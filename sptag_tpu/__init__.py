@@ -1,0 +1,41 @@
+"""sptag_tpu — a TPU-native approximate nearest neighbor search framework.
+
+A brand-new framework with the capabilities of Microsoft SPTAG (Space Partition
+Tree And Graph): space-partition-tree (balanced-k-means / kd-tree forests) +
+relative-neighborhood-graph vector indexes, budgeted best-first k-NN search,
+online insert/delete with background refinement, durable save/load (binary
+compatible with the reference folder format), and distributed sharded serving —
+re-architected for TPUs: distance math and candidate scoring run as batched
+XLA/Pallas programs, the serial graph walk is re-shaped into a fixed-budget
+batched beam search compiled per query batch, and index shards live on a
+`jax.sharding.Mesh` with on-device top-k merges over ICI.
+
+Public API parity target: the reference SWIG wrapper surface
+(/root/reference/Wrappers/inc/CoreInterface.h:14-65).
+"""
+
+from sptag_tpu.core.types import (
+    DistCalcMethod,
+    ErrorCode,
+    IndexAlgoType,
+    VectorValueType,
+)
+from sptag_tpu.core.vectorset import VectorSet, MetadataSet
+from sptag_tpu.core.index import VectorIndex, create_instance, load_index
+
+# Importing algo modules registers them with the factory.
+import sptag_tpu.algo.flat  # noqa: F401  (IndexAlgoType.FLAT)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DistCalcMethod",
+    "ErrorCode",
+    "IndexAlgoType",
+    "VectorValueType",
+    "VectorSet",
+    "MetadataSet",
+    "VectorIndex",
+    "create_instance",
+    "load_index",
+]

@@ -1,0 +1,219 @@
+"""FLAT — exact brute-force index on the MXU.
+
+No reference counterpart (SPTAG has only BKT/KDT); this is the framework's
+minimum end-to-end slice (SURVEY.md §7 step 3): exact top-K as one
+``(Q,D)x(N,D)`` matmul + `lax.top_k` per query batch.  It also serves as the
+ground-truth oracle for recall tests and as the search path for not-yet-merged
+delta rows in the mutable graph indexes.
+
+Device layout: the corpus lives as an immutable (Npad, D) jax.Array snapshot
+(rows padded to a lane-friendly multiple); deletes and padding are folded into
+the top-k as +inf distances (the reference filters tombstones in its hot loop
+instead, BKTIndex.cpp:234-239 — on TPU a masked dense top-k is cheaper than
+divergent control flow).  Mutation follows the single-writer snapshot design
+(SURVEY.md §2b P7): the host buffer grows, a dirty flag triggers a fresh
+device snapshot on the next search.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sptag_tpu.core.index import MAX_DIST, VectorIndex, register_algo
+from sptag_tpu.core.params import FlatParams
+from sptag_tpu.core.types import (
+    DistCalcMethod,
+    IndexAlgoType,
+    VectorValueType,
+    dtype_of,
+)
+from sptag_tpu.io import format as fmt
+from sptag_tpu.ops import distance as dist_ops
+
+_ROW_PAD = 128      # pad corpus rows to multiples of this (TPU lane width)
+_QUERY_BUCKETS = (1, 8, 32, 128, 512)
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _query_bucket(q: int) -> int:
+    for b in _QUERY_BUCKETS:
+        if q <= b:
+            return b
+    return _round_up(q, _QUERY_BUCKETS[-1])
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "base"))
+def _flat_search_kernel(data, sqnorm, invalid, queries, k: int,
+                        metric: int, base: int):
+    """One fused program: distance matrix -> mask -> top-k."""
+    if metric == int(DistCalcMethod.L2):
+        d = dist_ops.pairwise_l2(queries, data, sqnorm)
+    else:
+        d = dist_ops.pairwise_cosine(queries, data, base)
+    d = jnp.where(invalid[None, :], jnp.float32(MAX_DIST), d)
+    neg, idx = jax.lax.top_k(-d, k)
+    dists = -neg
+    ids = jnp.where(dists >= jnp.float32(MAX_DIST), -1, idx).astype(jnp.int32)
+    return dists, ids
+
+
+@register_algo
+class FlatIndex(VectorIndex):
+    algo = IndexAlgoType.FLAT
+
+    def __init__(self, value_type: VectorValueType):
+        super().__init__(value_type)
+        self._host: Optional[np.ndarray] = None   # capacity x D
+        self._n = 0
+        self._deleted = np.zeros(0, dtype=bool)
+        self._num_deleted = 0
+        self._dirty = True
+        self._device: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+
+    def _make_params(self) -> FlatParams:
+        return FlatParams()
+
+    # ---- storage ----------------------------------------------------------
+
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    @property
+    def num_deleted(self) -> int:
+        return self._num_deleted
+
+    @property
+    def feature_dim(self) -> int:
+        return 0 if self._host is None else self._host.shape[1]
+
+    def contains_sample(self, vid: int) -> bool:
+        return 0 <= vid < self._n and not self._deleted[vid]
+
+    def get_sample(self, vid: int) -> np.ndarray:
+        return self._host[vid]
+
+    def _reserve(self, extra: int) -> None:
+        need = self._n + extra
+        if self._host is None:
+            raise RuntimeError("index not built")
+        cap = self._host.shape[0]
+        if need > cap:
+            new_cap = max(need, cap * 2, 1024)
+            grown = np.empty((new_cap, self._host.shape[1]),
+                             self._host.dtype)
+            grown[:self._n] = self._host[:self._n]
+            self._host = grown
+            dels = np.zeros(new_cap, dtype=bool)
+            dels[:self._n] = self._deleted[:self._n]
+            self._deleted = dels
+
+    def _build(self, data: np.ndarray) -> None:
+        self._host = np.ascontiguousarray(data)
+        self._n = data.shape[0]
+        self._deleted = np.zeros(self._n, dtype=bool)
+        self._num_deleted = 0
+        self._dirty = True
+
+    def _add(self, data: np.ndarray) -> int:
+        begin = self._n
+        self._reserve(data.shape[0])
+        self._host[begin:begin + data.shape[0]] = data
+        self._n += data.shape[0]
+        self._dirty = True
+        return begin
+
+    def _delete_id(self, vid: int) -> bool:
+        if self._deleted[vid]:
+            return False
+        self._deleted[vid] = True
+        self._num_deleted += 1
+        self._dirty = True
+        return True
+
+    # ---- device snapshot --------------------------------------------------
+
+    def _snapshot(self):
+        if not self._dirty and self._device is not None:
+            return self._device
+        # Rebuild under the index's single-writer lock so a mutation landing
+        # mid-copy can't be lost behind a cleared dirty flag (P7 design).
+        with self._lock:
+            if not self._dirty and self._device is not None:
+                return self._device
+            n_pad = max(_ROW_PAD, _round_up(self._n, _ROW_PAD))
+            dt = dtype_of(self.value_type)
+            data = np.zeros((n_pad, self.feature_dim), dtype=dt)
+            data[:self._n] = self._host[:self._n]
+            invalid = np.ones(n_pad, dtype=bool)
+            invalid[:self._n] = self._deleted[:self._n]
+            data_d = jnp.asarray(data)
+            sqnorm_d = dist_ops.row_sqnorms(data_d)
+            self._device = (data_d, sqnorm_d, jnp.asarray(invalid))
+            self._dirty = False
+            return self._device
+
+    # ---- search -----------------------------------------------------------
+
+    def _search_batch(self, queries: np.ndarray,
+                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._n == 0:
+            raise RuntimeError("index is empty")
+        data_d, sqnorm_d, invalid_d = self._snapshot()
+        q = queries.shape[0]
+        q_pad = _query_bucket(q)
+        if q_pad != q:
+            queries = np.concatenate(
+                [queries, np.zeros((q_pad - q, queries.shape[1]),
+                                   queries.dtype)], axis=0)
+        k_eff = min(k, data_d.shape[0])
+        dists, ids = _flat_search_kernel(
+            data_d, sqnorm_d, invalid_d, jnp.asarray(queries), k_eff,
+            int(self.dist_calc_method), self.base)
+        dists = np.asarray(dists)[:q]
+        ids = np.asarray(ids)[:q]
+        if k_eff < k:
+            pad_d = np.full((q, k - k_eff), MAX_DIST, np.float32)
+            pad_i = np.full((q, k - k_eff), -1, np.int32)
+            dists = np.concatenate([dists, pad_d], axis=1)
+            ids = np.concatenate([ids, pad_i], axis=1)
+        return dists, ids
+
+    # ---- refine / persistence ---------------------------------------------
+
+    def _refine_impl(self) -> None:
+        keep = np.flatnonzero(~self._deleted[:self._n])
+        self._host = np.ascontiguousarray(self._host[keep])
+        self._n = len(keep)
+        self._deleted = np.zeros(self._n, dtype=bool)
+        self._num_deleted = 0
+        if self.metadata is not None:
+            self.metadata = self.metadata.refine(keep.tolist())
+        if self._meta_to_vec is not None:
+            self.build_meta_mapping()
+        self._dirty = True
+
+    def _save_index_data(self, folder: str) -> None:
+        fmt.write_matrix(os.path.join(folder, self.params.vector_file),
+                         self._host[:self._n])
+        fmt.write_deletes(os.path.join(folder, self.params.delete_file),
+                          self._deleted[:self._n])
+
+    def _load_index_data(self, folder: str) -> None:
+        data = fmt.read_matrix(os.path.join(folder, self.params.vector_file),
+                               dtype_of(self.value_type))
+        self._build(data)
+        delete_path = os.path.join(folder, self.params.delete_file)
+        if os.path.exists(delete_path):
+            mask = fmt.read_deletes(delete_path)
+            self._deleted[:len(mask)] = mask
+            self._num_deleted = int(mask.sum())

@@ -1,0 +1,408 @@
+"""VectorIndex — THE public API of the framework, plus the algo factory.
+
+Parity: the reference abstract base `VectorIndex` (/root/reference/AnnService/
+inc/Core/VectorIndex.h:18-130) and its shared logic (src/Core/
+VectorIndex.cpp): BuildIndex / AddIndex / DeleteIndex / SearchIndex /
+RefineIndex / SaveIndex / LoadIndex / MergeIndex, the static factory
+`CreateInstance(algo, valuetype)` (:286-320), folder save/load around
+`indexloader.ini` (:92-109, :324-360), and the metadata→vector mapping
+(:113-122, :235-242).
+
+TPU-first departures: search is batch-native (a (Q, D) query block is one
+compiled XLA program — the reference's OpenMP-over-queries loop,
+VectorIndex.cpp:212-220, becomes the batch dimension), and mutation follows a
+single-writer immutable-device-snapshot design (SURVEY.md §2b P7) instead of
+mutexes around shared rows.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Type, Union
+
+import numpy as np
+
+from sptag_tpu.core.params import ParamSet
+from sptag_tpu.core.types import (
+    DistCalcMethod,
+    ErrorCode,
+    IndexAlgoType,
+    VectorValueType,
+    base_of,
+    convert_to_string,
+    dtype_of,
+    enum_from_string,
+)
+from sptag_tpu.core.vectorset import MetadataSet, VectorSet
+from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.utils.ini import IniReader
+
+MAX_DIST = np.float32(np.finfo(np.float32).max)
+
+# Distance at-or-below which a searched vector counts as "the same vector"
+# for DeleteIndex(vector) (reference BKTIndex.cpp:439-453 uses 1e-6).
+DELETE_EPS = 1e-6
+
+
+@dataclass
+class SearchResult:
+    """One query's results; parity with QueryResult/BasicResult
+    (reference inc/Core/SearchQuery.h:15-190, SearchResult.h:12-23)."""
+
+    ids: np.ndarray                  # (K,) int32, -1 padded
+    dists: np.ndarray                # (K,) float32, MAX_DIST padded
+    metas: Optional[List[bytes]] = None
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+
+_REGISTRY: Dict[IndexAlgoType, Type["VectorIndex"]] = {}
+
+
+def register_algo(cls: Type["VectorIndex"]) -> Type["VectorIndex"]:
+    _REGISTRY[cls.algo] = cls
+    return cls
+
+
+def create_instance(algo: Union[IndexAlgoType, str],
+                    value_type: Union[VectorValueType, str]) -> "VectorIndex":
+    """Parity: VectorIndex::CreateInstance (reference VectorIndex.cpp:286-320)."""
+    if isinstance(algo, str):
+        algo = enum_from_string(IndexAlgoType, algo)
+    if isinstance(value_type, str):
+        value_type = enum_from_string(VectorValueType, value_type)
+    cls = _REGISTRY.get(IndexAlgoType(algo))
+    if cls is None:
+        raise ValueError(f"no index algorithm registered for {algo}")
+    return cls(value_type)
+
+
+class VectorIndex(abc.ABC):
+    algo: IndexAlgoType = IndexAlgoType.Undefined
+
+    def __init__(self, value_type: VectorValueType):
+        self.value_type = VectorValueType(value_type)
+        self.params: ParamSet = self._make_params()
+        self.metadata: Optional[MetadataSet] = None
+        self._meta_to_vec: Optional[Dict[bytes, int]] = None
+        self._lock = threading.RLock()   # single-writer mutation lock (P7)
+        self._meta_file = "metadata.bin"
+        self._meta_index_file = "metadataIndex.bin"
+
+    # ---- subclass surface -------------------------------------------------
+
+    @abc.abstractmethod
+    def _make_params(self) -> ParamSet: ...
+
+    @abc.abstractmethod
+    def _build(self, data: np.ndarray) -> None:
+        """Build index structures over `data` (already normalized if cosine)."""
+
+    @abc.abstractmethod
+    def _search_batch(self, queries: np.ndarray,
+                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(Q, D) queries (already normalized if cosine) -> ((Q, K) dists,
+        (Q, K) int32 ids), ascending, -1/MAX_DIST padded, excluding deleted."""
+
+    @abc.abstractmethod
+    def _add(self, data: np.ndarray) -> int:
+        """Append rows (already normalized if cosine); returns first new id."""
+
+    @abc.abstractmethod
+    def _delete_id(self, vid: int) -> bool:
+        """Tombstone one id; returns False if already deleted."""
+
+    @abc.abstractmethod
+    def _save_index_data(self, folder: str) -> None: ...
+
+    @abc.abstractmethod
+    def _load_index_data(self, folder: str) -> None: ...
+
+    @property
+    @abc.abstractmethod
+    def num_samples(self) -> int: ...
+
+    @property
+    @abc.abstractmethod
+    def num_deleted(self) -> int: ...
+
+    @property
+    @abc.abstractmethod
+    def feature_dim(self) -> int: ...
+
+    @abc.abstractmethod
+    def contains_sample(self, vid: int) -> bool: ...
+
+    @abc.abstractmethod
+    def get_sample(self, vid: int) -> np.ndarray: ...
+
+    def _refine_impl(self) -> None:
+        """Compact deleted rows; subclasses with graphs/trees override."""
+        raise NotImplementedError
+
+    # ---- common parameter / metric helpers --------------------------------
+
+    @property
+    def dist_calc_method(self) -> DistCalcMethod:
+        return DistCalcMethod(getattr(self.params, "dist_calc_method",
+                                      DistCalcMethod.L2))
+
+    @property
+    def base(self) -> int:
+        return base_of(self.value_type)
+
+    def set_parameter(self, name: str, value: str) -> bool:
+        return self.params.set_param(name, value)
+
+    def get_parameter(self, name: str) -> Optional[str]:
+        return self.params.get_param(name)
+
+    def _prepare_vectors(self, vectors, normalize: bool = True) -> np.ndarray:
+        if isinstance(vectors, VectorSet):
+            if vectors.value_type != self.value_type:
+                raise ValueError("VectorSet value type mismatch")
+            data = vectors.data
+        else:
+            data = np.asarray(vectors)
+            if data.ndim == 1:
+                data = data[None, :]
+            data = data.astype(dtype_of(self.value_type), copy=False)
+        if normalize and self.dist_calc_method == DistCalcMethod.Cosine:
+            # Build-time corpus normalization, parity with the reference
+            # (BKTIndex.cpp:289-296 + Utils::Normalize CommonUtils.h:93-108).
+            data = dist_ops.normalize(data, self.base)
+        return np.ascontiguousarray(data)
+
+    # ---- build / search ---------------------------------------------------
+
+    def build(self, vectors, metadata: Optional[MetadataSet] = None,
+              with_meta_index: bool = False) -> ErrorCode:
+        """Parity: VectorIndex::BuildIndex (reference VectorIndex.cpp:192-208)."""
+        data = self._prepare_vectors(vectors)
+        if data.size == 0:
+            return ErrorCode.EmptyData
+        with self._lock:
+            self._build(data)
+            self.metadata = metadata
+            if with_meta_index and metadata is not None:
+                self.build_meta_mapping()
+        return ErrorCode.Success
+
+    def build_meta_mapping(self) -> None:
+        """Parity: VectorIndex::BuildMetaMapping (VectorIndex.cpp:113-122)."""
+        assert self.metadata is not None
+        mapping: Dict[bytes, int] = {}
+        for i in range(self.metadata.count):
+            if self.contains_sample(i):
+                mapping[self.metadata.get_metadata(i)] = i
+        self._meta_to_vec = mapping
+
+    def search(self, query, k: int = 10,
+               with_metadata: bool = False) -> SearchResult:
+        dists, ids = self.search_batch(np.asarray(query)[None, :], k)
+        metas = None
+        if with_metadata and self.metadata is not None:
+            metas = [self.metadata.get_metadata(int(v)) if v >= 0 else b""
+                     for v in ids[0]]
+        return SearchResult(ids[0], dists[0], metas)
+
+    def search_batch(self, queries: np.ndarray,
+                     k: int = 10) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch search: the whole (Q, D) block is one device program —
+        replaces the reference's OpenMP parallel-for over queries
+        (VectorIndex.cpp:212-220)."""
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"query dim {queries.shape[1]} != index dim {self.feature_dim}")
+        queries = self._prepare_query(queries)
+        return self._search_batch(queries, k)
+
+    def _prepare_query(self, queries: np.ndarray) -> np.ndarray:
+        """Queries are normalized for cosine, like the reference harness does
+        at load (Utils::PrepareQuerys, CommonUtils.h:110-143)."""
+        queries = queries.astype(dtype_of(self.value_type), copy=False)
+        if self.dist_calc_method == DistCalcMethod.Cosine:
+            queries = dist_ops.normalize(queries, self.base)
+        return np.ascontiguousarray(queries)
+
+    # ---- mutation ---------------------------------------------------------
+
+    def add(self, vectors, metadata: Optional[MetadataSet] = None,
+            with_meta_index: bool = False) -> ErrorCode:
+        """Parity: VectorIndex::AddIndex + BKT dedupe-by-metadata semantics
+        (reference VectorIndex.cpp:224-231, BKTIndex.cpp:462-529)."""
+        data = self._prepare_vectors(vectors)
+        if data.size == 0:
+            return ErrorCode.EmptyData
+        with self._lock:
+            if self.num_samples == 0:
+                # data is already normalized; bypass build()'s re-preparation
+                self._build(data)
+                self.metadata = metadata
+                if with_meta_index and metadata is not None:
+                    self.build_meta_mapping()
+                return ErrorCode.Success
+            begin = self._add(data)
+            if metadata is not None:
+                if self.metadata is None:
+                    self.metadata = MetadataSet([b""] * begin)
+                for i in range(data.shape[0]):
+                    meta = metadata.get_metadata(i)
+                    self.metadata.add(meta)
+                    if self._meta_to_vec is not None and meta:
+                        old = self._meta_to_vec.get(meta)
+                        if old is not None:
+                            self._delete_id(old)
+                        self._meta_to_vec[meta] = begin + i
+            elif self.metadata is not None:
+                for _ in range(data.shape[0]):
+                    self.metadata.add(b"")
+        return ErrorCode.Success
+
+    def delete(self, vectors) -> ErrorCode:
+        """Delete-by-content: search each vector, tombstone exact matches
+        (dist <= eps), parity with BKT::DeleteIndex (BKTIndex.cpp:439-453)."""
+        if self.num_samples == 0:
+            return ErrorCode.VectorNotFound
+        data = self._prepare_vectors(vectors, normalize=True)
+        if data.shape[1] != self.feature_dim:
+            return ErrorCode.DimensionSizeMismatch
+        found_any = False
+        # data is already normalized — call the subclass engine directly
+        # rather than search_batch, which would normalize a second time.
+        dists, ids = self._search_batch(data, 32)
+        with self._lock:
+            for row_d, row_i in zip(dists, ids):
+                for d, v in zip(row_d, row_i):
+                    if v >= 0 and d <= DELETE_EPS:
+                        self._delete_id(int(v))
+                        found_any = True
+        return ErrorCode.Success if found_any else ErrorCode.VectorNotFound
+
+    def delete_by_metadata(self, meta: bytes) -> ErrorCode:
+        """Parity: VectorIndex::DeleteIndex(ByteArray) (VectorIndex.cpp:235-242)."""
+        if self._meta_to_vec is None:
+            return ErrorCode.VectorNotFound
+        vid = self._meta_to_vec.get(bytes(meta))
+        if vid is None:
+            return ErrorCode.VectorNotFound
+        with self._lock:
+            self._delete_id(vid)
+        return ErrorCode.Success
+
+    # ---- refine / merge ---------------------------------------------------
+
+    @property
+    def need_refine(self) -> bool:
+        """Parity: deleted fraction > DeletePercentageForRefine (reference
+        BKT/Index.h:122)."""
+        n = self.num_samples
+        if n == 0:
+            return False
+        limit = getattr(self.params, "delete_percentage_for_refine", 0.4)
+        return self.num_deleted >= limit * n
+
+    def refine_index(self) -> ErrorCode:
+        with self._lock:
+            self._refine_impl()
+        return ErrorCode.Success
+
+    def merge_index(self, other: "VectorIndex") -> ErrorCode:
+        """Parity: VectorIndex::MergeIndex re-add loop (VectorIndex.cpp:246-268)."""
+        if (other.value_type != self.value_type
+                or other.feature_dim != self.feature_dim):
+            return ErrorCode.Fail
+        keep = [i for i in range(other.num_samples) if other.contains_sample(i)]
+        if not keep:
+            return ErrorCode.Success
+        rows = np.stack([other.get_sample(i) for i in keep])
+        metas = None
+        if other.metadata is not None:
+            metas = MetadataSet(other.metadata.get_metadata(i) for i in keep)
+        # rows are already normalized by the source index for cosine
+        with self._lock:
+            if self.num_samples == 0:
+                self._build(rows)
+                self.metadata = metas
+            else:
+                begin = self._add(rows)
+                if metas is not None:
+                    if self.metadata is None:
+                        self.metadata = MetadataSet([b""] * begin)
+                    self.metadata.add_batch(metas)
+                elif self.metadata is not None:
+                    for _ in keep:
+                        self.metadata.add(b"")
+        if self._meta_to_vec is not None:
+            self.build_meta_mapping()
+        return ErrorCode.Success
+
+    # ---- persistence ------------------------------------------------------
+
+    def save_index_config(self) -> str:
+        """Parity: VectorIndex::SaveIndexConfig (VectorIndex.cpp:92-109)."""
+        out = []
+        if self.metadata is not None:
+            out.append("[MetaData]")
+            out.append(f"MetaDataFilePath={self._meta_file}")
+            out.append(f"MetaDataIndexPath={self._meta_index_file}")
+            if self._meta_to_vec is not None:
+                out.append("MetaDataToVectorIndex=true")
+            out.append("")
+        out.append("[Index]")
+        out.append(f"IndexAlgoType={convert_to_string(self.algo)}")
+        out.append(f"ValueType={convert_to_string(self.value_type)}")
+        out.append("")
+        out.append(self.params.save_config())
+        return "\n".join(out)
+
+    def save_index(self, folder: str) -> ErrorCode:
+        """Parity: VectorIndex::SaveIndex(folder) (VectorIndex.cpp:162-190),
+        including the transparent compaction of a >40%-deleted index."""
+        if self.num_samples - self.num_deleted == 0:
+            return ErrorCode.EmptyIndex
+        os.makedirs(folder, exist_ok=True)
+        with self._lock:
+            if self.need_refine:
+                self._refine_impl()
+            with open(os.path.join(folder, "indexloader.ini"), "w") as f:
+                f.write(self.save_index_config())
+            if self.metadata is not None:
+                self.metadata.save(os.path.join(folder, self._meta_file),
+                                   os.path.join(folder, self._meta_index_file))
+            self._save_index_data(folder)
+        return ErrorCode.Success
+
+    def load_index_data(self, folder: str, reader: IniReader) -> None:
+        self.params.load_config(reader.section_items("Index"))
+        self._load_index_data(folder)
+        if reader.does_section_exist("MetaData"):
+            self._meta_file = reader.get_parameter(
+                "MetaData", "MetaDataFilePath", self._meta_file)
+            self._meta_index_file = reader.get_parameter(
+                "MetaData", "MetaDataIndexPath", self._meta_index_file)
+            self.metadata = MetadataSet.load(
+                os.path.join(folder, self._meta_file),
+                os.path.join(folder, self._meta_index_file))
+            if reader.get_parameter("MetaData", "MetaDataToVectorIndex",
+                                    "") == "true":
+                self.build_meta_mapping()
+
+
+def load_index(folder: str) -> VectorIndex:
+    """Parity: VectorIndex::LoadIndex(folder) (VectorIndex.cpp:324-360)."""
+    reader = IniReader.load(os.path.join(folder, "indexloader.ini"))
+    algo = reader.get_parameter("Index", "IndexAlgoType")
+    value_type = reader.get_parameter("Index", "ValueType")
+    if algo is None or value_type is None:
+        raise ValueError("indexloader.ini missing IndexAlgoType/ValueType")
+    index = create_instance(algo, value_type)
+    index.load_index_data(folder, reader)
+    return index

@@ -1,0 +1,168 @@
+"""Typed parameter registry with string get/set parity.
+
+Parity: the reference's X-macro parameter system — `DefineBKTParameter(var,
+type, default, "Name")` (/root/reference/AnnService/inc/Core/BKT/
+ParameterDefinitionList.h:7-38, KDT :7-36) expands into member init,
+SetParameter/GetParameter string dispatch (src/Core/BKT/BKTIndex.cpp:537-573)
+and config save/load (:18-27, :64-73).  Here the registry is a plain dict of
+ParamSpec; each index class owns a Params instance.  `set_param`/`get_param`
+accept the same case-insensitive RepresentStr names the wrappers use
+(CoreInterface.h SetBuildParam/SetSearchParam).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from sptag_tpu.core.types import (
+    DistCalcMethod,
+    convert_string_to,
+    convert_to_string,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamSpec:
+    attr: str           # python attribute name
+    py_type: type       # int / float / str / enum
+    default: Any
+    name: str           # RepresentStr (external, case-insensitive)
+
+
+class ParamSet:
+    """A bag of typed parameters addressable by external string name."""
+
+    SPECS: List[ParamSpec] = []
+
+    def __init__(self, **overrides):
+        self._by_name: Dict[str, ParamSpec] = {
+            s.name.lower(): s for s in self.SPECS
+        }
+        for spec in self.SPECS:
+            setattr(self, spec.attr, spec.default)
+        for attr, value in overrides.items():
+            if not any(s.attr == attr for s in self.SPECS):
+                raise AttributeError(f"unknown parameter attribute {attr!r}")
+            setattr(self, attr, value)
+
+    def set_param(self, name: str, value: str) -> bool:
+        """String-typed set; returns False for unknown names (the reference
+        returns ErrorCode::Fail, BKTIndex.cpp:546)."""
+        spec = self._by_name.get(name.lower())
+        if spec is None:
+            return False
+        setattr(self, spec.attr, convert_string_to(str(value), spec.py_type))
+        return True
+
+    def get_param(self, name: str) -> Optional[str]:
+        spec = self._by_name.get(name.lower())
+        if spec is None:
+            return None
+        return convert_to_string(getattr(self, spec.attr))
+
+    def items(self):
+        for spec in self.SPECS:
+            yield spec.name, convert_to_string(getattr(self, spec.attr))
+
+    def save_config(self) -> str:
+        """One `Name=Value` line per registered param, in registry order —
+        same shape the reference writes into indexloader.ini [Index]
+        (BKTIndex.cpp:64-73)."""
+        return "".join(f"{k}={v}\n" for k, v in self.items())
+
+    def load_config(self, section: Dict[str, str]) -> None:
+        for key, value in section.items():
+            self.set_param(key, value)
+
+
+def _spec(attr, py_type, default, name):
+    return ParamSpec(attr, py_type, default, name)
+
+
+# Shared graph params appear in both BKT and KDT registries, matching the two
+# reference ParameterDefinitionList.h files line for line.
+_GRAPH_SPECS = [
+    _spec("tpt_number", int, 32, "TPTNumber"),
+    _spec("tpt_leaf_size", int, 2000, "TPTLeafSize"),
+    _spec("neighborhood_size", int, 32, "NeighborhoodSize"),
+    _spec("neighborhood_scale", int, 2, "GraphNeighborhoodScale"),
+    _spec("cef_scale", int, 2, "GraphCEFScale"),
+    _spec("refine_iterations", int, 2, "RefineIterations"),
+    _spec("cef", int, 1000, "CEF"),
+    _spec("add_cef", int, 500, "AddCEF"),
+    _spec("max_check_for_refine_graph", int, 8192, "MaxCheckForRefineGraph"),
+]
+
+_COMMON_TAIL_SPECS = [
+    _spec("number_of_threads", int, 1, "NumberOfThreads"),
+    _spec("dist_calc_method", DistCalcMethod, DistCalcMethod.Cosine,
+          "DistCalcMethod"),
+    _spec("delete_percentage_for_refine", float, 0.4,
+          "DeletePercentageForRefine"),
+    _spec("add_count_for_rebuild", int, 1000, "AddCountForRebuild"),
+    _spec("max_check", int, 8192, "MaxCheck"),
+    _spec("no_better_propagation_limit", int, 3,
+          "ThresholdOfNumberOfContinuousNoBetterPropagation"),
+    _spec("initial_dynamic_pivots", int, 50, "NumberOfInitialDynamicPivots"),
+    _spec("other_dynamic_pivots", int, 4, "NumberOfOtherDynamicPivots"),
+]
+
+_FILE_SPECS = [
+    _spec("tree_file", str, "tree.bin", "TreeFilePath"),
+    _spec("graph_file", str, "graph.bin", "GraphFilePath"),
+    _spec("vector_file", str, "vectors.bin", "VectorFilePath"),
+    _spec("delete_file", str, "deletes.bin", "DeleteVectorFilePath"),
+]
+
+
+class BKTParams(ParamSet):
+    """Parity: inc/Core/BKT/ParameterDefinitionList.h:7-38."""
+
+    SPECS = (
+        _FILE_SPECS
+        + [
+            _spec("tree_number", int, 1, "BKTNumber"),
+            _spec("kmeans_k", int, 32, "BKTKmeansK"),
+            _spec("leaf_size", int, 8, "BKTLeafSize"),
+            _spec("samples", int, 1000, "Samples"),
+        ]
+        + _GRAPH_SPECS[:2]
+        + [_spec("tpt_top_dims", int, 5, "NumTopDimensionTpTreeSplit")]
+        + _GRAPH_SPECS[2:]
+        + _COMMON_TAIL_SPECS
+    )
+
+
+class KDTParams(ParamSet):
+    """Parity: inc/Core/KDT/ParameterDefinitionList.h:7-36."""
+
+    SPECS = (
+        _FILE_SPECS
+        + [
+            _spec("tree_number", int, 1, "KDTNumber"),
+            _spec("kdt_top_dims", int, 5, "NumTopDimensionKDTSplit"),
+            _spec("samples", int, 100, "Samples"),
+        ]
+        + _GRAPH_SPECS[:2]
+        + [_spec("tpt_top_dims", int, 5, "NumTopDimensionTPTSplit")]
+        + _GRAPH_SPECS[2:]
+        + _COMMON_TAIL_SPECS
+    )
+
+
+class FlatParams(ParamSet):
+    """Params for the TPU-only exact FLAT index (no reference counterpart;
+    kept registry-compatible so the wrapper SetBuildParam surface works)."""
+
+    SPECS = [
+        _spec("vector_file", str, "vectors.bin", "VectorFilePath"),
+        _spec("delete_file", str, "deletes.bin", "DeleteVectorFilePath"),
+        _spec("dist_calc_method", DistCalcMethod, DistCalcMethod.Cosine,
+              "DistCalcMethod"),
+        _spec("number_of_threads", int, 1, "NumberOfThreads"),
+        _spec("delete_percentage_for_refine", float, 0.4,
+              "DeletePercentageForRefine"),
+        _spec("max_check", int, 8192, "MaxCheck"),
+        _spec("batch_size", int, 256, "BatchSize"),
+    ]

@@ -1,0 +1,128 @@
+"""VectorSet and MetadataSet — the framework's data-carrying types.
+
+Parity: reference `BasicVectorSet` (/root/reference/AnnService/inc/Core/
+VectorSet.h:12-69) and `MemMetadataSet`/`FileMetadataSet`
+(inc/Core/MetadataSet.h:15-115, src/Core/MetadataSet.cpp).  The universal
+buffer type is a numpy array instead of the ref-counted ByteArray
+(inc/Core/CommonDataStructure.h:12-222) — numpy provides the same
+shared-ownership semantics natively.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from sptag_tpu.core.types import VectorValueType, dtype_of, value_type_of
+
+
+class VectorSet:
+    """A (count, dim) matrix of vectors of one VectorValueType."""
+
+    def __init__(self, data: np.ndarray,
+                 value_type: Optional[VectorValueType] = None):
+        data = np.ascontiguousarray(data)
+        if data.ndim != 2:
+            raise ValueError("VectorSet expects a 2-D array")
+        if value_type is None:
+            value_type = value_type_of(data.dtype)
+        self._value_type = VectorValueType(value_type)
+        self._data = data.astype(dtype_of(self._value_type), copy=False)
+
+    @property
+    def data(self) -> np.ndarray:
+        return self._data
+
+    @property
+    def value_type(self) -> VectorValueType:
+        return self._value_type
+
+    @property
+    def count(self) -> int:
+        return self._data.shape[0]
+
+    @property
+    def dimension(self) -> int:
+        return self._data.shape[1]
+
+    def get_vector(self, i: int) -> np.ndarray:
+        return self._data[i]
+
+    def save(self, path_or_stream) -> None:
+        """Reference vectors.bin layout: int32 rows, int32 cols, raw row-major
+        data (Dataset<T>::Save, reference Dataset.h:144-158)."""
+        from sptag_tpu.io import format as fmt
+        fmt.write_matrix(path_or_stream, self._data)
+
+    @classmethod
+    def load(cls, path_or_stream, value_type: VectorValueType) -> "VectorSet":
+        from sptag_tpu.io import format as fmt
+        data = fmt.read_matrix(path_or_stream, dtype_of(value_type))
+        return cls(data, value_type)
+
+
+class MetadataSet:
+    """Per-vector opaque byte payloads.
+
+    Binary layout parity (MetadataSet::RefineMetadata, reference
+    src/Core/MetadataSet.cpp:22-35): ``metadata.bin`` is the raw
+    concatenation; ``metadataIndex.bin`` is int32 count followed by
+    (count+1) uint64 byte offsets.
+    """
+
+    def __init__(self, metas: Optional[Iterable[bytes]] = None):
+        self._metas: List[bytes] = [bytes(m) for m in metas] if metas else []
+
+    @classmethod
+    def from_lines(cls, blob: bytes, offsets: Sequence[int]) -> "MetadataSet":
+        metas = [bytes(blob[offsets[i]:offsets[i + 1]])
+                 for i in range(len(offsets) - 1)]
+        return cls(metas)
+
+    @property
+    def count(self) -> int:
+        return len(self._metas)
+
+    def get_metadata(self, i: int) -> bytes:
+        if i < 0 or i >= len(self._metas):
+            return b""
+        return self._metas[i]
+
+    def add(self, meta: bytes) -> None:
+        self._metas.append(bytes(meta))
+
+    def add_batch(self, other: "MetadataSet") -> None:
+        self._metas.extend(other._metas)
+
+    def refine(self, indices: Sequence[int]) -> "MetadataSet":
+        return MetadataSet(self._metas[i] for i in indices)
+
+    def save(self, meta_path_or_stream, index_path_or_stream) -> None:
+        from sptag_tpu.io import format as fmt
+        blob = b"".join(self._metas)
+        offsets = np.zeros(len(self._metas) + 1, dtype=np.uint64)
+        np.cumsum([len(m) for m in self._metas], out=offsets[1:])
+        with fmt.open_write(meta_path_or_stream) as f:
+            f.write(blob)
+        with fmt.open_write(index_path_or_stream) as f:
+            f.write(struct.pack("<i", len(self._metas)) + offsets.tobytes())
+
+    @classmethod
+    def load(cls, meta_path_or_stream, index_path_or_stream) -> "MetadataSet":
+        from sptag_tpu.io import format as fmt
+        with fmt.open_read(index_path_or_stream) as f:
+            idx = f.read()
+        (count,) = struct.unpack_from("<i", idx, 0)
+        offsets = np.frombuffer(idx, dtype=np.uint64, count=count + 1,
+                                offset=4).astype(np.int64)
+        with fmt.open_read(meta_path_or_stream) as f:
+            blob = f.read()
+        return cls.from_lines(blob, offsets.tolist())
+
+
+def metadata_from_texts(texts: Iterable[Union[str, bytes]]) -> MetadataSet:
+    return MetadataSet(
+        t.encode() if isinstance(t, str) else bytes(t) for t in texts)

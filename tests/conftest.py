@@ -1,0 +1,20 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so
+multi-chip sharding (mesh/pjit/shard_map) is exercised without TPU hardware —
+the strategy SURVEY.md §4 prescribes for the new framework's multi-shard tests.
+
+The environment pre-registers the TPU backend via sitecustomize, so setting
+JAX_PLATFORMS alone is not enough; jax.config.update pins the platform list.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,143 @@
+"""FLAT index lifecycle tests — the deterministic ramp-vector lifecycle test
+the reference uses (Test/src/AlgoTest.cpp:112-188): Build -> Search ->
+Save -> Load -> Search -> Add -> Delete, with metadata truth checks."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sptag_tpu import (
+    DistCalcMethod,
+    IndexAlgoType,
+    VectorValueType,
+    create_instance,
+    load_index,
+)
+from sptag_tpu.core.vectorset import metadata_from_texts
+
+
+def ramp_vectors(n=200, d=10, dtype=np.float32):
+    """Reference AlgoTest synthetic data: vec[i] = [i, i, ..., i] + ramp."""
+    base = np.arange(n, dtype=np.float32)[:, None] + np.zeros((1, d), np.float32)
+    base += np.arange(d, dtype=np.float32)[None, :] * 0.01
+    return base.astype(dtype)
+
+
+def brute_force_l2(data, queries, k):
+    d = ((queries[:, None, :].astype(np.float64)
+          - data[None, :, :].astype(np.float64)) ** 2).sum(-1)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return idx
+
+
+@pytest.mark.parametrize("value_type,dtype", [
+    (VectorValueType.Float, np.float32),
+    (VectorValueType.Int8, np.int8),
+])
+def test_build_search_exact(value_type, dtype):
+    n, d, k = 300, 16, 5
+    rng = np.random.default_rng(3)
+    if dtype == np.float32:
+        data = rng.standard_normal((n, d)).astype(np.float32)
+        queries = data[:10] + 0.001 * rng.standard_normal((10, d)).astype(np.float32)
+    else:
+        data = rng.integers(-100, 100, (n, d)).astype(np.int8)
+        queries = data[:10]
+    index = create_instance(IndexAlgoType.FLAT, value_type)
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    dists, ids = index.search_batch(queries, k)
+    truth = brute_force_l2(data, queries, k)
+    # exact search: top-1 must be the nearest neighbor
+    np.testing.assert_array_equal(ids[:, 0], truth[:, 0])
+    assert np.all(np.diff(dists, axis=1) >= 0)
+
+
+def test_cosine_self_query_is_nearest():
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal((100, 12)).astype(np.float32)
+    index = create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "Cosine")
+    index.build(data)
+    res = index.search(data[7], k=1)
+    assert res.ids[0] == 7
+    assert res.dists[0] == pytest.approx(0.0, abs=1e-5)
+
+
+def test_lifecycle_with_metadata(tmp_path):
+    n, d = 120, 10
+    data = ramp_vectors(n, d)
+    metas = metadata_from_texts([str(i) for i in range(n)])
+    index = create_instance(IndexAlgoType.FLAT, VectorValueType.Float)
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data, metas, with_meta_index=True)
+
+    res = index.search(data[13], k=3, with_metadata=True)
+    assert res.metas[0] == b"13"
+
+    folder = str(tmp_path / "flatidx")
+    assert index.save_index(folder).name == "Success"
+    assert os.path.exists(os.path.join(folder, "indexloader.ini"))
+    assert os.path.exists(os.path.join(folder, "vectors.bin"))
+    assert os.path.exists(os.path.join(folder, "deletes.bin"))
+    assert os.path.exists(os.path.join(folder, "metadata.bin"))
+
+    loaded = load_index(folder)
+    assert loaded.num_samples == n
+    assert loaded.value_type == VectorValueType.Float
+    assert loaded.dist_calc_method == DistCalcMethod.L2
+    res2 = loaded.search(data[13], k=3, with_metadata=True)
+    assert res2.metas[0] == b"13"
+    np.testing.assert_array_equal(res.ids, res2.ids)
+
+    # add
+    extra = ramp_vectors(5, d) + 1000.0
+    extra_meta = metadata_from_texts([f"x{i}" for i in range(5)])
+    loaded.add(extra, extra_meta)
+    assert loaded.num_samples == n + 5
+    res3 = loaded.search(extra[2], k=1, with_metadata=True)
+    assert res3.metas[0] == b"x2"
+
+    # delete by vector content
+    assert loaded.delete(data[13]).name == "Success"
+    res4 = loaded.search(data[13], k=1)
+    assert res4.ids[0] != 13
+
+    # delete by metadata
+    loaded.build_meta_mapping()
+    assert loaded.delete_by_metadata(b"x2").name == "Success"
+    res5 = loaded.search(extra[2], k=1, with_metadata=True)
+    assert res5.metas[0] != b"x2"
+
+
+def test_refine_compacts_deleted(tmp_path):
+    data = ramp_vectors(50, 8)
+    metas = metadata_from_texts([str(i) for i in range(50)])
+    index = create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data, metas)
+    for i in range(30):
+        index._delete_id(i)
+    assert index.need_refine
+    folder = str(tmp_path / "refined")
+    index.save_index(folder)  # save triggers transparent compaction
+    loaded = load_index(folder)
+    assert loaded.num_samples == 20
+    assert loaded.num_deleted == 0
+    res = loaded.search(data[35], k=1, with_metadata=True)
+    assert res.metas[0] == b"35"
+
+
+def test_merge_index():
+    a = create_instance("FLAT", "Float")
+    a.set_parameter("DistCalcMethod", "L2")
+    b = create_instance("FLAT", "Float")
+    b.set_parameter("DistCalcMethod", "L2")
+    data = ramp_vectors(40, 6)
+    a.build(data[:20], metadata_from_texts([str(i) for i in range(20)]))
+    b.build(data[20:], metadata_from_texts([str(i) for i in range(20, 40)]))
+    assert a.merge_index(b).name == "Success"
+    assert a.num_samples == 40
+    res = a.search(data[33], k=1, with_metadata=True)
+    assert res.metas[0] == b"33"

@@ -1,0 +1,98 @@
+"""Binary format round-trip tests against hand-computed reference layouts
+(Dataset.h:144-158, BKTree.h:219-229, NeighborhoodGraph.h:376-386,
+Labelset.h:47-52, MetadataSet.cpp:22-35)."""
+
+import io
+import struct
+
+import numpy as np
+
+from sptag_tpu.core.vectorset import MetadataSet
+from sptag_tpu.io import format as fmt
+from sptag_tpu.utils.ini import IniReader
+
+
+def test_matrix_layout_bytes():
+    arr = np.array([[1, 2], [3, 4], [5, 6]], dtype=np.float32)
+    buf = io.BytesIO()
+    fmt.write_matrix(buf, arr)
+    raw = buf.getvalue()
+    # int32 rows, int32 cols, row-major payload
+    assert struct.unpack_from("<ii", raw) == (3, 2)
+    np.testing.assert_array_equal(
+        np.frombuffer(raw[8:], np.float32).reshape(3, 2), arr)
+    out = fmt.read_matrix(io.BytesIO(raw), np.float32)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_tree_forest_layout():
+    starts = np.array([0, 7], np.int32)
+    nodes = np.zeros(9, fmt.BKT_NODE_DTYPE)
+    nodes["centerid"] = np.arange(9)
+    nodes["childStart"] = np.arange(9) + 100
+    nodes["childEnd"] = np.arange(9) + 200
+    buf = io.BytesIO()
+    fmt.write_tree_forest(buf, starts, nodes)
+    raw = buf.getvalue()
+    assert struct.unpack_from("<i", raw)[0] == 2          # treeNumber
+    assert struct.unpack_from("<ii", raw, 4) == (0, 7)     # starts
+    assert struct.unpack_from("<i", raw, 12)[0] == 9       # node count
+    assert len(raw) == 16 + 9 * 12                         # 12-byte BKTNode
+    s2, n2 = fmt.read_tree_forest(io.BytesIO(raw), fmt.BKT_NODE_DTYPE)
+    np.testing.assert_array_equal(s2, starts)
+    np.testing.assert_array_equal(n2, nodes)
+
+
+def test_kdt_node_is_16_bytes():
+    assert fmt.KDT_NODE_DTYPE.itemsize == 16
+    nodes = np.zeros(3, fmt.KDT_NODE_DTYPE)
+    nodes["split_value"] = [0.5, -1.25, 3.0]
+    buf = io.BytesIO()
+    fmt.write_tree_forest(buf, np.array([0], np.int32), nodes)
+    _, n2 = fmt.read_tree_forest(io.BytesIO(buf.getvalue()),
+                                 fmt.KDT_NODE_DTYPE)
+    np.testing.assert_array_equal(n2["split_value"], nodes["split_value"])
+
+
+def test_deletes_layout():
+    mask = np.array([0, 1, 0, 1, 1], bool)
+    buf = io.BytesIO()
+    fmt.write_deletes(buf, mask)
+    raw = buf.getvalue()
+    assert struct.unpack_from("<i", raw)[0] == 3           # deleted count
+    assert struct.unpack_from("<ii", raw, 4) == (5, 1)     # Dataset<int8> hdr
+    out = fmt.read_deletes(io.BytesIO(raw))
+    np.testing.assert_array_equal(out, mask)
+
+
+def test_metadata_layout():
+    metas = MetadataSet([b"alpha", b"", b"xy"])
+    mbuf, ibuf = io.BytesIO(), io.BytesIO()
+    metas.save(mbuf, ibuf)
+    assert mbuf.getvalue() == b"alphaxy"
+    raw = ibuf.getvalue()
+    assert struct.unpack_from("<i", raw)[0] == 3
+    offsets = np.frombuffer(raw, np.uint64, 4, 4)
+    np.testing.assert_array_equal(offsets, [0, 5, 5, 7])
+    loaded = MetadataSet.load(io.BytesIO(mbuf.getvalue()),
+                              io.BytesIO(raw))
+    assert [loaded.get_metadata(i) for i in range(3)] == [b"alpha", b"", b"xy"]
+
+
+def test_ini_reader_case_insensitive():
+    text = """
+; comment
+[Index]
+IndexAlgoType=BKT
+ValueType=Float
+
+[MetaData]
+MetaDataFilePath=metadata.bin
+"""
+    r = IniReader.loads(text)
+    assert r.does_section_exist("index")
+    assert r.get_parameter("INDEX", "indexalgotype") == "BKT"
+    assert r.get_parameter("Index", "Missing", "dflt") == "dflt"
+    assert r.section_items("Index")["IndexAlgoType"] == "BKT"
+    r2 = IniReader.loads(r.dumps())
+    assert r2.get_parameter("MetaData", "MetaDataFilePath") == "metadata.bin"

@@ -1,0 +1,60 @@
+"""Parameter registry parity tests (reference X-macro registry,
+inc/Core/BKT/ParameterDefinitionList.h + BKTIndex.cpp:537-573)."""
+
+from sptag_tpu.core.params import BKTParams, KDTParams
+from sptag_tpu.core.types import DistCalcMethod
+
+
+def test_bkt_defaults_match_reference():
+    p = BKTParams()
+    assert p.get_param("BKTNumber") == "1"
+    assert p.get_param("BKTKmeansK") == "32"
+    assert p.get_param("BKTLeafSize") == "8"
+    assert p.get_param("Samples") == "1000"
+    assert p.get_param("TPTNumber") == "32"
+    assert p.get_param("TPTLeafSize") == "2000"
+    assert p.get_param("NeighborhoodSize") == "32"
+    assert p.get_param("GraphNeighborhoodScale") == "2"
+    assert p.get_param("CEF") == "1000"
+    assert p.get_param("AddCEF") == "500"
+    assert p.get_param("MaxCheckForRefineGraph") == "8192"
+    assert p.get_param("DistCalcMethod") == "Cosine"
+    assert p.get_param("MaxCheck") == "8192"
+    assert p.get_param("NumberOfInitialDynamicPivots") == "50"
+    assert p.get_param("NumberOfOtherDynamicPivots") == "4"
+    assert p.get_param("DeletePercentageForRefine") == "0.4"
+    assert p.get_param("AddCountForRebuild") == "1000"
+    assert (p.get_param("ThresholdOfNumberOfContinuousNoBetterPropagation")
+            == "3")
+    assert p.get_param("TreeFilePath") == "tree.bin"
+
+
+def test_kdt_defaults_match_reference():
+    p = KDTParams()
+    assert p.get_param("KDTNumber") == "1"
+    assert p.get_param("NumTopDimensionKDTSplit") == "5"
+    assert p.get_param("Samples") == "100"
+    assert p.get_param("NumTopDimensionTPTSplit") == "5"
+
+
+def test_set_param_case_insensitive_and_typed():
+    p = BKTParams()
+    assert p.set_param("maxcheck", "2048")
+    assert p.max_check == 2048
+    assert p.set_param("DistCalcMethod", "L2")
+    assert p.dist_calc_method == DistCalcMethod.L2
+    assert p.get_param("DistCalcMethod") == "L2"
+    assert not p.set_param("NoSuchParam", "1")
+    assert p.get_param("NoSuchParam") is None
+
+
+def test_save_config_round_trip():
+    p = BKTParams()
+    p.set_param("MaxCheck", "4096")
+    text = p.save_config()
+    assert "MaxCheck=4096" in text
+    q = BKTParams()
+    section = dict(line.split("=", 1) for line in text.strip().splitlines())
+    q.load_config(section)
+    assert q.max_check == 4096
+    assert q.save_config() == text
