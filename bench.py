@@ -1,0 +1,121 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Mirrors the reference's IndexSearcher harness semantics
+(/root/reference/AnnService/src/IndexSearcher/main.cpp:66-228): recall@10 =
+|top10 ∩ truth|/10 averaged over queries, latency percentiles over per-batch
+wall time.  Dataset: synthetic SIFT-like corpus (float32 d=128, L2) because
+the environment has no network egress for the real SIFT1M.
+
+Metric: QPS/chip at recall@10 on the graph index (BKT when available, FLAT
+exact otherwise).  vs_baseline = TPU QPS / single-core numpy brute-force QPS
+measured in-process (BASELINE.md: the reference publishes no numbers, so the
+baseline is a measured CPU reference; numpy's BLAS matmul here is the stand-in
+for the reference's AVX2 DistanceUtils loop).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_dataset(n=200_000, d=128, nq=1000, seed=7):
+    rng = np.random.default_rng(seed)
+    # clustered corpus (SIFT-like structure rather than pure noise)
+    n_clusters = 256
+    centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 4.0
+    assign = rng.integers(0, n_clusters, n)
+    data = centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
+    queries = (centers[rng.integers(0, n_clusters, nq)]
+               + rng.standard_normal((nq, d)).astype(np.float32))
+    return data, queries
+
+
+def cpu_brute_force_qps(data, queries, k=10, sample=50):
+    """Single-core numpy brute force — the measured CPU baseline."""
+    qs = queries[:sample]
+    t0 = time.perf_counter()
+    dn = (data.astype(np.float32) ** 2).sum(1)
+    d = dn[None, :] - 2.0 * (qs @ data.T)
+    idx = np.argpartition(d, k, axis=1)[:, :k]
+    rows = np.take_along_axis(d, idx, axis=1)
+    order = np.argsort(rows, axis=1)
+    truth = np.take_along_axis(idx, order, axis=1)
+    dt = time.perf_counter() - t0
+    return sample / dt, truth
+
+
+def main():
+    import sptag_tpu as sp
+    from sptag_tpu.ops import distance as dist_ops
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    data, queries = make_dataset(n=n)
+    k = 10
+
+    # ground truth + CPU baseline timing from the same computation path
+    cpu_qps, _ = cpu_brute_force_qps(data, queries, k=k, sample=50)
+
+    # full ground truth for recall (chunked numpy, exact)
+    truth = np.zeros((len(queries), k), np.int64)
+    dn = (data.astype(np.float32) ** 2).sum(1)
+    for i in range(0, len(queries), 200):
+        qs = queries[i:i + 200]
+        d = dn[None, :] - 2.0 * (qs @ data.T)
+        idx = np.argpartition(d, k, axis=1)[:, :k]
+        rows = np.take_along_axis(d, idx, axis=1)
+        order = np.argsort(rows, axis=1)
+        truth[i:i + 200] = np.take_along_axis(idx, order, axis=1)
+
+    # ---- TPU index ----
+    algo = "BKT"
+    try:
+        index = sp.create_instance(algo, "Float")
+    except ValueError:
+        algo = "FLAT"
+        index = sp.create_instance(algo, "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    if algo == "BKT":
+        index.set_parameter("MaxCheck", "2048")
+    t_build0 = time.perf_counter()
+    index.build(data)
+    build_s = time.perf_counter() - t_build0
+
+    batch = 256
+    # warm up / compile
+    index.search_batch(queries[:batch], k)
+
+    # timed sweep
+    ids_all = np.zeros((len(queries), k), np.int64)
+    nq = (len(queries) // batch) * batch
+    batch_times = []
+    t0 = time.perf_counter()
+    for i in range(0, nq, batch):
+        tb = time.perf_counter()
+        _, ids = index.search_batch(queries[i:i + batch], k)
+        batch_times.append(time.perf_counter() - tb)
+        ids_all[i:i + batch] = ids
+    dt = time.perf_counter() - t0
+    qps = nq / dt
+
+    recall = float(np.mean([
+        len(set(ids_all[i]) & set(truth[i])) / k for i in range(nq)]))
+
+    result = {
+        "metric": f"qps_per_chip_{algo.lower()}_n{n}_d128_l2_recall@10",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 2),
+        "recall_at_10": round(recall, 4),
+        "cpu_baseline_qps": round(cpu_qps, 1),
+        "p50_batch_ms": round(float(np.percentile(batch_times, 50)) * 1000, 2),
+        "p99_batch_ms": round(float(np.percentile(batch_times, 99)) * 1000, 2),
+        "build_s": round(build_s, 1),
+        "batch": batch,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,20 +35,17 @@ from sptag_tpu.core.types import (
 )
 from sptag_tpu.io import format as fmt
 from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.utils import round_up
 
 _ROW_PAD = 128      # pad corpus rows to multiples of this (TPU lane width)
 _QUERY_BUCKETS = (1, 8, 32, 128, 512)
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
 
 
 def _query_bucket(q: int) -> int:
     for b in _QUERY_BUCKETS:
         if q <= b:
             return b
-    return _round_up(q, _QUERY_BUCKETS[-1])
+    return round_up(q, _QUERY_BUCKETS[-1])
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "base"))
@@ -150,7 +147,7 @@ class FlatIndex(VectorIndex):
         with self._lock:
             if not self._dirty and self._device is not None:
                 return self._device
-            n_pad = max(_ROW_PAD, _round_up(self._n, _ROW_PAD))
+            n_pad = max(_ROW_PAD, round_up(self._n, _ROW_PAD))
             dt = dtype_of(self.value_type)
             data = np.zeros((n_pad, self.feature_dim), dtype=dt)
             data[:self._n] = self._host[:self._n]

@@ -316,8 +316,9 @@ class VectorIndex(abc.ABC):
 
     def merge_index(self, other: "VectorIndex") -> ErrorCode:
         """Parity: VectorIndex::MergeIndex re-add loop (VectorIndex.cpp:246-268)."""
-        if (other.value_type != self.value_type
-                or other.feature_dim != self.feature_dim):
+        if other.value_type != self.value_type:
+            return ErrorCode.Fail
+        if self.num_samples > 0 and other.feature_dim != self.feature_dim:
             return ErrorCode.Fail
         keep = [i for i in range(other.num_samples) if other.contains_sample(i)]
         if not keep:

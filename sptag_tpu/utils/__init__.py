@@ -1,0 +1,3 @@
+def round_up(n: int, m: int) -> int:
+    """Round n up to the next multiple of m."""
+    return ((n + m - 1) // m) * m

@@ -1,0 +1,62 @@
+"""Multi-shard search tests on the 8-device virtual CPU mesh — the
+aggregator-equivalent scatter/gather (SURVEY.md §2c) as one program."""
+
+import numpy as np
+
+import jax
+
+from sptag_tpu.core.types import DistCalcMethod
+from sptag_tpu.parallel.sharded import ShardedFlatIndex, make_mesh
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_matches_single_device_exact():
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((1000, 32)).astype(np.float32)
+    queries = rng.standard_normal((16, 32)).astype(np.float32)
+
+    idx = ShardedFlatIndex(data, DistCalcMethod.L2, base=1)
+    dists, ids = idx.search(queries, k=10)
+
+    # brute force truth
+    d = ((queries[:, None, :] - data[None, :, :]) ** 2).sum(-1)
+    truth_ids = np.argsort(d, axis=1)[:, :10]
+    truth_d = np.sort(d, axis=1)[:, :10]
+
+    np.testing.assert_allclose(dists, truth_d, rtol=1e-4, atol=1e-4)
+    # ids match except possible ties
+    agree = (ids == truth_ids).mean()
+    assert agree > 0.95
+
+
+def test_sharded_respects_deletes():
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((200, 16)).astype(np.float32)
+    deleted = np.zeros(200, bool)
+    deleted[7] = True
+    idx = ShardedFlatIndex(data, DistCalcMethod.L2, base=1, deleted=deleted)
+    _, ids = idx.search(data[7:8], k=5)
+    assert 7 not in ids[0]
+
+
+def test_sharded_cosine():
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((512, 24)).astype(np.float32)
+    data /= np.linalg.norm(data, axis=1, keepdims=True)
+    idx = ShardedFlatIndex(data, DistCalcMethod.Cosine, base=1)
+    dists, ids = idx.search(data[:4], k=1)
+    np.testing.assert_array_equal(ids[:, 0], np.arange(4))
+    np.testing.assert_allclose(dists[:, 0], 0.0, atol=1e-5)
+
+
+def test_explicit_submesh():
+    devs = jax.devices()[:4]
+    mesh = make_mesh(devs)
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((100, 8)).astype(np.float32)
+    idx = ShardedFlatIndex(data, DistCalcMethod.L2, base=1, mesh=mesh)
+    _, ids = idx.search(data[:3], k=1)
+    np.testing.assert_array_equal(ids[:, 0], np.arange(3))
