@@ -32,18 +32,26 @@ def make_dataset(n=200_000, d=128, nq=1000, seed=7):
     return data, queries
 
 
-def cpu_brute_force_qps(data, queries, k=10, sample=50):
-    """Single-core numpy brute force — the measured CPU baseline."""
-    qs = queries[:sample]
-    t0 = time.perf_counter()
-    dn = (data.astype(np.float32) ** 2).sum(1)
+def exact_topk(data, dn, qs, k):
+    """Exact top-k via expanded-form distances (shared by the CPU-baseline
+    timing and the ground-truth computation)."""
     d = dn[None, :] - 2.0 * (qs @ data.T)
     idx = np.argpartition(d, k, axis=1)[:, :k]
     rows = np.take_along_axis(d, idx, axis=1)
     order = np.argsort(rows, axis=1)
-    truth = np.take_along_axis(idx, order, axis=1)
+    return np.take_along_axis(idx, order, axis=1)
+
+
+def cpu_brute_force_qps(data, queries, k=10, sample=50):
+    """Numpy brute force — the measured CPU baseline (BLAS matmul stands in
+    for the reference's AVX2 DistanceUtils loop; uses however many threads
+    the host BLAS is configured with — reported as-is, not per-core)."""
+    qs = queries[:sample]
+    dn = (data ** 2).sum(1)          # corpus norms precomputed outside timing
+    t0 = time.perf_counter()
+    exact_topk(data, dn, qs, k)
     dt = time.perf_counter() - t0
-    return sample / dt, truth
+    return sample / dt
 
 
 def main():
@@ -54,19 +62,12 @@ def main():
     data, queries = make_dataset(n=n)
     k = 10
 
-    # ground truth + CPU baseline timing from the same computation path
-    cpu_qps, _ = cpu_brute_force_qps(data, queries, k=k, sample=50)
-
-    # full ground truth for recall (chunked numpy, exact)
+    # CPU baseline timing + full ground truth from the same code path
+    cpu_qps = cpu_brute_force_qps(data, queries, k=k, sample=50)
     truth = np.zeros((len(queries), k), np.int64)
-    dn = (data.astype(np.float32) ** 2).sum(1)
+    dn = (data ** 2).sum(1)
     for i in range(0, len(queries), 200):
-        qs = queries[i:i + 200]
-        d = dn[None, :] - 2.0 * (qs @ data.T)
-        idx = np.argpartition(d, k, axis=1)[:, :k]
-        rows = np.take_along_axis(d, idx, axis=1)
-        order = np.argsort(rows, axis=1)
-        truth[i:i + 200] = np.take_along_axis(idx, order, axis=1)
+        truth[i:i + 200] = exact_topk(data, dn, queries[i:i + 200], k)
 
     # ---- TPU index ----
     algo = "BKT"
@@ -86,18 +87,22 @@ def main():
     # warm up / compile
     index.search_batch(queries[:batch], k)
 
-    # timed sweep
-    ids_all = np.zeros((len(queries), k), np.int64)
-    nq = (len(queries) // batch) * batch
+    # timed sweep over ALL queries (tail batch included); repeated passes so
+    # the latency percentiles have enough samples to mean something
+    nq = len(queries)
+    repeats = 3
+    ids_all = np.zeros((nq, k), np.int64)
     batch_times = []
     t0 = time.perf_counter()
-    for i in range(0, nq, batch):
-        tb = time.perf_counter()
-        _, ids = index.search_batch(queries[i:i + batch], k)
-        batch_times.append(time.perf_counter() - tb)
-        ids_all[i:i + batch] = ids
+    for r in range(repeats):
+        for i in range(0, nq, batch):
+            tb = time.perf_counter()
+            _, ids = index.search_batch(queries[i:i + batch], k)
+            batch_times.append(time.perf_counter() - tb)
+            if r == 0:
+                ids_all[i:i + batch] = ids
     dt = time.perf_counter() - t0
-    qps = nq / dt
+    qps = nq * repeats / dt
 
     recall = float(np.mean([
         len(set(ids_all[i]) & set(truth[i])) / k for i in range(nq)]))

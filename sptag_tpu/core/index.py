@@ -318,6 +318,10 @@ class VectorIndex(abc.ABC):
         """Parity: VectorIndex::MergeIndex re-add loop (VectorIndex.cpp:246-268)."""
         if other.value_type != self.value_type:
             return ErrorCode.Fail
+        if other.dist_calc_method != self.dist_calc_method:
+            # rows below are taken as-is from the source index; they are only
+            # valid under the same metric (cosine rows are pre-normalized)
+            return ErrorCode.Fail
         if self.num_samples > 0 and other.feature_dim != self.feature_dim:
             return ErrorCode.Fail
         keep = [i for i in range(other.num_samples) if other.contains_sample(i)]
