@@ -25,6 +25,8 @@ from sptag_tpu.core.index import VectorIndex, create_instance, load_index
 
 # Importing algo modules registers them with the factory.
 import sptag_tpu.algo.flat  # noqa: F401  (IndexAlgoType.FLAT)
+import sptag_tpu.algo.bkt   # noqa: F401  (IndexAlgoType.BKT)
+import sptag_tpu.algo.kdt   # noqa: F401  (IndexAlgoType.KDT)
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,431 @@
+"""BKT index — balanced k-means tree forest + RNG graph + beam search.
+
+Parity: BKT::Index<T> (/root/reference/AnnService/inc/Core/BKT/Index.h:37-161,
+src/Core/BKT/BKTIndex.cpp): composition of {Dataset, BKTree, RNG graph,
+Labelset, WorkSpacePool} with
+
+* BuildIndex (BKTIndex.cpp:279-306): normalize (cosine), build tree forest,
+  build + refine graph;
+* SearchIndex (:216-264): tree-seeded budgeted best-first walk — here the
+  batched beam engine (algo/engine.py);
+* AddIndex (:462-529): append rows, link each new node into the graph via an
+  AddCEF-budget search + RNG prune, insert reverse edges, and rebuild the
+  tree forest after `AddCountForRebuild` appends (the reference queues an
+  async RebuildJob on a thread pool, BKTIndex.cpp:39-49; here the rebuild is
+  a synchronous snapshot swap under the writer lock — single-writer design,
+  SURVEY.md §2b P4/P7);
+* DeleteIndex / RefineIndex (:308-453): tombstones + compaction that remaps
+  the graph and rebuilds tree + refine pass.
+
+Duplicate-center semantics: the reference excludes duplicate points from the
+graph and chases them through the tree's sample-center map at search time
+(BKTree.h:184-205, BKTIndex.cpp:120-138).  Here every row — duplicates
+included — is a TPT-leaf member and therefore a graph node, so duplicates are
+reachable through the graph itself and no chase is needed; the map is still
+built and persisted for tree-format compatibility.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sptag_tpu.algo.dense import DenseTreeSearcher, partition_from_tree
+from sptag_tpu.algo.engine import GraphSearchEngine
+from sptag_tpu.core.index import MAX_DIST, VectorIndex, register_algo
+from sptag_tpu.core.params import BKTParams
+from sptag_tpu.core.types import IndexAlgoType, VectorValueType, dtype_of
+from sptag_tpu.graph.rng import RelativeNeighborhoodGraph
+from sptag_tpu.io import format as fmt
+from sptag_tpu.trees.bktree import BKTree
+
+log = logging.getLogger(__name__)
+
+
+@register_algo
+class BKTIndex(VectorIndex):
+    algo = IndexAlgoType.BKT
+
+    def __init__(self, value_type: VectorValueType):
+        super().__init__(value_type)
+        self._host: Optional[np.ndarray] = None
+        self._n = 0
+        self._deleted = np.zeros(0, bool)
+        self._num_deleted = 0
+        self._tree: Optional[BKTree] = None
+        self._graph: Optional[RelativeNeighborhoodGraph] = None
+        self._engine: Optional[GraphSearchEngine] = None
+        self._dense: Optional[DenseTreeSearcher] = None
+        self._dirty = True
+        self._tombstones_dirty = False
+        self._adds_since_rebuild = 0
+
+    def _make_params(self) -> BKTParams:
+        return BKTParams()
+
+    # ---- storage ----------------------------------------------------------
+
+    @property
+    def num_samples(self) -> int:
+        return self._n
+
+    @property
+    def num_deleted(self) -> int:
+        return self._num_deleted
+
+    @property
+    def feature_dim(self) -> int:
+        return 0 if self._host is None else self._host.shape[1]
+
+    def contains_sample(self, vid: int) -> bool:
+        return 0 <= vid < self._n and not self._deleted[vid]
+
+    def get_sample(self, vid: int) -> np.ndarray:
+        return self._host[vid]
+
+    def _reserve(self, extra: int) -> None:
+        need = self._n + extra
+        cap = self._host.shape[0]
+        if need > cap:
+            new_cap = max(need, cap * 2, 1024)
+            grown = np.empty((new_cap, self._host.shape[1]), self._host.dtype)
+            grown[:self._n] = self._host[:self._n]
+            self._host = grown
+            dels = np.zeros(new_cap, bool)
+            dels[:self._n] = self._deleted[:self._n]
+            self._deleted = dels
+
+    # ---- component factories ----------------------------------------------
+
+    def _new_tree(self) -> BKTree:
+        p = self.params
+        return BKTree(tree_number=p.tree_number, kmeans_k=p.kmeans_k,
+                      leaf_size=p.leaf_size, samples=p.samples,
+                      metric=int(self.dist_calc_method), base=self.base)
+
+    def _load_tree(self, path: str) -> BKTree:
+        p = self.params
+        return BKTree.load(path, tree_number=p.tree_number,
+                           kmeans_k=p.kmeans_k, leaf_size=p.leaf_size,
+                           samples=p.samples,
+                           metric=int(self.dist_calc_method), base=self.base)
+
+    def _new_graph(self) -> RelativeNeighborhoodGraph:
+        p = self.params
+        return RelativeNeighborhoodGraph(
+            neighborhood_size=p.neighborhood_size, tpt_number=p.tpt_number,
+            tpt_leaf_size=p.tpt_leaf_size,
+            neighborhood_scale=p.neighborhood_scale, cef_scale=p.cef_scale,
+            refine_iterations=p.refine_iterations, cef=p.cef,
+            tpt_top_dims=p.tpt_top_dims, tpt_samples=p.samples)
+
+    def _pivot_ids(self) -> np.ndarray:
+        p = self.params
+        max_pivots = min(self._n, max(64, p.initial_dynamic_pivots * 32))
+        return self._tree.collect_pivots(max_pivots)
+
+    def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:
+        return GraphSearchEngine(self._host[:self._n], graph,
+                                 self._pivot_ids(), self._deleted[:self._n],
+                                 self.dist_calc_method, self.base)
+
+    def _get_engine(self) -> GraphSearchEngine:
+        if self._dirty or self._engine is None:
+            with self._lock:
+                if self._dirty or self._engine is None:
+                    self._engine = self._make_engine(self._graph.graph)
+                    self._dense = None
+                    self._dirty = False
+                    self._tombstones_dirty = False
+        elif self._tombstones_dirty:
+            # delete-only change: swap the mask, keep the snapshots
+            with self._lock:
+                if self._tombstones_dirty:
+                    self._engine.set_deleted(self._deleted)
+                    if self._dense is not None:
+                        self._dense.set_deleted(self._deleted)
+                    self._tombstones_dirty = False
+        return self._engine
+
+    def _get_dense(self) -> DenseTreeSearcher:
+        """Lazy cluster-contiguous snapshot for the dense search mode.
+
+        Rows appended after the last tree rebuild are not under any tree
+        node yet; they are assigned to their nearest cut-center cluster so
+        the partition always covers the whole corpus.
+        """
+        self._get_engine()          # refresh dirty state under one lock
+        if self._dense is None:
+            with self._lock:
+                if self._dense is None:
+                    data = self._host[:self._n]
+                    centers, clusters = partition_from_tree(
+                        self._tree, self._n,
+                        self.params.dense_cluster_size)
+                    covered = np.zeros(self._n, bool)
+                    for c in clusters:
+                        covered[c] = True
+                    missing = np.flatnonzero(~covered)
+                    if len(missing):
+                        import jax.numpy as jnp
+
+                        from sptag_tpu.ops import distance as dist_ops
+                        d = np.asarray(dist_ops.pairwise_distance(
+                            jnp.asarray(data[missing]),
+                            jnp.asarray(data[centers]),
+                            self.dist_calc_method))
+                        owner = d.argmin(axis=1)
+                        for ci in range(len(clusters)):
+                            extra = missing[owner == ci]
+                            if len(extra):
+                                clusters[ci] = np.concatenate(
+                                    [clusters[ci], extra])
+                    self._dense = DenseTreeSearcher(
+                        data, centers, clusters, self._deleted[:self._n],
+                        self.dist_calc_method, self.base)
+        return self._dense
+
+    # ---- build ------------------------------------------------------------
+
+    def _build(self, data: np.ndarray) -> None:
+        self._host = np.ascontiguousarray(data)
+        self._n = data.shape[0]
+        self._deleted = np.zeros(self._n, bool)
+        self._num_deleted = 0
+        self._adds_since_rebuild = 0
+
+        self._tree = self._new_tree()
+        self._tree.build(self._host[:self._n])
+        log.info("BKT forest built: %d nodes", self._tree.num_nodes)
+
+        self._graph = self._new_graph()
+        self._graph.build(self._host[:self._n], int(self.dist_calc_method),
+                          self.base, self._refine_search_factory)
+        self._dirty = True
+
+    def _refine_search_factory(self, graph: np.ndarray):
+        """SearchFn over a mid-build graph snapshot, at the refine budget
+        (MaxCheckForRefineGraph — reference RefineSearchIndex,
+        BKTIndex.cpp:266-276)."""
+        engine = self._make_engine(graph)
+        budget = self.params.max_check_for_refine_graph
+
+        def search(queries: np.ndarray, k: int):
+            return engine.search(
+                queries, k, max_check=budget,
+                pool_size=max(2 * k, 64),
+                nbp_limit=self.params.no_better_propagation_limit)
+        return search
+
+    # ---- search -----------------------------------------------------------
+
+    def _search_batch(self, queries: np.ndarray,
+                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._n == 0:
+            raise RuntimeError("index is empty")
+        p = self.params
+        if getattr(p, "search_mode", "beam") == "dense":
+            d, ids = self._get_dense().search(
+                queries, min(k, self._n), max_check=p.max_check)
+        else:
+            d, ids = self._get_engine().search(
+                queries, min(k, self._n), max_check=p.max_check,
+                nbp_limit=p.no_better_propagation_limit)
+        if ids.shape[1] < k:
+            q = ids.shape[0]
+            d = np.concatenate(
+                [d, np.full((q, k - d.shape[1]), MAX_DIST, np.float32)], 1)
+            ids = np.concatenate(
+                [ids, np.full((q, k - ids.shape[1]), -1, np.int32)], 1)
+        return d, ids
+
+    # ---- mutation ---------------------------------------------------------
+
+    def _add(self, data: np.ndarray) -> int:
+        begin = self._n
+        count = data.shape[0]
+        engine = self._get_engine()   # snapshot BEFORE the rows land
+        self._reserve(count)
+        self._host[begin:begin + count] = data
+        self._n += count
+
+        self._link_new_rows(engine, begin, count)
+        self._adds_since_rebuild += count
+        if self._adds_since_rebuild >= self.params.add_count_for_rebuild:
+            # reference queues an async RebuildJob (BKTIndex.cpp:39-49);
+            # here: synchronous forest rebuild + snapshot swap
+            self._tree = self._new_tree()
+            self._tree.build(self._host[:self._n])
+            self._adds_since_rebuild = 0
+        self._dirty = True
+        return begin
+
+    def _link_new_rows(self, engine: GraphSearchEngine, begin: int,
+                       count: int) -> None:
+        """Wire `count` appended rows into the RNG graph.
+
+        Parity: the AddIndex tail (BKTIndex.cpp:523-526): per new node, an
+        AddCEF-budget search + RebuildNeighbors for its own row, then
+        InsertNeighbors for the reverse edges.  The searches for a whole
+        added batch run as ONE device batch against the pre-add snapshot.
+        """
+        p = self.params
+        m = p.neighborhood_size
+        new_rows = np.full((count, self._graph.graph.shape[1]), -1, np.int32)
+        grown = np.concatenate([self._graph.graph, new_rows], axis=0)
+
+        add_k = min(p.add_cef + 1, max(begin, 1))
+        queries = self._host[begin:begin + count]
+        d, ids = engine.search(
+            queries, add_k, max_check=p.max_check_for_refine_graph,
+            nbp_limit=p.no_better_propagation_limit)
+
+        from sptag_tpu.ops import graph as graph_ops
+        import jax.numpy as jnp
+        vecs = self._host[np.maximum(ids, 0)].astype(np.float32)
+        keep = np.asarray(graph_ops.rng_select(
+            jnp.asarray(queries.astype(np.float32)), jnp.asarray(vecs),
+            jnp.asarray(d), jnp.asarray(ids >= 0), m,
+            int(self.dist_calc_method), self.base))
+        sel = np.where(keep >= 0,
+                       np.take_along_axis(ids, np.maximum(keep, 0), axis=1),
+                       -1)
+        grown[begin:begin + count, :m] = sel
+
+        # reverse edges, one host insertion per (neighbor, new) pair
+        for i in range(count):
+            vid = begin + i
+            for j in range(m):
+                g = int(sel[i, j])
+                if g < 0:
+                    break
+                self._insert_neighbor(grown, g, vid,
+                                      float(d[i, int(keep[i, j])]))
+        self._graph.graph = grown
+
+    def _insert_neighbor(self, graph: np.ndarray, node: int, insert_id: int,
+                         insert_dist: float) -> None:
+        """Parity: RelativeNeighborhoodGraph::InsertNeighbors
+        (RelativeNeighborhoodGraph.h:37-71): keep `node`'s row distance-
+        sorted, reject an insert occluded by an earlier neighbor, shift the
+        tail while each shifted neighbor stays non-occluded by the insert."""
+        row = graph[node]
+        m = len(row)
+        nv = self._host[node].astype(np.float32)
+        iv = self._host[insert_id].astype(np.float32)
+        for k in range(m):
+            tmp = int(row[k])
+            if tmp == insert_id:
+                return
+            if tmp < 0:
+                row[k] = insert_id
+                return
+            tmp_dist = self._row_dist(nv, self._host[tmp])
+            if tmp_dist > insert_dist or (tmp_dist == insert_dist
+                                          and insert_id < tmp):
+                for t in range(k):
+                    if self._row_dist(iv, self._host[int(row[t])]) \
+                            < insert_dist:
+                        return
+                carry = tmp
+                row[k] = insert_id
+                kk = k
+                while carry >= 0 and kk + 1 < m:
+                    kk += 1
+                    if self._row_dist(self._host[carry].astype(np.float32),
+                                      self._host[insert_id]) < \
+                            self._row_dist(nv, self._host[carry]):
+                        break
+                    carry, row[kk] = int(row[kk]), carry
+                return
+
+    def _row_dist(self, a, b) -> float:
+        """Host scalar distance matching the device convention."""
+        af = np.asarray(a, np.float32)
+        bf = np.asarray(b, np.float32)
+        if int(self.dist_calc_method) == 1:
+            return float(self.base) * float(self.base) - float(af @ bf)
+        diff = af - bf
+        return float(diff @ diff)
+
+    def _delete_id(self, vid: int) -> bool:
+        if self._deleted[vid]:
+            return False
+        self._deleted[vid] = True
+        self._num_deleted += 1
+        # tombstones ride a cheap mask swap, not a snapshot rebuild
+        self._tombstones_dirty = True
+        return True
+
+    # ---- refine (compaction) ----------------------------------------------
+
+    def _refine_impl(self) -> None:
+        """Parity: BKT::RefineIndex (BKTIndex.cpp:308-398): drop tombstoned
+        rows, remap ids, rebuild the tree forest, re-run one graph refine
+        pass over the compacted corpus."""
+        keep = np.flatnonzero(~self._deleted[:self._n])
+        remap = np.full(self._n, -1, np.int64)
+        remap[keep] = np.arange(len(keep))
+
+        self._host = np.ascontiguousarray(self._host[keep])
+        old_graph = self._graph.graph
+        g = old_graph[keep]
+        g = np.where(g >= 0, remap[np.maximum(g, 0)], -1).astype(np.int32)
+        # compact each row's surviving neighbors to the front
+        order = np.argsort(g < 0, axis=1, kind="stable")
+        g = np.take_along_axis(g, order, axis=1)
+        self._graph.graph = g
+
+        self._n = len(keep)
+        self._deleted = np.zeros(self._n, bool)
+        self._num_deleted = 0
+        if self.metadata is not None:
+            self.metadata = self.metadata.refine(keep.tolist())
+        if self._meta_to_vec is not None:
+            self.build_meta_mapping()
+
+        self._tree = self._new_tree()
+        self._tree.build(self._host[:self._n])
+        self._graph.refine_once(
+            self._host[:self._n],
+            self._refine_search_factory(self._graph.graph),
+            self._graph.neighborhood_size, int(self.dist_calc_method),
+            self.base)
+        self._adds_since_rebuild = 0
+        self._dirty = True
+
+    # ---- persistence ------------------------------------------------------
+
+    def _save_index_data(self, folder: str) -> None:
+        p = self.params
+        fmt.write_matrix(os.path.join(folder, p.vector_file),
+                         self._host[:self._n])
+        self._tree.save(os.path.join(folder, p.tree_file))
+        fmt.write_graph(os.path.join(folder, p.graph_file),
+                        self._graph.graph)
+        fmt.write_deletes(os.path.join(folder, p.delete_file),
+                          self._deleted[:self._n])
+
+    def _load_index_data(self, folder: str) -> None:
+        p = self.params
+        data = fmt.read_matrix(os.path.join(folder, p.vector_file),
+                               dtype_of(self.value_type))
+        self._host = np.ascontiguousarray(data)
+        self._n = data.shape[0]
+        self._deleted = np.zeros(self._n, bool)
+        self._num_deleted = 0
+        delete_path = os.path.join(folder, p.delete_file)
+        if os.path.exists(delete_path):
+            mask = fmt.read_deletes(delete_path)
+            self._deleted[:len(mask)] = mask[:self._n]
+            self._num_deleted = int(self._deleted.sum())
+        self._tree = self._load_tree(os.path.join(folder, p.tree_file))
+        self._graph = self._new_graph()
+        self._graph.graph = fmt.read_graph(
+            os.path.join(folder, p.graph_file))
+        self._graph.neighborhood_size = self._graph.graph.shape[1]
+        self._adds_since_rebuild = 0
+        self._dirty = True

@@ -1,0 +1,218 @@
+"""Dense tree-partition search — the MXU-native fast path for BKT.
+
+The reference's only search strategy is the budgeted best-first graph walk
+(§3.2, BKTIndex.cpp:105-157), whose serial, gather-per-step shape is hostile
+to a systolic-array machine even after batching (algo/engine.py).  This
+module adds the TPU-first alternative the hardware actually wants, built
+from the SAME balanced-k-means tree:
+
+* a **cut** through the BKT forest's first tree (every subtree at the cut
+  holds ≈ DenseClusterSize samples — near-uniform BECAUSE the reference's
+  k-means is count-balanced, BKTree.h:329,346) defines a partition of the
+  corpus;
+* corpus rows are re-laid out cluster-contiguously as one (C, P, D) block
+  (P = padded cluster size), so "fetch a cluster" is a single contiguous
+  block read instead of P scattered row gathers;
+* a query batch scores all cut-node centers with ONE (Q, C) matmul (these
+  centers are the tree's real medoid samples — the same pivots the walk
+  seeds from), picks the top `nprobe = ceil(MaxCheck / P)` clusters, block-
+  gathers them, and scores all Q x nprobe x P candidates as one batched
+  contraction + `lax.top_k`.
+
+`MaxCheck` keeps its reference meaning — the number of candidates scored per
+query — so the recall/latency knob transfers unchanged.  Tombstones are
+masked in the final top-k exactly like the other TPU paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sptag_tpu.core.types import DistCalcMethod
+from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.utils import query_bucket, round_up
+
+MAX_DIST = jnp.float32(3.4e38)
+
+# score-buffer budget per kernel call (bytes): Q * nprobe * P * D * 4
+_GATHER_BUDGET = 1 << 28
+
+
+def partition_from_tree(tree, n: int, target_size: int
+                        ) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Cut the first BKT tree into subtrees of <= target_size samples.
+
+    Returns (cut-node center sample ids (C,), list of C member id arrays —
+    every sample id in [0, n) appears in exactly one cluster; each cluster's
+    center sample is a member of that cluster).
+    """
+    nodes = tree.nodes
+    cid = nodes["centerid"].astype(np.int64)
+    cs = nodes["childStart"].astype(np.int64)
+    ce = nodes["childEnd"].astype(np.int64)
+    start = int(tree.tree_starts[0])
+    end = int(tree.tree_starts[1]) if len(tree.tree_starts) > 1 \
+        else len(nodes)
+
+    def children(ni: int) -> range:
+        # leaf: cs == -1 and ce <= 0; degenerate duplicate node stores a
+        # negated childStart (bktree.py loader disambiguation)
+        if cs[ni] >= 0:
+            return range(int(cs[ni]), int(ce[ni]))
+        if cs[ni] < -1 or (cs[ni] == -1 and ce[ni] > 0):
+            return range(int(-cs[ni]), int(ce[ni]))
+        return range(0)
+
+    def sample_of(ni: int) -> int:
+        # the ROOT's centerid is the build-time sample count, not a sample
+        # (reference BKTree.h:168); after online adds grow n past it, that
+        # sentinel would masquerade as a real id without this check
+        if ni == start:
+            return -1
+        c = int(cid[ni])
+        return c if 0 <= c < n else -1
+
+    # bottom-up subtree sample counts (children are appended after parents,
+    # so a reverse scan sees children before parents)
+    counts = np.zeros(end - start, np.int64)
+    for ni in range(end - 1, start - 1, -1):
+        c = 1 if sample_of(ni) >= 0 else 0
+        for ch in children(ni):
+            c += counts[ch - start]
+        counts[ni - start] = c
+
+    # top-down BFS: emit a node as a cluster root once its subtree fits
+    roots: List[int] = []
+    loose: List[int] = []          # interior-node center samples above cuts
+    frontier = [start]
+    while frontier:
+        nxt: List[int] = []
+        for ni in frontier:
+            if counts[ni - start] == 0:
+                continue
+            kids = children(ni)
+            if counts[ni - start] <= target_size or len(kids) == 0:
+                roots.append(ni)
+            else:
+                nxt.extend(kids)
+                if sample_of(ni) >= 0:
+                    loose.append(sample_of(ni))
+        frontier = nxt
+
+    clusters: List[np.ndarray] = []
+    centers: List[int] = []
+    for r in roots:
+        members: List[int] = []
+        stack = [r]
+        while stack:
+            ni = stack.pop()
+            if sample_of(ni) >= 0:
+                members.append(sample_of(ni))
+            stack.extend(children(ni))
+        if members:
+            clusters.append(np.asarray(members, np.int64))
+            centers.append(sample_of(r) if sample_of(r) >= 0 else members[0])
+    # center samples of nodes above the cut join the smallest cluster (keeps
+    # sizes balanced; they are close to several clusters by construction)
+    for s in loose:
+        smallest = min(range(len(clusters)), key=lambda i: len(clusters[i]))
+        clusters[smallest] = np.append(clusters[smallest], s)
+    return np.asarray(centers, np.int64), clusters
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "metric", "base"))
+def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
+                        cent_sq, deleted, queries, k: int, nprobe: int,
+                        metric: int, base: int):
+    """One program: (Q,C) center scores -> top-nprobe block gather ->
+    (Q, nprobe*P) candidate scores -> masked top-k."""
+    Q = queries.shape[0]
+    C, P, D = data_perm.shape
+    d0 = dist_ops.pairwise_distance(queries, centroids,
+                                    DistCalcMethod(metric), x_sqnorm=cent_sq)
+    _, topc = jax.lax.top_k(-d0, nprobe)                     # (Q, nprobe)
+    vecs = data_perm[topc].reshape(Q, nprobe * P, D)
+    ids = member_ids[topc].reshape(Q, nprobe * P)
+    sq = member_sq[topc].reshape(Q, nprobe * P)
+    nd = dist_ops.batched_gathered_distance(
+        queries, vecs, DistCalcMethod(metric), base, sq)
+    dead = deleted[jnp.maximum(ids, 0)] | (ids < 0)
+    nd = jnp.where(dead, MAX_DIST, nd)
+    k_eff = min(k, nprobe * P)
+    neg, pos = jax.lax.top_k(-nd, k_eff)
+    out_d = -neg
+    out_ids = jnp.take_along_axis(ids, pos, axis=1)
+    out_ids = jnp.where(out_d < MAX_DIST, out_ids, -1)
+    return out_d, out_ids.astype(jnp.int32)
+
+
+class DenseTreeSearcher:
+    """Immutable device snapshot of the cluster-contiguous layout."""
+
+    def __init__(self, data: np.ndarray, centers: np.ndarray,
+                 clusters: List[np.ndarray],
+                 deleted: Optional[np.ndarray],
+                 metric: DistCalcMethod, base: int):
+        self.metric = DistCalcMethod(metric)
+        self.base = base
+        self.n = data.shape[0]
+        C = len(clusters)
+        P = round_up(max(len(c) for c in clusters), 8)
+        D = data.shape[1]
+        perm = np.zeros((C, P, D), data.dtype)
+        mids = np.full((C, P), -1, np.int32)
+        for i, members in enumerate(clusters):
+            perm[i, :len(members)] = data[members]
+            mids[i, :len(members)] = members
+        self.cluster_size = P
+        self.num_clusters = C
+        self.data_perm = jnp.asarray(perm)
+        self.member_ids = jnp.asarray(mids)
+        sq = np.asarray(jax.jit(dist_ops.row_sqnorms)(
+            self.data_perm.reshape(C * P, D))).reshape(C, P)
+        # padding rows have sqnorm 0 == a real-looking vector; the id mask
+        # already excludes them from the top-k
+        self.member_sq = jnp.asarray(sq)
+        self.centroids = jnp.asarray(data[centers])
+        self.cent_sq = jax.jit(dist_ops.row_sqnorms)(self.centroids)
+        if deleted is None:
+            deleted = np.zeros(self.n, bool)
+        self.deleted = jnp.asarray(deleted[:self.n])
+
+    def set_deleted(self, deleted: np.ndarray) -> None:
+        """Swap only the tombstone mask (delete-only mutation path)."""
+        self.deleted = jnp.asarray(deleted[:self.n])
+
+    def search(self, queries: np.ndarray, k: int, max_check: int = 2048
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        nq, D = queries.shape
+        P = self.cluster_size
+        nprobe = int(np.clip(-(-max_check // P), 1, self.num_clusters))
+        k_eff = min(k, nprobe * P, self.n)
+
+        chunk = max(1, min(_GATHER_BUDGET // (nprobe * P * D * 4), 1024))
+        out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
+        out_i = np.full((nq, k), -1, np.int32)
+        for off in range(0, nq, chunk):
+            q = queries[off:off + chunk]
+            qn = q.shape[0]
+            q_pad = query_bucket(qn, chunk)
+            if q_pad != qn:
+                q = np.concatenate(
+                    [q, np.zeros((q_pad - qn, D), q.dtype)])
+            d, ids = _dense_search_kernel(
+                self.data_perm, self.member_ids, self.member_sq,
+                self.centroids, self.cent_sq, self.deleted, jnp.asarray(q),
+                k_eff, nprobe, int(self.metric), self.base)
+            out_d[off:off + qn, :d.shape[1]] = np.asarray(d)[:qn]
+            out_i[off:off + qn, :ids.shape[1]] = np.asarray(ids)[:qn]
+        return out_d, out_i

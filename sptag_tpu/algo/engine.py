@@ -1,0 +1,373 @@
+"""Batched beam-search engine — the TPU reshape of SPTAG's serving hot path.
+
+The reference search (/root/reference/AnnService/src/Core/BKT/
+BKTIndex.cpp:105-157) pops ONE frontier node at a time from a priority queue,
+scores its <=32 graph neighbors with scalar SIMD calls, and stops when the
+`MaxCheck` budget is spent or `ThresholdOfNumberOfContinuousNoBetterPropagation`
+consecutive pops fail to improve the top-K.  That data-dependent serial walk
+would leave the MXU idle; here it becomes a fixed-shape device loop
+(SURVEY.md §7):
+
+* a query BATCH (Q, D) runs as one compiled program — the batch dimension
+  replaces the reference's OpenMP-over-queries (VectorIndex.cpp:212-220);
+* tree seeding is one dense (Q, P) distance matrix against a pivot set
+  collected from the trees (replacing InitSearchTrees/SearchTrees,
+  BKTree.h:279-320) — the top-L pivots initialize the beam;
+* each iteration pops the best `B` unexpanded beam entries AT ONCE, gathers
+  their B*32 neighbors, dedupes against a per-query visited table, scores all
+  candidates as one batched contraction, and merges beam+candidates with
+  `lax.top_k` — `ceil(max_check / B)` iterations under `lax.while_loop`
+  preserve the MaxCheck budget semantics (each iteration expands B nodes, the
+  reference expands 1 per pop);
+* the no-better-propagation early exit carries over per query: a query whose
+  top-k worst distance fails to improve for `nbp_limit` consecutive
+  iterations stops expanding (each iteration aggregates B pops, so the limit
+  bites at comparable budget);
+* tombstoned rows (Labelset, reference Labelset.h) are traversed but filtered
+  from the final top-k (the reference filters in-loop, BKTIndex.cpp:234-239;
+  a masked dense top-k is the cheaper TPU equivalent).
+
+The visited structure is a per-query PACKED BITSET (Q, ceil((N+1)/32))
+int32 — the TPU replacement for the reference's OptHashPosVector
+open-addressing hash (WorkSpace.h:33-134).  Packing matters: a loop-carried
+array that is read and scatter-written every iteration gets double-buffered
+by XLA, so its size is pure copy cost per iteration — a boolean (Q, N) table
+at N=200k costs ~4ms/iter in copies; the packed table is 32x smaller.
+Setting bits without a scatter-OR primitive uses a sort + segmented
+associative OR-scan: candidate ids are sorted (the same sort also yields the
+intra-batch duplicate mask), runs of ids in the same word OR their bits
+together, and each run's last element scatter-writes `existing | run_or`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sptag_tpu.core.types import DistCalcMethod
+from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.utils import query_bucket
+
+MAX_DIST = jnp.float32(3.4e38)
+
+# visited-table memory budget per search call (bytes)
+_VISITED_BUDGET = 1 << 29
+
+
+def _scatter_true(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """arr (Q, W) bool; idx (Q, X) int in [0, W) -> set True, batched.
+    Only used for the small (Q, L+1) expanded flags — the big visited
+    structure is the packed bitset below."""
+    return jax.vmap(lambda a, i: a.at[i].set(True))(arr, idx)
+
+
+def _num_words(n: int) -> int:
+    """Packed-bitset word count covering ids [0, n] (id n is the dump id for
+    masked candidates: its bit lands in a real word but no real id owns it)."""
+    return (n + 1 + 31) // 32
+
+
+def _test_bits(words: jax.Array, ids: jax.Array) -> jax.Array:
+    """words (Q, W) int32 bitset; ids (Q, X) in [0, 32W) -> (Q, X) bool."""
+    w = jnp.right_shift(ids, 5)
+    got = jnp.take_along_axis(words, w, axis=1)
+    return (jnp.right_shift(got, ids & 31) & 1).astype(bool)
+
+
+def _seg_or(bits: jax.Array, first: jax.Array) -> jax.Array:
+    """Segmented inclusive OR-scan along axis 1: `first` marks run starts."""
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, av | bv), af | bf
+    orv, _ = jax.lax.associative_scan(op, (bits, first), axis=1)
+    return orv
+
+
+def _mark_bits(words: jax.Array, ids: jax.Array) -> jax.Array:
+    """Set bits `ids` (Q, X) in the packed bitset (Q, W) without a
+    scatter-OR primitive: sort ids, OR together the bits of each same-word
+    run with a segmented scan, and let only each run's LAST element write
+    ``existing | run_or`` (distinct words per row -> no scatter conflicts).
+    """
+    Q, X = ids.shape
+    W = words.shape[1]
+    s = jnp.sort(ids, axis=1)
+    w = jnp.right_shift(s, 5)
+    b = jnp.left_shift(jnp.int32(1), s & 31)
+    first = jnp.concatenate(
+        [jnp.ones((Q, 1), bool), w[:, 1:] != w[:, :-1]], axis=1)
+    run_or = _seg_or(b, first)
+    last = jnp.concatenate(
+        [w[:, 1:] != w[:, :-1], jnp.ones((Q, 1), bool)], axis=1)
+    existing = jnp.take_along_axis(words, w, axis=1)
+    val = existing | run_or
+    target = jnp.where(last, w, W)          # W = out of bounds -> dropped
+    return jax.vmap(
+        lambda row, t, v: row.at[t].set(v, mode="drop"))(words, target, val)
+
+
+def _sorted_dup_mask(ids: jax.Array):
+    """(Q, X) int -> (Q, X) bool, True on every occurrence of an id after
+    the first (sort + inverse permutation)."""
+    Q = ids.shape[0]
+    order = jnp.argsort(ids, axis=1, stable=True)
+    sorted_ids = jnp.take_along_axis(ids, order, axis=1)
+    dup_sorted = jnp.concatenate(
+        [jnp.zeros((Q, 1), bool),
+         sorted_ids[:, 1:] == sorted_ids[:, :-1]], axis=1)
+    inv = jnp.argsort(order, axis=1)
+    return jnp.take_along_axis(dup_sorted, inv, axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
+def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
+                        pivot_mask, queries, k: int, L: int, B: int, T: int,
+                        metric: int, base: int, nbp_limit: int):
+    """Shared-pivot seeding (BKT): one dense (Q, P) matmul scores the whole
+    pivot set; the top-L pivots initialize every query's beam.  `pivot_mask`
+    (W,) int32 is the precomputed packed bitset of the pivot ids."""
+    Q = queries.shape[0]
+    N = data.shape[0]
+    P = pivot_ids.shape[0]
+
+    d0 = dist_ops.pairwise_distance(queries, pivot_vecs,
+                                    DistCalcMethod(metric))      # (Q, P)
+    if P < L:
+        d0 = jnp.concatenate(
+            [d0, jnp.full((Q, L - P), MAX_DIST, jnp.float32)], axis=1)
+        seed_ids = jnp.concatenate(
+            [pivot_ids, jnp.full((L - P,), -1, jnp.int32)])
+    else:
+        seed_ids = pivot_ids
+    neg, pos = jax.lax.top_k(-d0, L)
+    cand_d = -neg                                               # (Q, L)
+    cand_ids = jnp.where(cand_d < MAX_DIST, seed_ids[pos], -1)
+
+    # every pivot was scored: mark visited so the walk never re-scores one
+    visited = jnp.broadcast_to(pivot_mask[None, :],
+                               (Q, pivot_mask.shape[0])).astype(jnp.int32)
+
+    return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
+                 visited, k, L, B, T, metric, base, nbp_limit)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
+def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
+                               queries, k: int, L: int, B: int, T: int,
+                               metric: int, base: int, nbp_limit: int):
+    """Per-query seeding (KDT): `seed_ids` (Q, S) come from a host-side tree
+    descent per query (the reference's KDTSearch leaf seeding,
+    KDTree.h:178-215); they are gathered and scored as one batched
+    contraction, then the same walk runs."""
+    Q = queries.shape[0]
+    N = data.shape[0]
+    S = seed_ids.shape[1]
+
+    svecs = data[jnp.maximum(seed_ids, 0)]                       # (Q, S, D)
+    ssq = sqnorm[jnp.maximum(seed_ids, 0)]
+    d0 = dist_ops.batched_gathered_distance(
+        queries, svecs, DistCalcMethod(metric), base, ssq)
+    # duplicate seeds (same leaf reached twice) must not double-occupy the
+    # beam: keep the first occurrence only
+    d0 = jnp.where((seed_ids < 0) | _sorted_dup_mask(seed_ids), MAX_DIST, d0)
+    visited = jnp.zeros((Q, _num_words(N)), jnp.int32)
+    visited = _mark_bits(visited, jnp.where(seed_ids >= 0, seed_ids, N))
+    if S < L:
+        d0 = jnp.concatenate(
+            [d0, jnp.full((Q, L - S), MAX_DIST, jnp.float32)], axis=1)
+        seed_ids = jnp.concatenate(
+            [seed_ids, jnp.full((Q, L - S), -1, jnp.int32)], axis=1)
+    neg, pos = jax.lax.top_k(-d0, L)
+    cand_d = -neg
+    cand_ids = jnp.where(cand_d < MAX_DIST,
+                         jnp.take_along_axis(seed_ids, pos, axis=1), -1)
+
+    return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
+                 visited, k, L, B, T, metric, base, nbp_limit)
+
+
+def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
+          k: int, L: int, B: int, T: int, metric: int, base: int,
+          nbp_limit: int):
+    Q = queries.shape[0]
+    N = data.shape[0]
+
+    # expanded has a dump slot at column L; visited a dump slot at row N
+    expanded = jnp.concatenate(
+        [cand_ids < 0, jnp.zeros((Q, 1), bool)], axis=1)        # (Q, L+1)
+    no_better = jnp.zeros((Q,), jnp.int32)
+    k_eff = min(k, L)
+
+    def cond(state):
+        cand_ids, cand_d, expanded, visited, no_better, it = state
+        active = no_better < nbp_limit
+        has_work = jnp.any((~expanded[:, :L]) & (cand_ids >= 0), axis=1)
+        return (it < T) & jnp.any(active & has_work)
+
+    def body(state):
+        cand_ids, cand_d, expanded, visited, no_better, it = state
+        active = no_better < nbp_limit                           # (Q,)
+
+        # ---- pop best B unexpanded entries --------------------------------
+        sel_score = jnp.where(expanded[:, :L], MAX_DIST, cand_d)
+        sneg, spos = jax.lax.top_k(-sel_score, B)                # (Q, B)
+        sel_ok = ((-sneg) < MAX_DIST) & active[:, None]
+        sel_ids = jnp.where(
+            sel_ok, jnp.take_along_axis(cand_ids, spos, axis=1), -1)
+        expanded = _scatter_true(expanded, jnp.where(sel_ok, spos, L))
+        # "no better propagation": the best popped frontier node is already
+        # farther than the current worst result (reference increments per
+        # such pop, BKTIndex.cpp:139-144; an iteration here aggregates B
+        # pops, so the caller scales the limit by 1/B)
+        frontier_worse = (-sneg[:, 0]) > cand_d[:, k_eff - 1]
+
+        # ---- gather neighbors, dedupe against visited ---------------------
+        nbrs = graph[jnp.maximum(sel_ids, 0)]                    # (Q, B, m)
+        nbrs = jnp.where(sel_ok[..., None], nbrs, -1)
+        flat = nbrs.reshape(Q, -1)                               # (Q, B*m)
+        flat_safe = jnp.where(flat >= 0, flat, N)
+        seen = _test_bits(visited, flat_safe)
+        # a node reached from two popped parents in the SAME iteration is
+        # not yet in `visited` for either copy — dedupe within the batch or
+        # the beam accumulates duplicate entries
+        fresh = (flat >= 0) & ~seen & ~_sorted_dup_mask(flat)
+        visited = _mark_bits(visited, jnp.where(fresh, flat, N))
+
+        # ---- score fresh candidates (one batched contraction) -------------
+        gather_idx = jnp.where(fresh, flat, 0)
+        cvecs = data[gather_idx]                                 # (Q, C, D)
+        csq = sqnorm[gather_idx]
+        nd = dist_ops.batched_gathered_distance(
+            queries, cvecs, DistCalcMethod(metric), base, csq)
+        nd = jnp.where(fresh, nd, MAX_DIST)
+
+        # ---- merge beam + candidates, keep top-L --------------------------
+        all_d = jnp.concatenate([cand_d, nd], axis=1)
+        all_ids = jnp.concatenate([cand_ids, flat], axis=1)
+        all_exp = jnp.concatenate(
+            [expanded[:, :L], jnp.zeros_like(fresh)], axis=1)
+        mneg, mpos = jax.lax.top_k(-all_d, L)
+        cand_d = -mneg
+        cand_ids = jnp.take_along_axis(all_ids, mpos, axis=1)
+        cand_ids = jnp.where(cand_d < MAX_DIST, cand_ids, -1)
+        expanded = jnp.concatenate(
+            [jnp.take_along_axis(all_exp, mpos, axis=1),
+             jnp.zeros((Q, 1), bool)], axis=1)
+
+        no_better = jnp.where(frontier_worse,
+                              jnp.where(active, no_better + 1, no_better),
+                              0)
+        return cand_ids, cand_d, expanded, visited, no_better, it + 1
+
+    state = (cand_ids, cand_d, expanded, visited, no_better,
+             jnp.int32(0))
+    cand_ids, cand_d, *_ = jax.lax.while_loop(cond, body, state)
+
+    # ---- final top-k with tombstones filtered -----------------------------
+    dead = deleted[jnp.maximum(cand_ids, 0)] | (cand_ids < 0)
+    out_d = jnp.where(dead, MAX_DIST, cand_d)
+    fneg, fpos = jax.lax.top_k(-out_d, k_eff)
+    final_d = -fneg
+    final_ids = jnp.take_along_axis(cand_ids, fpos, axis=1)
+    final_ids = jnp.where(final_d < MAX_DIST, final_ids, -1)
+    return final_d, final_ids.astype(jnp.int32)
+
+
+class GraphSearchEngine:
+    """Immutable device snapshot of {vectors, graph, tombstones, pivots}
+    plus the compiled beam-search program (the single-writer snapshot design
+    of SURVEY.md §2b P7 — mutation builds a NEW engine, searches never lock).
+    """
+
+    def __init__(self, data: np.ndarray, graph: np.ndarray,
+                 pivot_ids: np.ndarray, deleted: Optional[np.ndarray],
+                 metric: DistCalcMethod, base: int):
+        n = data.shape[0]
+        assert graph.shape[0] == n, (graph.shape, n)
+        self.n = n
+        self.metric = DistCalcMethod(metric)
+        self.base = base
+        self.data = jnp.asarray(data)
+        self.sqnorm = jax.jit(dist_ops.row_sqnorms)(self.data)
+        self.graph = jnp.asarray(graph.astype(np.int32, copy=False))
+        if deleted is None:
+            deleted = np.zeros(n, bool)
+        self.deleted = jnp.asarray(deleted[:n])
+        pivot_ids = np.asarray(pivot_ids, np.int32)
+        if len(pivot_ids) == 0:
+            pivot_ids = np.zeros(1, np.int32)
+        self.pivot_ids = jnp.asarray(pivot_ids)
+        self.pivot_vecs = self.data[self.pivot_ids]
+        mask = np.zeros(_num_words(n), np.uint32)
+        np.bitwise_or.at(mask, pivot_ids >> 5,
+                         np.uint32(1) << (pivot_ids.astype(np.uint32) & 31))
+        self.pivot_mask = jnp.asarray(mask.view(np.int32))
+
+    def set_deleted(self, deleted: np.ndarray) -> None:
+        """Swap only the tombstone mask — mutation path for delete-only
+        changes, which must not pay a full snapshot rebuild."""
+        self.deleted = jnp.asarray(deleted[:self.n])
+
+    def search(self, queries: np.ndarray, k: int, max_check: int = 2048,
+               beam_width: int = 16, pool_size: Optional[int] = None,
+               nbp_limit: int = 3, seeds: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched search; returns ((Q, k) dists, (Q, k) int32 ids),
+        ascending, -1 / MAX_DIST padded.
+
+        `seeds` (Q, S) int32 overrides the engine's shared pivot seeding
+        with per-query seed ids (KDT tree-descent seeding), -1 padded.
+        """
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        nq = queries.shape[0]
+        k_eff = min(k, self.n)
+        L = pool_size or max(2 * k_eff, 64)
+        L = min(max(L, k_eff), self.n)
+        B = max(1, min(beam_width, L))
+        T = max(1, -(-max_check // B))
+        # continuous no-better-propagation limit: maxCheck/64 pops in the
+        # reference (WorkSpace.h:191), aggregated B pops per iteration here
+        limit = max(nbp_limit, (max_check // 64) // B, 1)
+
+        # packed bitset: 4 bytes per 32 ids -> N/8 bytes per query
+        chunk = max(1, min(_VISITED_BUDGET // max(self.n // 8, 1), 1024))
+        out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
+        out_i = np.full((nq, k), -1, np.int32)
+        for off in range(0, nq, chunk):
+            q = queries[off:off + chunk]
+            qn = q.shape[0]
+            q_pad = query_bucket(qn, chunk)
+            if q_pad != qn:
+                q = np.concatenate(
+                    [q, np.zeros((q_pad - qn, q.shape[1]), q.dtype)])
+            if seeds is None:
+                d, ids = _beam_search_kernel(
+                    self.data, self.sqnorm, self.graph, self.deleted,
+                    self.pivot_ids, self.pivot_vecs, self.pivot_mask,
+                    jnp.asarray(q),
+                    k_eff, L, B, T, int(self.metric), self.base, limit)
+            else:
+                s = seeds[off:off + qn].astype(np.int32, copy=False)
+                if q_pad != qn:
+                    s = np.concatenate(
+                        [s, np.full((q_pad - qn, s.shape[1]), -1, np.int32)])
+                d, ids = _beam_search_seeded_kernel(
+                    self.data, self.sqnorm, self.graph, self.deleted,
+                    jnp.asarray(s), jnp.asarray(q),
+                    k_eff, L, B, T, int(self.metric), self.base, limit)
+            out_d[off:off + qn, :k_eff] = np.asarray(d)[:qn]
+            out_i[off:off + qn, :k_eff] = np.asarray(ids)[:qn]
+        return out_d, out_i
+
+

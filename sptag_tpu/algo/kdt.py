@@ -1,0 +1,84 @@
+"""KDT index — kd-tree forest + RNG graph + beam search.
+
+Parity: KDT::Index<T> (/root/reference/AnnService/inc/Core/KDT/Index.h,
+src/Core/KDT/KDTIndex.cpp) — the same composition as BKT but seeded from
+kd-trees and with kd-specific termination heuristics:
+
+* BuildIndex (KDTIndex.cpp:254-281): build kd-tree forest, build + refine
+  the same RNG graph;
+* SearchIndex (:105-141): kd-tree guided DFS collects seed leaves with
+  accumulated distance bounds, then the budgeted graph walk runs; the
+  reference re-descends the trees mid-walk when tree-checked <= checked/10 —
+  here the equivalent coverage comes from seeding with `backtrack`
+  lowest-bound branches per tree up front (trees/kdtree.collect_seeds), so
+  the whole walk stays one compiled device loop;
+* AddIndex (:389-455) / DeleteIndex / RefineIndex: same shape as BKT.
+
+Shares BKTIndex's storage/mutation/persistence machinery; only the tree
+type, seeding, and parameter registry differ.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Tuple
+
+import numpy as np
+
+from sptag_tpu.algo.bkt import BKTIndex
+from sptag_tpu.core.index import MAX_DIST, register_algo
+from sptag_tpu.core.params import KDTParams
+from sptag_tpu.core.types import IndexAlgoType
+from sptag_tpu.trees.kdtree import KDTree
+
+log = logging.getLogger(__name__)
+
+# other-children branches greedily descended per tree at seed time (the
+# reference's SPTQueue backtracking, KDTree.h:157-215)
+_BACKTRACK = 15
+
+
+@register_algo
+class KDTIndex(BKTIndex):
+    algo = IndexAlgoType.KDT
+
+    def _make_params(self) -> KDTParams:
+        return KDTParams()
+
+    def _new_tree(self) -> KDTree:
+        p = self.params
+        return KDTree(tree_number=p.tree_number, top_dims=p.kdt_top_dims,
+                      samples=p.samples)
+
+    def _pivot_ids(self) -> np.ndarray:
+        # the engine's shared pivot set is only a fallback for KDT (used
+        # when no per-query seeds are provided, e.g. graph refine); a
+        # uniform stride sample plays the role of tree-top pivots
+        n = self._n
+        count = min(n, max(64, self.params.initial_dynamic_pivots * 32))
+        return np.linspace(0, n - 1, count, dtype=np.int32)
+
+    def _seeds_for(self, queries: np.ndarray) -> np.ndarray:
+        return self._tree.collect_seeds(queries, backtrack=_BACKTRACK)
+
+    def _search_batch(self, queries: np.ndarray,
+                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._n == 0:
+            raise RuntimeError("index is empty")
+        p = self.params
+        seeds = self._seeds_for(queries)
+        d, ids = self._get_engine().search(
+            queries, min(k, self._n), max_check=p.max_check,
+            nbp_limit=p.no_better_propagation_limit, seeds=seeds)
+        if ids.shape[1] < k:
+            q = ids.shape[0]
+            d = np.concatenate(
+                [d, np.full((q, k - d.shape[1]), MAX_DIST, np.float32)], 1)
+            ids = np.concatenate(
+                [ids, np.full((q, k - ids.shape[1]), -1, np.int32)], 1)
+        return d, ids
+
+    def _load_tree(self, path: str) -> KDTree:
+        p = self.params
+        return KDTree.load(path, tree_number=p.tree_number,
+                           top_dims=p.kdt_top_dims, samples=p.samples)

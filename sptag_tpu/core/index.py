@@ -277,7 +277,9 @@ class VectorIndex(abc.ABC):
         found_any = False
         # data is already normalized — call the subclass engine directly
         # rather than search_batch, which would normalize a second time.
-        dists, ids = self._search_batch(data, 32)
+        # The reference searches with k=CEF for deletes (BKTIndex.cpp:441).
+        k = int(getattr(self.params, "cef", 32))
+        dists, ids = self._search_batch(data, min(k, self.num_samples))
         with self._lock:
             for row_d, row_i in zip(dists, ids):
                 for d, v in zip(row_d, row_i):

@@ -126,6 +126,12 @@ class BKTParams(ParamSet):
             _spec("kmeans_k", int, 32, "BKTKmeansK"),
             _spec("leaf_size", int, 8, "BKTLeafSize"),
             _spec("samples", int, 1000, "Samples"),
+            # TPU-only knobs (no reference counterpart): search strategy
+            # ("dense" = MXU tree-partition scan, "beam" = batched graph
+            # walk with reference walk semantics) and the dense partition's
+            # target cluster size
+            _spec("search_mode", str, "dense", "SearchMode"),
+            _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
         ]
         + _GRAPH_SPECS[:2]
         + [_spec("tpt_top_dims", int, 5, "NumTopDimensionTpTreeSplit")]

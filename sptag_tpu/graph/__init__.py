@@ -1,0 +1,2 @@
+from sptag_tpu.graph.rng import RelativeNeighborhoodGraph  # noqa: F401
+from sptag_tpu.graph.tptree import tpt_partition  # noqa: F401

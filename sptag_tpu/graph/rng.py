@@ -1,0 +1,256 @@
+"""RelativeNeighborhoodGraph — the k-NN graph with RNG pruning.
+
+Parity targets (all under /root/reference/AnnService/inc/Core/Common/):
+
+* NeighborhoodGraph::BuildGraph (NeighborhoodGraph.h:43-110): `TPTNumber`(32)
+  random-projection trees partition the corpus into <=`TPTLeafSize`(2000)
+  leaves; every leaf is all-pairs joined and each node keeps its best
+  ``NeighborhoodSize * GraphNeighborhoodScale`` candidates; refine passes then
+  shrink rows to `NeighborhoodSize` under the RNG rule.
+* NeighborhoodGraph::RefineGraph (:113-143): each pass re-searches every node
+  (budget `MaxCheckForRefineGraph`) and rebuilds its row via
+  RelativeNeighborhoodGraph::RebuildNeighbors (RelativeNeighborhoodGraph.h:
+  18-35).
+* GraphAccuracyEstimation (RelativeNeighborhoodGraph.h:73-112): sampled
+  exact-vs-stored row overlap.
+
+TPU reshape: leaf all-pairs and candidate merging are single device programs
+per tree (ops/graph.py); the refine pass batches thousands of node-queries
+through the beam-search engine at once and double-buffers the graph (the
+reference refines rows in place one node at a time under per-row locks —
+sequential semantics a TPU batch cannot and need not reproduce).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from sptag_tpu.io import format as fmt
+from sptag_tpu.graph.tptree import tpt_partition
+from sptag_tpu.ops import graph as graph_ops
+from sptag_tpu.utils import round_up
+
+log = logging.getLogger(__name__)
+
+MAX_DIST = np.float32(3.4e38)
+
+# device budget for one (B, P, P) all-pairs tensor (floats)
+_ALLPAIRS_BUDGET = 1 << 26
+# node rows per rng_select / refine chunk
+_PRUNE_CHUNK = 4096
+
+# SearchFn(queries (Q, D), k) -> (dists (Q, k), ids (Q, k))
+SearchFn = Callable[[np.ndarray, int], Tuple[np.ndarray, np.ndarray]]
+
+
+class RelativeNeighborhoodGraph:
+    def __init__(self, neighborhood_size: int = 32, tpt_number: int = 32,
+                 tpt_leaf_size: int = 2000, neighborhood_scale: int = 2,
+                 cef_scale: int = 2, refine_iterations: int = 2,
+                 cef: int = 1000, tpt_top_dims: int = 5,
+                 tpt_samples: int = 1000):
+        self.neighborhood_size = neighborhood_size
+        self.tpt_number = tpt_number
+        self.tpt_leaf_size = tpt_leaf_size
+        self.neighborhood_scale = neighborhood_scale
+        self.cef_scale = cef_scale
+        self.refine_iterations = refine_iterations
+        self.cef = cef
+        self.tpt_top_dims = tpt_top_dims
+        self.tpt_samples = tpt_samples
+        # (N, row_width) int32 neighbor ids, -1 padded.  Width is
+        # neighborhood_size after the final refine; candidate-width before.
+        self.graph = np.zeros((0, neighborhood_size), np.int32)
+
+    # ------------------------------------------------------------------ build
+
+    def build(self, data: np.ndarray, metric: int, base: int,
+              search_fn_factory: Optional[Callable[[np.ndarray], SearchFn]]
+              = None, seed: int = 31) -> None:
+        """Full build: TPT candidates, then refine passes.
+
+        `search_fn_factory(graph)` returns a SearchFn over the *current*
+        graph (the index wires the beam engine in); when None, refine falls
+        back to candidate-only pruning (no re-search).
+        """
+        cand_ids, cand_d = self.build_candidates(data, metric, base, seed)
+        m = self.neighborhood_size
+        passes = max(self.refine_iterations, 1)
+        for it in range(passes):
+            last = it == passes - 1
+            width = m if last else min(cand_ids.shape[1],
+                                       m * self.neighborhood_scale)
+            if it == 0 or search_fn_factory is None:
+                # first pass prunes the TPT candidates directly
+                self.graph = self.prune_candidates(
+                    data, cand_ids, cand_d, width, metric, base)
+            else:
+                self.refine_once(data, search_fn_factory(self.graph),
+                                 width, metric, base)
+            log.info("RNG refine pass %d/%d width=%d", it + 1, passes, width)
+
+    def build_candidates(self, data: np.ndarray, metric: int, base: int,
+                         seed: int) -> Tuple[np.ndarray, np.ndarray]:
+        """TPT forest -> (N, C) best-candidate lists, ascending distance.
+
+        Parity: the TPT scatter phase of BuildGraph (NeighborhoodGraph.h:
+        61-110); one `leaf_allpairs_topk` + `merge_candidates` device program
+        pair per tree replaces the per-pair AddNeighbor insertion sorts.
+        """
+        n = data.shape[0]
+        C = min(max(self.neighborhood_size * self.neighborhood_scale, 1),
+                max(n - 1, 1))
+        rng = np.random.default_rng(seed)
+        cand_ids = np.full((n, C), -1, np.int32)
+        cand_d = np.full((n, C), MAX_DIST, np.float32)
+
+        for t in range(self.tpt_number):
+            leaves = tpt_partition(data, self.tpt_leaf_size,
+                                   self.tpt_top_dims, self.tpt_samples, rng)
+            new_ids, new_d = self._tree_candidates(
+                data, leaves, C, metric, base)
+            merged_ids, merged_d = graph_ops.merge_candidates(
+                jnp.asarray(cand_ids), jnp.asarray(cand_d),
+                jnp.asarray(new_ids), jnp.asarray(new_d))
+            cand_ids = np.asarray(merged_ids)
+            cand_d = np.asarray(merged_d)
+            log.info("TPT tree %d/%d merged", t + 1, self.tpt_number)
+        return cand_ids, cand_d
+
+    def _tree_candidates(self, data, leaves, C, metric, base):
+        """All-pairs join of one tree's leaves -> (N, C) candidates."""
+        n = data.shape[0]
+        new_ids = np.full((n, C), -1, np.int32)
+        new_d = np.full((n, C), MAX_DIST, np.float32)
+        max_leaf = max(len(leaf) for leaf in leaves)
+        P = max(round_up(max_leaf, 128), 128)
+        batch = max(1, _ALLPAIRS_BUDGET // (P * P))
+        for off in range(0, len(leaves), batch):
+            chunk = leaves[off:off + batch]
+            B = len(chunk)
+            ids_pad = np.full((B, P), -1, np.int64)
+            vecs = np.zeros((B, P, data.shape[1]), np.float32)
+            valid = np.zeros((B, P), bool)
+            for b, leaf in enumerate(chunk):
+                ids_pad[b, :len(leaf)] = leaf
+                vecs[b, :len(leaf)] = data[leaf].astype(np.float32)
+                valid[b, :len(leaf)] = True
+            pos, d = graph_ops.leaf_allpairs_topk(
+                jnp.asarray(vecs), jnp.asarray(valid), C, metric, base)
+            pos = np.asarray(pos)              # (B, P, C) within-leaf
+            d = np.asarray(d)
+            gids = np.where(pos >= 0,
+                            np.take_along_axis(
+                                np.broadcast_to(ids_pad[:, :, None],
+                                                pos.shape),
+                                np.maximum(pos, 0), axis=1), -1)
+            rows = ids_pad[valid]
+            new_ids[rows] = gids[valid]
+            new_d[rows] = d[valid]
+        return new_ids, new_d
+
+    # ----------------------------------------------------------------- refine
+
+    def prune_candidates(self, data: np.ndarray, cand_ids: np.ndarray,
+                         cand_d: np.ndarray, width: int, metric: int,
+                         base: int) -> np.ndarray:
+        """RNG-prune sorted candidate lists into rows of `width` neighbors."""
+        n, C = cand_ids.shape
+        out = np.full((n, width), -1, np.int32)
+        for off in range(0, n, _PRUNE_CHUNK):
+            rows = slice(off, min(off + _PRUNE_CHUNK, n))
+            ids = cand_ids[rows]
+            d = cand_d[rows]
+            vecs = data[np.maximum(ids, 0)].astype(np.float32)
+            keep = np.asarray(graph_ops.rng_select(
+                jnp.asarray(data[rows.start:rows.stop].astype(np.float32)),
+                jnp.asarray(vecs), jnp.asarray(d),
+                jnp.asarray(ids >= 0), width, metric, base))
+            sel = np.where(keep >= 0,
+                           np.take_along_axis(ids, np.maximum(keep, 0),
+                                              axis=1), -1)
+            out[rows] = sel
+        return out
+
+    def refine_once(self, data: np.ndarray, search_fn: SearchFn, width: int,
+                    metric: int, base: int) -> None:
+        """One refine pass: re-search every node, RNG-prune the results.
+
+        Parity: RefineGraph (NeighborhoodGraph.h:113-143) — each node's new
+        row comes from a fresh CEF-budget search, self excluded.  Batched and
+        double-buffered: all searches in the pass read the pass-start graph.
+        """
+        n = data.shape[0]
+        k = min(self.cef + 1, n)
+        new_graph = np.full((n, width), -1, np.int32)
+        for off in range(0, n, _PRUNE_CHUNK):
+            rows = slice(off, min(off + _PRUNE_CHUNK, n))
+            queries = data[rows]
+            d, ids = search_fn(queries, k)
+            # drop self-hits, keep ascending order
+            node_ids = np.arange(rows.start, rows.stop)[:, None]
+            is_self = ids == node_ids
+            d = np.where(is_self, MAX_DIST, d)
+            order = np.argsort(d, axis=1, kind="stable")
+            d = np.take_along_axis(d, order, axis=1)
+            ids = np.take_along_axis(ids, order, axis=1)
+            ids = np.where(d >= MAX_DIST, -1, ids)
+            C = min(ids.shape[1], self.cef)
+            ids = ids[:, :C]
+            d = d[:, :C]
+            vecs = data[np.maximum(ids, 0)].astype(np.float32)
+            keep = np.asarray(graph_ops.rng_select(
+                jnp.asarray(queries.astype(np.float32)),
+                jnp.asarray(vecs), jnp.asarray(d),
+                jnp.asarray(ids >= 0), width, metric, base))
+            new_graph[rows] = np.where(
+                keep >= 0,
+                np.take_along_axis(ids, np.maximum(keep, 0), axis=1), -1)
+        self.graph = new_graph
+
+    # ------------------------------------------------------- quality estimate
+
+    def accuracy_estimation(self, data: np.ndarray, metric: int, base: int,
+                            samples: int = 100,
+                            seed: int = 0) -> float:
+        """Sampled fraction of stored neighbors that are true nearest
+        neighbors (parity: GraphAccuracyEstimation,
+        RelativeNeighborhoodGraph.h:73-112)."""
+        from sptag_tpu.ops import distance as dist_ops
+
+        n = data.shape[0]
+        if n == 0 or self.graph.shape[0] == 0:
+            return 0.0
+        rng = np.random.default_rng(seed)
+        pick = rng.choice(n, min(samples, n), replace=False)
+        q = jnp.asarray(data[pick])
+        d = np.array(dist_ops.pairwise_distance(
+            q, jnp.asarray(data), metric))
+        d[np.arange(len(pick)), pick] = MAX_DIST
+        m = self.graph.shape[1]
+        truth = np.argsort(d, axis=1)[:, :m]
+        hits = 0
+        total = 0
+        for row, node in enumerate(pick):
+            stored = set(int(x) for x in self.graph[node] if x >= 0)
+            if not stored:
+                continue
+            hits += len(stored & set(truth[row][:len(stored)].tolist()))
+            total += len(stored)
+        return hits / max(total, 1)
+
+    # ------------------------------------------------------------ persistence
+
+    def save(self, path_or_stream) -> None:
+        fmt.write_graph(path_or_stream, self.graph)
+
+    @classmethod
+    def load(cls, path_or_stream, **kwargs) -> "RelativeNeighborhoodGraph":
+        g = cls(**kwargs)
+        g.graph = fmt.read_graph(path_or_stream)
+        g.neighborhood_size = g.graph.shape[1]
+        return g

@@ -126,37 +126,38 @@ def pairwise_distance(q: jax.Array, x: jax.Array, metric: DistCalcMethod,
     return pairwise_cosine(q, x, base_of(value_type))
 
 
-def gathered_distance(q: jax.Array, cand: jax.Array, metric: DistCalcMethod,
-                      base: int) -> jax.Array:
-    """Distances between one query (D,) and gathered candidates (C, D) ->
-    (C,) float32.  Used inside the beam-search engine where candidates come
-    from graph adjacency gathers; vmapped over the query batch."""
-    metric = DistCalcMethod(metric)
+def batched_gathered_distance(q: jax.Array, cand: jax.Array,
+                              metric: DistCalcMethod, base: int,
+                              cand_sqnorm: Optional[jax.Array] = None
+                              ) -> jax.Array:
+    """(Q, D) queries x (Q, C, D) per-query gathered candidates -> (Q, C)
+    distances, float32.  The adjacency-gather scoring step of the beam-search
+    engine (the reference computes these one at a time in its frontier loop,
+    BKTIndex.cpp:145-152); `cand_sqnorm` (Q, C) skips re-reducing corpus rows
+    whose norms are cached on the index."""
+    metric = int(metric)
     if _is_int(q.dtype):
-        dot = jnp.einsum("d,cd->c", q.astype(jnp.int32),
+        dot = jnp.einsum("qd,qcd->qc", q.astype(jnp.int32),
                          cand.astype(jnp.int32),
                          preferred_element_type=jnp.int32).astype(jnp.float32)
-        if metric == DistCalcMethod.Cosine:
+        if metric == int(DistCalcMethod.Cosine):
             return float(base) * float(base) - dot
-        if q.dtype == jnp.int16:
-            qf, cf = q.astype(jnp.float32), cand.astype(jnp.float32)
-            qn = jnp.sum(qf * qf)
-            cn = jnp.sum(cf * cf, axis=-1)
-        else:
-            qi = q.astype(jnp.int32)
-            qn = jnp.sum(qi * qi).astype(jnp.float32)
-            ci = cand.astype(jnp.int32)
-            cn = jnp.sum(ci * ci, axis=-1).astype(jnp.float32)
-        return jnp.maximum(qn + cn - 2.0 * dot, 0.0)
+        qf = q.astype(jnp.float32)
+        qn = jnp.sum(qf * qf, axis=-1)[:, None]
+        if cand_sqnorm is None:
+            cf = cand.astype(jnp.float32)
+            cand_sqnorm = jnp.sum(cf * cf, axis=-1)
+        return jnp.maximum(qn + cand_sqnorm - 2.0 * dot, 0.0)
     qf = q.astype(jnp.float32)
     cf = cand.astype(jnp.float32)
-    dot = jnp.einsum("d,cd->c", qf, cf, precision=_FLOAT_PRECISION,
+    dot = jnp.einsum("qd,qcd->qc", qf, cf, precision=_FLOAT_PRECISION,
                      preferred_element_type=jnp.float32)
-    if metric == DistCalcMethod.Cosine:
+    if metric == int(DistCalcMethod.Cosine):
         return 1.0 - dot
-    qn = jnp.sum(qf * qf)
-    cn = jnp.sum(cf * cf, axis=-1)
-    return jnp.maximum(qn + cn - 2.0 * dot, 0.0)
+    qn = jnp.sum(qf * qf, axis=-1)[:, None]
+    if cand_sqnorm is None:
+        cand_sqnorm = jnp.sum(cf * cf, axis=-1)
+    return jnp.maximum(qn + cand_sqnorm - 2.0 * dot, 0.0)
 
 
 def normalize(vectors: np.ndarray, base: int) -> np.ndarray:

@@ -1,0 +1,196 @@
+"""Wire protocol — byte-compatible with the reference socket stack.
+
+Parity targets (all under /root/reference/AnnService/):
+
+* Packet framing: 16-byte header {u8 type, u8 status, u32 bodyLength,
+  u32 connectionID, u32 resourceID, 2B pad} (inc/Socket/Packet.h:52-76,
+  src/Socket/Packet.cpp:41-66; header buffer is c_bufferSize=16 while the
+  serialized fields occupy 14).
+* PacketType/ResponseMask values (inc/Socket/Packet.h:20-37) and
+  PacketProcessStatus (:40-48).
+* SimpleSerialization conventions (inc/Socket/SimpleSerialization.h:21-168):
+  POD little-endian, strings/bytes as u32 length + payload.
+* RemoteQuery / RemoteSearchResult bodies incl. the u16 version prologue
+  (inc/Socket/RemoteSearchQuery.h:23-92, src/Socket/RemoteSearchQuery.cpp:
+  11-210).
+
+A C++ reference client can talk to this server and vice versa — the framing
+and bodies are bit-identical on x86 (little-endian).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import List, Optional, Tuple
+
+HEADER_SIZE = 16
+INVALID_CONNECTION_ID = 0
+INVALID_RESOURCE_ID = 0
+
+_HEADER_STRUCT = struct.Struct("<BBIII2x")
+_U32 = struct.Struct("<I")
+_U16X2_U8 = struct.Struct("<HHB")
+_VID_DIST = struct.Struct("<if")
+
+
+class PacketType(enum.IntEnum):
+    Undefined = 0x00
+    HeartbeatRequest = 0x01
+    RegisterRequest = 0x02
+    SearchRequest = 0x03
+    ResponseMask = 0x80
+    HeartbeatResponse = 0x81
+    RegisterResponse = 0x82
+    SearchResponse = 0x83
+
+
+def is_request(ptype: int) -> bool:
+    return 0 < ptype < PacketType.ResponseMask
+
+
+def response_type(ptype: int) -> int:
+    return ptype | PacketType.ResponseMask
+
+
+class PacketProcessStatus(enum.IntEnum):
+    Ok = 0x00
+    Timeout = 0x01
+    Dropped = 0x02
+    Failed = 0x03
+
+
+class ResultStatus(enum.IntEnum):
+    """RemoteSearchResult::ResultStatus
+    (inc/Socket/RemoteSearchQuery.h:61-72)."""
+
+    Success = 0
+    Timeout = 1
+    FailedNetwork = 2
+    FailedExecute = 3
+    Dropped = 4
+
+
+@dataclasses.dataclass
+class PacketHeader:
+    packet_type: int = PacketType.Undefined
+    process_status: int = PacketProcessStatus.Ok
+    body_length: int = 0
+    connection_id: int = INVALID_CONNECTION_ID
+    resource_id: int = INVALID_RESOURCE_ID
+
+    def pack(self) -> bytes:
+        return _HEADER_STRUCT.pack(self.packet_type, self.process_status,
+                                   self.body_length, self.connection_id,
+                                   self.resource_id)
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> "PacketHeader":
+        t, s, blen, cid, rid = _HEADER_STRUCT.unpack(buf[:HEADER_SIZE])
+        return cls(t, s, blen, cid, rid)
+
+
+def write_string(s) -> bytes:
+    if isinstance(s, str):
+        s = s.encode()
+    return _U32.pack(len(s)) + bytes(s)
+
+
+def read_string(buf: bytes, off: int) -> Tuple[bytes, int]:
+    (n,) = _U32.unpack_from(buf, off)
+    off += 4
+    return bytes(buf[off:off + n]), off + n
+
+
+@dataclasses.dataclass
+class RemoteQuery:
+    """inc/Socket/RemoteSearchQuery.h:23-46; version (1, 0), type String=0."""
+
+    query: str = ""
+    query_type: int = 0
+
+    MAJOR = 1
+    MIRROR = 0
+
+    def pack(self) -> bytes:
+        return (_U16X2_U8.pack(self.MAJOR, self.MIRROR, self.query_type)
+                + write_string(self.query))
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> Optional["RemoteQuery"]:
+        major, _, qtype = _U16X2_U8.unpack_from(buf, 0)
+        if major != cls.MAJOR:
+            return None
+        q, _ = read_string(buf, _U16X2_U8.size)
+        return cls(q.decode("utf-8", "replace"), qtype)
+
+
+@dataclasses.dataclass
+class IndexSearchResult:
+    """inc/Socket/RemoteSearchQuery.h:49-54."""
+
+    index_name: str
+    ids: List[int]
+    dists: List[float]
+    metas: Optional[List[bytes]] = None
+
+
+@dataclasses.dataclass
+class RemoteSearchResult:
+    """inc/Socket/RemoteSearchQuery.h:57-92 — flat list of per-index result
+    lists; the aggregator concatenates these without re-ranking
+    (AggregatorService.cpp:316-366)."""
+
+    status: int = ResultStatus.Timeout
+    results: List[IndexSearchResult] = dataclasses.field(default_factory=list)
+
+    MAJOR = 1
+    MIRROR = 0
+
+    def pack(self) -> bytes:
+        out = [_U16X2_U8.pack(self.MAJOR, self.MIRROR, self.status),
+               _U32.pack(len(self.results))]
+        for r in self.results:
+            out.append(write_string(r.index_name))
+            out.append(_U32.pack(len(r.ids)))
+            with_meta = r.metas is not None
+            out.append(struct.pack("<?", with_meta))
+            for vid, dist in zip(r.ids, r.dists):
+                out.append(_VID_DIST.pack(int(vid), float(dist)))
+            if with_meta:
+                for m in r.metas:
+                    out.append(write_string(m))
+        return b"".join(out)
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> Optional["RemoteSearchResult"]:
+        major, _, status = _U16X2_U8.unpack_from(buf, 0)
+        if major != cls.MAJOR:
+            return None
+        off = _U16X2_U8.size
+        (count,) = _U32.unpack_from(buf, off)
+        off += 4
+        results: List[IndexSearchResult] = []
+        for _ in range(count):
+            name, off = read_string(buf, off)
+            (num,) = _U32.unpack_from(buf, off)
+            off += 4
+            (with_meta,) = struct.unpack_from("<?", buf, off)
+            off += 1
+            ids: List[int] = []
+            dists: List[float] = []
+            for _ in range(num):
+                vid, dist = _VID_DIST.unpack_from(buf, off)
+                off += _VID_DIST.size
+                ids.append(vid)
+                dists.append(dist)
+            metas = None
+            if with_meta:
+                metas = []
+                for _ in range(num):
+                    m, off = read_string(buf, off)
+                    metas.append(m)
+            results.append(IndexSearchResult(name.decode(), ids, dists,
+                                             metas))
+        return cls(status, results)

@@ -97,8 +97,9 @@ class BKTree:
             perm = rng.permutation(ids_all)
             tree_starts.append(len(centerid))
             root = new_node(n)
-            # level items: (node_idx, sample-id array)
-            level: List[Tuple[int, np.ndarray]] = [(root, perm)]
+            # level items: (node_idx, sample-id array, has_center_sample —
+            # False for the root, whose centerid is the count sentinel)
+            level: List[Tuple[int, np.ndarray, bool]] = [(root, perm, False)]
             while level:
                 level = self._expand_level(
                     data, level, centerid, child_start, child_end,
@@ -116,11 +117,11 @@ class BKTree:
                       new_node, rng, key):
         """Expand all items of one level; returns the next level's items."""
         K = self.kmeans_k
-        next_level: List[Tuple[int, np.ndarray]] = []
+        next_level: List[Tuple[int, np.ndarray, bool]] = []
 
-        leaf_items = [(ni, ids) for ni, ids in level
+        leaf_items = [(ni, ids) for ni, ids, _ in level
                       if len(ids) <= self.leaf_size]
-        km_items = [(ni, ids) for ni, ids in level
+        km_items = [(ni, ids, hc) for ni, ids, hc in level
                     if len(ids) > self.leaf_size]
 
         for ni, ids in leaf_items:
@@ -132,7 +133,7 @@ class BKTree:
         # ---- bucket k-means items by padded size, run batched device kmeans
         results: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         buckets: Dict[int, List[int]] = {}
-        for idx, (ni, ids) in enumerate(km_items):
+        for idx, (ni, ids, hc) in enumerate(km_items):
             buckets.setdefault(_next_pow2(len(ids)), []).append(idx)
 
         for p_full, idxs in sorted(buckets.items()):
@@ -144,12 +145,22 @@ class BKTree:
                     data, km_items, chunk, p_full, p_sub, rng, key, results)
 
         # ---- materialize children from labels
-        for idx, (ni, ids) in enumerate(km_items):
+        for idx, (ni, ids, has_center) in enumerate(km_items):
             labels, counts, medoids = results[idx]
             nonzero = np.flatnonzero(counts)
             child_start[ni] = len(centerid)
             if len(nonzero) <= 1:
-                # degenerate duplicate cluster (reference BKTree.h:184-195)
+                # degenerate duplicate cluster (reference BKTree.h:184-195).
+                # The node's own centerid sample was excluded from `ids` by
+                # the parent's clustering; the reference re-includes it
+                # (`end = min(item.last + 1, ...)` reaches the parent's
+                # medoid slot) so no sample is lost from the tree.  Only
+                # nodes created by a parent's clustering carry such a
+                # sample (`has_center`) — the root's centerid is the count
+                # sentinel and must never be re-included.
+                old_center = int(centerid[ni])
+                if has_center and old_center not in ids:
+                    ids = np.concatenate([ids, [old_center]])
                 ids_sorted = np.sort(ids)
                 center = int(ids_sorted[0])
                 centerid[ni] = center
@@ -171,7 +182,7 @@ class BKTree:
                     # cluster's center from deeper recursion, BKTree.h:201)
                     rest = members[members != med]
                     if len(rest) > 0:
-                        next_level.append((cni, rest))
+                        next_level.append((cni, rest, True))
             child_end[ni] = len(centerid)
         return next_level
 
@@ -179,8 +190,13 @@ class BKTree:
                           rng, key, results):
         """Run one padded (B, P) batch through device kmeans; fill results
         as (labels over the item's ids, counts (K,), medoid sample ids)."""
-        K = self.kmeans_k
-        B = len(chunk)
+        # a node smaller than K can't seed K distinct centers; clamp (the
+        # reference's per-node loop never hits this because it k-means only
+        # nodes with > leaf_size samples and K <= default leaf budgets)
+        K = min(self.kmeans_k, p_sub)
+        # pad the batch dim to a power of two so deep levels with varying
+        # node counts reuse compiled kernels instead of recompiling per shape
+        B = _next_pow2(len(chunk))
         D = data.shape[1]
         sub = np.zeros((B, p_sub, D), np.float32)
         sub_valid = np.zeros((B, p_sub), bool)
@@ -277,7 +293,9 @@ class BKTree:
         cid = self.nodes["centerid"]
         cs = self.nodes["childStart"]
         ce = self.nodes["childEnd"]
-        for ni in np.flatnonzero((cs < -1)):
+        # degenerate nodes store a negated childStart; cs == -1 is ambiguous
+        # (the leaf default) unless childEnd shows materialized children
+        for ni in np.flatnonzero((cs < -1) | ((cs == -1) & (ce > 0))):
             center = int(cid[ni])
             if center < 0:
                 continue

@@ -1,3 +1,17 @@
 def round_up(n: int, m: int) -> int:
     """Round n up to the next multiple of m."""
     return ((n + m - 1) // m) * m
+
+
+# Query-batch padding ladder shared by the device search paths: padding to a
+# fixed bucket lets repeated searches reuse compiled programs instead of
+# recompiling per shape.
+QUERY_BUCKETS = (1, 8, 32, 128, 256, 1024)
+
+
+def query_bucket(q: int, cap: int) -> int:
+    """Pad q up to the smallest bucket, bounded by the caller's chunk cap."""
+    for b in QUERY_BUCKETS:
+        if q <= b:
+            return min(b, cap)
+    return min(round_up(q, QUERY_BUCKETS[-1]), cap)

@@ -1,0 +1,116 @@
+"""BKT index end-to-end tests, modeled on the reference lifecycle suite
+(Test/src/AlgoTest.cpp:112-188: Build -> Search -> Save -> Load -> Add ->
+Delete) plus recall-vs-brute-force assertions the reference lacks
+(SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.core.types import DistCalcMethod
+
+
+def _make_index(n=800, d=12, metric="L2", seed=11, mode="dense"):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((16, d)).astype(np.float32) * 4
+    data = (centers[rng.integers(0, 16, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+    queries = (centers[rng.integers(0, 16, 50)]
+               + rng.standard_normal((50, d)).astype(np.float32))
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", metric)
+    # small-corpus build params (defaults target million-scale)
+    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "6"), ("TPTLeafSize", "64"),
+                        ("NeighborhoodSize", "16"), ("CEF", "64"),
+                        ("AddCEF", "32"), ("MaxCheckForRefineGraph", "256"),
+                        ("MaxCheck", "512"), ("RefineIterations", "2"),
+                        ("Samples", "100"), ("SearchMode", mode),
+                        ("DenseClusterSize", "64")]:
+        assert index.set_parameter(name, value)
+    assert index.build(data) == sp.ErrorCode.Success
+    return index, data, queries
+
+
+def _oracle(index, data, queries, k):
+    oracle = sp.create_instance("FLAT", "Float")
+    oracle.set_parameter(
+        "DistCalcMethod",
+        "L2" if index.dist_calc_method == DistCalcMethod.L2 else "Cosine")
+    oracle.build(data)
+    return oracle.search_batch(queries, k)
+
+
+@pytest.mark.parametrize("metric", ["L2", "Cosine"])
+@pytest.mark.parametrize("mode", ["dense", "beam"])
+def test_bkt_recall_vs_oracle(metric, mode):
+    index, data, queries = _make_index(metric=metric, mode=mode)
+    k = 10
+    d_bkt, i_bkt = index.search_batch(queries, k)
+    d_true, i_true = _oracle(index, data, queries, k)
+    recall = np.mean([len(set(i_bkt[q].tolist()) & set(i_true[q].tolist()))
+                      / k for q in range(len(queries))])
+    assert recall >= 0.9, recall
+    # distances ascending and consistent with ids
+    assert np.all(np.diff(d_bkt, axis=1) >= -1e-4)
+
+
+def test_bkt_self_query_exact():
+    index, data, _ = _make_index()
+    d, ids = index.search_batch(data[:20], 1)
+    assert (ids[:, 0] == np.arange(20)).mean() >= 0.95
+    assert np.allclose(d[ids[:, 0] == np.arange(20), 0], 0, atol=1e-4)
+
+
+def test_bkt_save_load_roundtrip(tmp_path):
+    index, data, queries = _make_index(n=400)
+    folder = str(tmp_path / "bkt_index")
+    assert index.save_index(folder) == sp.ErrorCode.Success
+    loaded = sp.load_index(folder)
+    assert loaded.algo == sp.IndexAlgoType.BKT
+    assert loaded.num_samples == index.num_samples
+    d0, i0 = index.search_batch(queries[:8], 5)
+    d1, i1 = loaded.search_batch(queries[:8], 5)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_allclose(d0, d1, rtol=1e-5)
+
+
+def test_bkt_add_then_search_finds_new_rows():
+    index, data, _ = _make_index(n=400)
+    rng = np.random.default_rng(99)
+    new = data[:16] + rng.standard_normal((16, data.shape[1])).astype(
+        np.float32) * 0.01
+    assert index.add(new) == sp.ErrorCode.Success
+    assert index.num_samples == 416
+    d, ids = index.search_batch(new, 3)
+    hit = np.mean([(400 + q) in ids[q] for q in range(16)])
+    assert hit >= 0.9, (hit, ids[:4])
+
+
+def test_bkt_delete_and_refine():
+    index, data, queries = _make_index(n=400)
+    # delete-by-content: exact rows are tombstoned and vanish from results
+    # (an ANN search backs the delete, exactly as in the reference
+    # BKTIndex.cpp:439-453, so a rare miss is legal — require >=4 of 5)
+    assert index.delete(data[:5]) == sp.ErrorCode.Success
+    assert index.num_deleted >= 4
+    gone = np.flatnonzero([not index.contains_sample(i) for i in range(5)])
+    _, ids = index.search_batch(data[:5], 3)
+    assert not np.isin(ids, gone).any()
+    # compaction keeps search working
+    assert index.refine_index() == sp.ErrorCode.Success
+    assert index.num_deleted == 0
+    assert index.num_samples <= 396
+    d, ids = index.search_batch(queries[:10], 5)
+    assert (ids[:, 0] >= 0).all()
+
+
+def test_bkt_add_triggers_tree_rebuild():
+    index, data, _ = _make_index(n=300)
+    index.set_parameter("AddCountForRebuild", "32")
+    rng = np.random.default_rng(5)
+    new = rng.standard_normal((40, data.shape[1])).astype(np.float32)
+    assert index.add(new) == sp.ErrorCode.Success
+    assert index._adds_since_rebuild == 0   # rebuild fired
+    d, ids = index.search_batch(new[:4], 1)
+    assert (ids[:, 0] >= 300).all()

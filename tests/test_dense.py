@@ -1,0 +1,86 @@
+"""Dense tree-partition search tests (TPU-first fast path, algo/dense.py)."""
+
+import numpy as np
+
+import sptag_tpu as sp
+from sptag_tpu.algo.dense import DenseTreeSearcher, partition_from_tree
+from sptag_tpu.core.types import DistCalcMethod
+from sptag_tpu.trees.bktree import BKTree
+
+
+def _corpus(n=800, d=12, seed=5):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((16, d)).astype(np.float32) * 4
+    data = (centers[rng.integers(0, 16, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+    return data
+
+
+def test_partition_covers_every_id_once():
+    data = _corpus()
+    tree = BKTree(tree_number=1, kmeans_k=8, leaf_size=8, samples=100)
+    tree.build(data)
+    centers, clusters = partition_from_tree(tree, len(data), 64)
+    all_ids = np.concatenate(clusters)
+    assert sorted(all_ids.tolist()) == list(range(len(data)))
+    assert len(centers) == len(clusters)
+    # clusters respect the target within the k-means branching slack
+    assert max(len(c) for c in clusters) <= 64 + 8
+
+
+def test_dense_search_recall():
+    data = _corpus()
+    tree = BKTree(tree_number=1, kmeans_k=8, leaf_size=8, samples=100)
+    tree.build(data)
+    centers, clusters = partition_from_tree(tree, len(data), 64)
+    searcher = DenseTreeSearcher(data, centers, clusters, None,
+                                 DistCalcMethod.L2, 1)
+    rng = np.random.default_rng(0)
+    queries = data[rng.integers(0, len(data), 32)] \
+        + rng.standard_normal((32, data.shape[1])).astype(np.float32) * 0.05
+    d, ids = searcher.search(queries, k=10, max_check=512)
+
+    diff = queries[:, None, :] - data[None, :, :]
+    exact = np.sum(diff * diff, axis=-1)
+    truth = np.argsort(exact, axis=1)[:, :10]
+    recall = np.mean([len(set(ids[q].tolist()) & set(truth[q].tolist())) / 10
+                      for q in range(32)])
+    assert recall >= 0.95, recall
+    assert np.all(np.diff(d, axis=1) >= -1e-4)
+
+
+def test_dense_search_excludes_deleted():
+    data = _corpus(n=300)
+    tree = BKTree(tree_number=1, kmeans_k=8, leaf_size=8, samples=100)
+    tree.build(data)
+    centers, clusters = partition_from_tree(tree, len(data), 64)
+    deleted = np.zeros(len(data), bool)
+    deleted[:10] = True
+    searcher = DenseTreeSearcher(data, centers, clusters, deleted,
+                                 DistCalcMethod.L2, 1)
+    d, ids = searcher.search(data[:10], k=3, max_check=300)
+    assert not np.isin(ids, np.arange(10)).any()
+
+
+def test_bkt_dense_after_add_covers_new_rows():
+    data = _corpus(n=400)
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    for name, value in [("BKTKmeansK", "8"), ("TPTNumber", "4"),
+                        ("TPTLeafSize", "64"), ("NeighborhoodSize", "16"),
+                        ("CEF", "64"), ("AddCEF", "32"),
+                        ("MaxCheckForRefineGraph", "128"),
+                        ("MaxCheck", "512"), ("RefineIterations", "1"),
+                        ("Samples", "100"), ("SearchMode", "dense"),
+                        ("DenseClusterSize", "64"),
+                        ("AddCountForRebuild", "1000")]:
+        assert index.set_parameter(name, value)
+    assert index.build(data) == sp.ErrorCode.Success
+    rng = np.random.default_rng(3)
+    new = data[:8] + rng.standard_normal((8, 12)).astype(np.float32) * 0.01
+    # AddCountForRebuild=1000 -> tree NOT rebuilt; dense path must still
+    # cover the appended rows via nearest-centroid assignment
+    assert index.add(new) == sp.ErrorCode.Success
+    _, ids = index.search_batch(new, 2)
+    hit = np.mean([(400 + q) in ids[q] for q in range(8)])
+    assert hit >= 0.9, (hit, ids)

@@ -59,18 +59,19 @@ def test_pairwise_matches_scalar(value_type, dim):
 
 
 @pytest.mark.parametrize("value_type", VALUE_TYPES)
-def test_gathered_distance_matches_pairwise(value_type):
+def test_batched_gathered_distance_matches_pairwise(value_type):
     rng = np.random.default_rng(int(value_type))
-    q = _rand(value_type, (24,), rng)
-    cand = _rand(value_type, (9, 24), rng)
+    q = _rand(value_type, (3, 24), rng)
+    cand = _rand(value_type, (3, 9, 24), rng)
     base = base_of(value_type)
     for metric in (DistCalcMethod.L2, DistCalcMethod.Cosine):
-        got = np.asarray(D.gathered_distance(jnp.asarray(q),
-                                             jnp.asarray(cand), metric, base))
-        want = np.asarray(D.pairwise_distance(jnp.asarray(q[None]),
-                                              jnp.asarray(cand), metric,
-                                              value_type))[0]
-        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-3)
+        got = np.asarray(D.batched_gathered_distance(
+            jnp.asarray(q), jnp.asarray(cand), metric, base))
+        for i in range(3):
+            want = np.asarray(D.pairwise_distance(
+                jnp.asarray(q[i][None]), jnp.asarray(cand[i]), metric,
+                value_type))[0]
+            np.testing.assert_allclose(got[i], want, rtol=2e-5, atol=1e-3)
 
 
 def test_int_cosine_base_constants():
