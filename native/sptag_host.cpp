@@ -1,0 +1,206 @@
+// sptag_tpu native host components.
+//
+// The reference keeps its whole runtime in C++; in the TPU-native design the
+// device math lives in XLA/Pallas and the host runtime stays native where
+// the reference's is performance-critical.  This library provides:
+//
+//  * the parallel TSV ingestion parser — parity with
+//    Helper::DefaultReader's block subtasks
+//    (/root/reference/AnnService/src/Helper/VectorSetReaders/
+//    DefaultReader.cpp:200-320): "<meta>\t<v1>|<v2>|...\n" lines parsed
+//    into a row-major float32 matrix + metadata offsets, one block per
+//    thread;
+//  * the wire packet-header codec (inc/Socket/Packet.h:52-76) for
+//    high-throughput serving front doors.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this toolchain).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libsptag_host.so
+//        sptag_host.cpp -lpthread
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- TSV parse
+
+// Pass 1: count data lines (non-empty) in [buf, buf+len).
+long long sptag_count_lines(const char* buf, long long len) {
+    long long rows = 0;
+    const char* end = buf + len;
+    const char* p = buf;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* line_end = nl ? nl : end;
+        if (line_end > p && !(line_end - p == 1 && *p == '\r')) ++rows;
+        p = nl ? nl + 1 : end;
+    }
+    return rows;
+}
+
+namespace {
+
+struct BlockResult {
+    long long rows_filled = 0;
+    int dim_seen = 0;
+    int error = 0;
+};
+
+// Parse one block of lines into out[row0*dim ...]; metadata copied into
+// meta_buf at meta_offsets[global_row].  Caller sizes out for the counted
+// rows and meta_buf for the block's byte length (metadata is never longer
+// than its line).
+void parse_block(const char* buf, long long len, char delim, int dim,
+                 float* out, long long row0,
+                 char* meta_buf, long long meta_cap,
+                 long long* meta_lens, BlockResult* result) {
+    const char* end = buf + len;
+    const char* p = buf;
+    long long row = row0;
+    long long meta_used = 0;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* line_end = nl ? nl : end;
+        if (line_end > p && *(line_end - 1) == '\r') --line_end;
+        if (line_end <= p) {
+            p = nl ? nl + 1 : end;
+            continue;
+        }
+        const char* tab = static_cast<const char*>(
+            memchr(p, '\t', static_cast<size_t>(line_end - p)));
+        const char* vec_begin = p;
+        long long meta_len = 0;
+        if (tab) {
+            meta_len = tab - p;
+            vec_begin = tab + 1;
+        }
+        if (meta_len > 0 && meta_used + meta_len <= meta_cap) {
+            memcpy(meta_buf + meta_used, p, static_cast<size_t>(meta_len));
+        }
+        meta_lens[row] = meta_len;
+        meta_used += meta_len;
+
+        float* out_row = out + row * dim;
+        int d = 0;
+        const char* q = vec_begin;
+        while (q < line_end && d < dim) {
+            char* parse_end = nullptr;
+            float v = strtof(q, &parse_end);
+            if (parse_end == q) break;
+            out_row[d++] = v;
+            q = parse_end;
+            if (q < line_end && *q == delim) ++q;
+        }
+        if (d != dim) {
+            result->error = 1;
+            result->dim_seen = d;
+            return;
+        }
+        ++row;
+        p = nl ? nl + 1 : end;
+    }
+    result->rows_filled = row - row0;
+}
+
+}  // namespace
+
+// Parallel parse: splits [buf, len) into n_threads blocks on line
+// boundaries; fills out (rows x dim float32), meta_blob (concatenated
+// metadata bytes, caller-capacity len) and meta_lens (rows).  Returns rows
+// parsed, or -1 on malformed input (dimension mismatch).
+long long sptag_parse_tsv(const char* buf, long long len, char delim,
+                          int dim, int n_threads, float* out,
+                          char* meta_blob, long long* meta_lens) {
+    if (len <= 0 || dim <= 0) return 0;
+    if (n_threads < 1) n_threads = 1;
+
+    // block boundaries on line starts
+    std::vector<long long> bounds;
+    bounds.push_back(0);
+    long long step = len / n_threads;
+    for (int i = 1; i < n_threads; ++i) {
+        long long want = i * step;
+        if (want <= bounds.back()) continue;
+        const char* nl = static_cast<const char*>(
+            memchr(buf + want, '\n', static_cast<size_t>(len - want)));
+        if (!nl) break;
+        long long pos = (nl - buf) + 1;
+        if (pos > bounds.back() && pos < len) bounds.push_back(pos);
+    }
+    bounds.push_back(len);
+
+    const size_t n_blocks = bounds.size() - 1;
+    std::vector<long long> row_starts(n_blocks + 1, 0);
+    for (size_t b = 0; b < n_blocks; ++b) {
+        row_starts[b + 1] = row_starts[b]
+            + sptag_count_lines(buf + bounds[b], bounds[b + 1] - bounds[b]);
+    }
+
+    std::vector<BlockResult> results(n_blocks);
+    // per-block metadata staging: block b's metadata is <= its byte length
+    std::vector<std::vector<char>> staging(n_blocks);
+    std::vector<std::thread> threads;
+    threads.reserve(n_blocks);
+    for (size_t b = 0; b < n_blocks; ++b) {
+        staging[b].resize(static_cast<size_t>(bounds[b + 1] - bounds[b]));
+        threads.emplace_back(parse_block, buf + bounds[b],
+                             bounds[b + 1] - bounds[b], delim, dim, out,
+                             row_starts[b], staging[b].data(),
+                             static_cast<long long>(staging[b].size()),
+                             meta_lens, &results[b]);
+    }
+    for (auto& t : threads) t.join();
+    for (size_t b = 0; b < n_blocks; ++b) {
+        if (results[b].error) return -1;
+    }
+
+    // merge pass: concatenate metadata in row order
+    long long total_rows = row_starts[n_blocks];
+    long long off = 0;
+    for (size_t b = 0; b < n_blocks; ++b) {
+        long long staged = 0;
+        for (long long r = row_starts[b]; r < row_starts[b + 1]; ++r) {
+            memcpy(meta_blob + off, staging[b].data() + staged,
+                   static_cast<size_t>(meta_lens[r]));
+            off += meta_lens[r];
+            staged += meta_lens[r];
+        }
+    }
+    return total_rows;
+}
+
+// ------------------------------------------------------------ packet codec
+
+// 16-byte header: u8 type, u8 status, u32 bodyLength, u32 connectionID,
+// u32 resourceID, 2B pad (inc/Socket/Packet.h:52-76).
+void sptag_pack_header(std::uint8_t type, std::uint8_t status,
+                       std::uint32_t body_length,
+                       std::uint32_t connection_id,
+                       std::uint32_t resource_id, std::uint8_t* out16) {
+    out16[0] = type;
+    out16[1] = status;
+    memcpy(out16 + 2, &body_length, 4);
+    memcpy(out16 + 6, &connection_id, 4);
+    memcpy(out16 + 10, &resource_id, 4);
+    out16[14] = 0;
+    out16[15] = 0;
+}
+
+void sptag_unpack_header(const std::uint8_t* in16, std::uint8_t* type,
+                         std::uint8_t* status, std::uint32_t* body_length,
+                         std::uint32_t* connection_id,
+                         std::uint32_t* resource_id) {
+    *type = in16[0];
+    *status = in16[1];
+    memcpy(body_length, in16 + 2, 4);
+    memcpy(connection_id, in16 + 6, 4);
+    memcpy(resource_id, in16 + 10, 4);
+}
+
+}  // extern "C"

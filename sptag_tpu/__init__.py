@@ -28,6 +28,8 @@ import sptag_tpu.algo.flat  # noqa: F401  (IndexAlgoType.FLAT)
 import sptag_tpu.algo.bkt   # noqa: F401  (IndexAlgoType.BKT)
 import sptag_tpu.algo.kdt   # noqa: F401  (IndexAlgoType.KDT)
 
+from sptag_tpu.wrappers import AnnIndex, AnnClient  # noqa: E402,F401
+
 __version__ = "0.1.0"
 
 __all__ = [

@@ -1,0 +1,104 @@
+"""Loader for the native C++ host library (native/sptag_host.cpp).
+
+The reference's host runtime is C++ end to end; here the TPU compute path is
+XLA and the native library accelerates the host-side hot paths (parallel TSV
+ingestion, wire codec).  Built on demand with g++ (this toolchain has no
+pybind11 — plain C ABI + ctypes), cached next to the source, and every
+caller degrades gracefully to the pure-Python implementation when the
+library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "sptag_host.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libsptag_host.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-o", _LIB, _SRC, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.info("native host library build skipped: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native library, building it on first use; None if the
+    toolchain or source is unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if not os.path.exists(_LIB) or (os.path.getmtime(_LIB)
+                                        < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            log.info("native host library load failed: %s", e)
+            return None
+        lib.sptag_count_lines.restype = ctypes.c_longlong
+        lib.sptag_count_lines.argtypes = [ctypes.c_char_p,
+                                          ctypes.c_longlong]
+        lib.sptag_parse_tsv.restype = ctypes.c_longlong
+        lib.sptag_parse_tsv.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong)]
+        _lib = lib
+        return _lib
+
+
+def parse_tsv(blob: bytes, delimiter: str, dim: int, threads: int):
+    """Native parallel TSV parse -> (float32 (rows, dim), list of metadata
+    bytes), or None when the native library is unavailable or input is
+    malformed (caller falls back to Python parsing)."""
+    import numpy as np
+
+    lib = load()
+    if lib is None or dim <= 0:
+        return None
+    rows = lib.sptag_count_lines(blob, len(blob))
+    if rows <= 0:
+        return None
+    out = np.empty((rows, dim), np.float32)
+    meta_blob = ctypes.create_string_buffer(len(blob))
+    meta_lens = (ctypes.c_longlong * rows)()
+    got = lib.sptag_parse_tsv(
+        blob, len(blob), delimiter.encode()[:1], dim, threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        meta_blob, meta_lens)
+    if got < 0:
+        return None
+    out = out[:got]
+    metas = []
+    off = 0
+    raw = meta_blob.raw
+    for r in range(got):
+        n = meta_lens[r]
+        metas.append(raw[off:off + n])
+        off += n
+    return out, metas

@@ -1,0 +1,12 @@
+from sptag_tpu.serve.aggregator import (  # noqa: F401
+    AggregatorContext,
+    AggregatorService,
+)
+from sptag_tpu.serve.client import AnnClient  # noqa: F401
+from sptag_tpu.serve.protocol import parse_query  # noqa: F401
+from sptag_tpu.serve.server import SearchServer  # noqa: F401
+from sptag_tpu.serve.service import (  # noqa: F401
+    SearchExecutor,
+    ServiceContext,
+    ServiceSettings,
+)

@@ -1,0 +1,246 @@
+"""Aggregator — scatter-gather proxy over multiple search servers.
+
+Parity: AggregatorService/AggregatorContext (/root/reference/AnnService/src/
+Aggregator/AggregatorService.cpp, inc/Aggregator/AggregatorContext.h:29-61):
+
+* ini config: ``[Service]`` ListenAddr/ListenPort/Threads,
+  ``[Servers] Number=N`` + ``[Server_<i>] Address=/Port=`` (AggregatorContext
+  ctor);
+* a reconnect loop re-dials Disconnected servers every 30 s
+  (AggregatorService.cpp:139-194);
+* each incoming SearchRequest fans out to every Connected server with a
+  per-request timeout; the LAST finisher (atomic unfinished count,
+  AggregatorExecutionContext.h:21-43) assembles the response
+  (:206-304);
+* the merge is a FLAT CONCATENATION of every server's per-index result
+  lists — no global re-rank (:316-366); a timeout or network failure
+  downgrades the overall status to the per-request partial statuses
+  (Timeout / FailedNetwork, :242-262).
+
+(The intra-pod TPU equivalent of this whole file is
+sptag_tpu/parallel/sharded.py — one pjit program over ICI.  This module is
+the DCN/external edge for reference-topology deployments.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import List, Optional
+
+from sptag_tpu.serve import wire
+from sptag_tpu.utils.ini import IniReader
+
+log = logging.getLogger(__name__)
+
+RECONNECT_INTERVAL_S = 30.0
+
+
+@dataclasses.dataclass
+class RemoteServer:
+    address: str
+    port: int
+    reader: Optional[asyncio.StreamReader] = None
+    writer: Optional[asyncio.StreamWriter] = None
+
+    @property
+    def connected(self) -> bool:
+        return self.writer is not None and not self.writer.is_closing()
+
+
+class AggregatorContext:
+    def __init__(self, listen_addr: str = "0.0.0.0",
+                 listen_port: int = 8100,
+                 search_timeout_s: float = 9.0):
+        self.listen_addr = listen_addr
+        self.listen_port = listen_port
+        self.search_timeout_s = search_timeout_s
+        self.servers: List[RemoteServer] = []
+
+    @classmethod
+    def from_ini(cls, path: str) -> "AggregatorContext":
+        reader = IniReader.load(path)
+        ctx = cls(
+            listen_addr=reader.get_parameter("Service", "ListenAddr",
+                                             "0.0.0.0"),
+            listen_port=int(reader.get_parameter("Service", "ListenPort",
+                                                 "8100")),
+            search_timeout_s=float(reader.get_parameter(
+                "Service", "SearchTimeout", "9")),
+        )
+        count = int(reader.get_parameter("Servers", "Number", "0"))
+        for i in range(count):
+            section = f"Server_{i}"
+            addr = reader.get_parameter(section, "Address", "")
+            port = reader.get_parameter(section, "Port", "")
+            if addr and port:
+                ctx.servers.append(RemoteServer(addr, int(port)))
+        return ctx
+
+
+class AggregatorService:
+    def __init__(self, context: AggregatorContext):
+        self.context = context
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._locks: List[asyncio.Lock] = []
+
+    async def start(self, host: Optional[str] = None,
+                    port: Optional[int] = None):
+        self._locks = [asyncio.Lock() for _ in self.context.servers]
+        await self._connect_all()
+        self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+        host = host or self.context.listen_addr
+        port = port if port is not None else self.context.listen_port
+        self._server = await asyncio.start_server(self._on_client, host,
+                                                  port)
+        addr = self._server.sockets[0].getsockname()
+        log.info("aggregator listening on %s:%d", addr[0], addr[1])
+        return addr[0], addr[1]
+
+    async def stop(self) -> None:
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for s in self.context.servers:
+            if s.writer is not None:
+                s.writer.close()
+
+    # ---------------------------------------------------------- connections
+
+    async def _connect(self, server: RemoteServer) -> None:
+        try:
+            reader, writer = await asyncio.open_connection(
+                server.address, server.port)
+            # register handshake
+            writer.write(wire.PacketHeader(
+                wire.PacketType.RegisterRequest).pack())
+            await writer.drain()
+            head = await reader.readexactly(wire.HEADER_SIZE)
+            wire.PacketHeader.unpack(head)
+            server.reader = reader
+            server.writer = writer
+            log.info("aggregator connected to %s:%d", server.address,
+                     server.port)
+        except OSError:
+            server.reader = None
+            server.writer = None
+
+    async def _connect_all(self) -> None:
+        await asyncio.gather(*(self._connect(s)
+                               for s in self.context.servers
+                               if not s.connected))
+
+    async def _reconnect_loop(self) -> None:
+        """30 s re-dial of Disconnected servers
+        (AggregatorService.cpp:139-194)."""
+        while True:
+            await asyncio.sleep(RECONNECT_INTERVAL_S)
+            await self._connect_all()
+
+    # -------------------------------------------------------------- serving
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                head = await reader.readexactly(wire.HEADER_SIZE)
+                header = wire.PacketHeader.unpack(head)
+                body = (await reader.readexactly(header.body_length)
+                        if header.body_length else b"")
+                t = header.packet_type
+                if t == wire.PacketType.RegisterRequest:
+                    writer.write(wire.PacketHeader(
+                        wire.PacketType.RegisterResponse,
+                        wire.PacketProcessStatus.Ok, 0, 1,
+                        header.resource_id).pack())
+                    await writer.drain()
+                elif t == wire.PacketType.HeartbeatRequest:
+                    writer.write(wire.PacketHeader(
+                        wire.PacketType.HeartbeatResponse,
+                        wire.PacketProcessStatus.Ok, 0,
+                        header.connection_id, header.resource_id).pack())
+                    await writer.drain()
+                elif t == wire.PacketType.SearchRequest:
+                    result = await self._scatter_gather(body)
+                    rbody = result.pack()
+                    writer.write(wire.PacketHeader(
+                        wire.PacketType.SearchResponse,
+                        wire.PacketProcessStatus.Ok, len(rbody),
+                        header.connection_id, header.resource_id).pack()
+                        + rbody)
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def _scatter_gather(self, body: bytes) -> wire.RemoteSearchResult:
+        """Fan out to every Connected server; flat-merge the per-index
+        lists; degrade status on timeout/network failure
+        (AggregatorService.cpp:206-366)."""
+        targets = [(i, s) for i, s in enumerate(self.context.servers)
+                   if s.connected]
+        if not targets:
+            return wire.RemoteSearchResult(wire.ResultStatus.FailedNetwork,
+                                           [])
+        tasks = [self._query_one(i, s, body) for i, s in targets]
+        replies = await asyncio.gather(*tasks)
+        merged = wire.RemoteSearchResult(wire.ResultStatus.Success, [])
+        for status, results in replies:
+            if status != wire.ResultStatus.Success:
+                merged.status = status
+            merged.results.extend(results)
+        return merged
+
+    async def _query_one(self, idx: int, server: RemoteServer, body: bytes):
+        lock = self._locks[idx]
+        header = wire.PacketHeader(wire.PacketType.SearchRequest,
+                                   wire.PacketProcessStatus.Ok, len(body),
+                                   0, 1)
+        try:
+            async with lock:
+                server.writer.write(header.pack() + body)
+                await server.writer.drain()
+                rhead_raw = await asyncio.wait_for(
+                    server.reader.readexactly(wire.HEADER_SIZE),
+                    self.context.search_timeout_s)
+                rhead = wire.PacketHeader.unpack(rhead_raw)
+                rbody = (await asyncio.wait_for(
+                    server.reader.readexactly(rhead.body_length),
+                    self.context.search_timeout_s)
+                    if rhead.body_length else b"")
+            result = wire.RemoteSearchResult.unpack(rbody)
+            if result is None:
+                return wire.ResultStatus.FailedNetwork, []
+            return result.status, result.results
+        except asyncio.TimeoutError:
+            return wire.ResultStatus.Timeout, []
+        except OSError:
+            server.reader = None
+            server.writer = None
+            return wire.ResultStatus.FailedNetwork, []
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="sptag_tpu aggregator")
+    parser.add_argument("-c", "--config", required=True)
+    args = parser.parse_args(argv)
+    context = AggregatorContext.from_ini(args.config)
+
+    async def serve():
+        service = AggregatorService(context)
+        await service.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,151 @@
+"""Search client — remote query API over the wire protocol.
+
+Parity: ClientWrapper / client tool (/root/reference/AnnService/inc/Client/
+ClientWrapper.h:26-74, src/Client/main.cpp:13-78): connect (with the
+register handshake), send `RemoteQuery`, match the `SearchResponse` by
+resourceID, honor a per-call timeout (the reference uses Socket::
+ResourceManager's timeout thread, inc/Socket/ResourceManager.h:31-184 —
+here a socket timeout plays that role), expose results as
+(ids, dists, metas) per index.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from sptag_tpu.serve import wire
+
+
+class AnnClient:
+    def __init__(self, host: str, port: int,
+                 timeout_s: float = 9.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._next_resource = 1
+        self._remote_cid = wire.INVALID_CONNECTION_ID
+
+    # ------------------------------------------------------------ connection
+
+    def connect(self) -> None:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout_s)
+        sock.settimeout(self.timeout_s)
+        self._sock = sock
+        # register handshake (Connection.cpp:301-312, 367-371)
+        self._send(wire.PacketHeader(wire.PacketType.RegisterRequest), b"")
+        header, _ = self._recv()
+        if header.packet_type == wire.PacketType.RegisterResponse:
+            self._remote_cid = header.connection_id
+
+    @property
+    def is_connected(self) -> bool:
+        return self._sock is not None
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    # ---------------------------------------------------------------- search
+
+    def search(self, query: str,
+               timeout_s: Optional[float] = None) -> wire.RemoteSearchResult:
+        """Send one text-protocol query; returns the RemoteSearchResult
+        (status Timeout / FailedNetwork on failure, matching the
+        aggregator's partial-result statuses)."""
+        if self._sock is None:
+            self.connect()
+        with self._lock:
+            rid = self._next_resource
+            self._next_resource += 1
+            body = wire.RemoteQuery(query).pack()
+            header = wire.PacketHeader(
+                wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+                len(body), self._remote_cid, rid)
+            old_timeout = self._sock.gettimeout()
+            if timeout_s is not None:
+                self._sock.settimeout(timeout_s)
+            try:
+                self._send(header, body)
+                while True:
+                    rhead, rbody = self._recv()
+                    if rhead.packet_type == wire.PacketType.SearchResponse \
+                            and rhead.resource_id == rid:
+                        result = wire.RemoteSearchResult.unpack(rbody)
+                        return result if result is not None else \
+                            wire.RemoteSearchResult(
+                                wire.ResultStatus.FailedNetwork, [])
+            except socket.timeout:
+                return wire.RemoteSearchResult(wire.ResultStatus.Timeout, [])
+            except OSError:
+                self.close()
+                return wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedNetwork, [])
+            finally:
+                if self._sock is not None:
+                    self._sock.settimeout(old_timeout)
+
+    # ------------------------------------------------------------------- io
+
+    def _send(self, header: wire.PacketHeader, body: bytes) -> None:
+        header.body_length = len(body)
+        self._sock.sendall(header.pack() + body)
+
+    def _recv(self):
+        head = self._read_exact(wire.HEADER_SIZE)
+        header = wire.PacketHeader.unpack(head)
+        body = self._read_exact(header.body_length) \
+            if header.body_length else b""
+        return header, body
+
+    def _read_exact(self, n: int) -> bytes:
+        chunks = []
+        remaining = n
+        while remaining:
+            chunk = self._sock.recv(remaining)
+            if not chunk:
+                raise OSError("connection closed")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        return b"".join(chunks)
+
+
+def main(argv=None) -> int:
+    """Interactive remote query REPL (parity: src/Client/main.cpp:13-78)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="sptag_tpu client")
+    parser.add_argument("-s", "--server", default="127.0.0.1")
+    parser.add_argument("-p", "--port", type=int, default=8000)
+    parser.add_argument("-t", "--timeout", type=float, default=9.0)
+    args = parser.parse_args(argv)
+    client = AnnClient(args.server, args.port, args.timeout)
+    client.connect()
+    print("connected; enter queries (empty line quits)")
+    import sys
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            break
+        result = client.search(line)
+        print(f"status={wire.ResultStatus(result.status).name}")
+        for idx_res in result.results:
+            print(f"[{idx_res.index_name}]")
+            for rank, (vid, dist) in enumerate(
+                    zip(idx_res.ids, idx_res.dists)):
+                meta = ""
+                if idx_res.metas is not None:
+                    meta = " " + idx_res.metas[rank].decode("utf-8",
+                                                            "replace")
+                print(f"  {rank}: id={vid} dist={dist:.6g}{meta}")
+    client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

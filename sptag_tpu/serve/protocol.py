@@ -1,0 +1,114 @@
+"""Query text protocol — parser parity with the reference server.
+
+Parity: the QueryParser state machine (/root/reference/AnnService/src/Server/
+QueryParser.cpp:28-181) and SearchExecutionContext option extraction
+(src/Server/SearchExecutionContext.cpp:66-155):
+
+* ``$option:value`` (or ``$option=value``) tokens set options; names are
+  case-insensitive (lowercased while scanning);
+* ``#<base64>`` supplies the query vector as base64 of the raw value-type
+  bytes;
+* any other token is the vector in text form: elements separated by the
+  configured separator (default ``|``);
+* recognized options: ``indexname`` (comma-separated list), ``datatype``
+  (Int8/UInt8/Int16/Float), ``extractmetadata`` (true/false), ``resultnum``.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sptag_tpu.core.types import VectorValueType, dtype_of, enum_from_string
+
+DEFAULT_SEPARATOR = "|"
+
+
+@dataclasses.dataclass
+class ParsedQuery:
+    options: Dict[str, str]
+    vector_text: Optional[str] = None        # raw element string
+    vector_base64: Optional[str] = None
+
+    # ---- option accessors (SearchExecutionContext.cpp:66-109) -------------
+
+    @property
+    def index_names(self) -> List[str]:
+        raw = self.options.get("indexname", "")
+        return [s for s in (t.strip() for t in raw.split(",")) if s]
+
+    @property
+    def data_type(self) -> Optional[VectorValueType]:
+        raw = self.options.get("datatype")
+        if raw is None:
+            return None
+        try:
+            return enum_from_string(VectorValueType, raw)
+        except ValueError:
+            return None
+
+    @property
+    def extract_metadata(self) -> bool:
+        return self.options.get("extractmetadata", "").lower() in (
+            "true", "1", "yes")
+
+    @property
+    def result_num(self) -> Optional[int]:
+        raw = self.options.get("resultnum")
+        try:
+            return int(raw) if raw is not None else None
+        except ValueError:
+            return None
+
+    def extract_vector(self, value_type: VectorValueType,
+                       separator: str = DEFAULT_SEPARATOR
+                       ) -> Optional[np.ndarray]:
+        """SearchExecutionContext::ExtractVector (:112-155): text elements
+        or base64 of the raw value-type buffer."""
+        dt = dtype_of(value_type)
+        if self.vector_base64 is not None:
+            try:
+                raw = base64.b64decode(self.vector_base64, validate=False)
+            except Exception:
+                return None
+            if len(raw) == 0 or len(raw) % dt.itemsize:
+                return None
+            return np.frombuffer(raw, dtype=dt)
+        if self.vector_text is not None:
+            parts = [p for p in self.vector_text.split(separator) if p != ""]
+            if not parts:
+                return None
+            try:
+                vals = [float(p) for p in parts]
+            except ValueError:
+                return None
+            return np.asarray(vals).astype(dt)
+        return None
+
+
+def parse_query(text: str) -> ParsedQuery:
+    """Tokenize one query line (QueryParser.cpp:28-181): whitespace-
+    separated tokens; `$name:value` options, `#b64` vector, else text
+    vector.  The last vector token wins, matching the reference's single
+    vectorStrBegin/vectorBase64 slots."""
+    options: Dict[str, str] = {}
+    vector_text: Optional[str] = None
+    vector_b64: Optional[str] = None
+    for token in text.split():
+        if token.startswith("$"):
+            body = token[1:]
+            for sep in (":", "="):
+                if sep in body:
+                    name, value = body.split(sep, 1)
+                    options[name.lower()] = value
+                    break
+            else:
+                options[body.lower()] = ""
+        elif token.startswith("#"):
+            vector_b64 = token[1:]
+        else:
+            vector_text = token
+    return ParsedQuery(options, vector_text, vector_b64)

@@ -1,0 +1,206 @@
+"""Socket search server — asyncio front-end over the wire protocol.
+
+Parity: SearchService (/root/reference/AnnService/src/Server/
+SearchService.cpp:90-262) + Socket::Server (inc/Socket/Server.h:20-49,
+src/Socket/Connection.cpp): 16-byte packet framing, register handshake
+(Connection.cpp:351-371), heartbeat responses (:316-347), SearchRequest ->
+RemoteQuery body -> executor -> SearchResponse with RemoteSearchResult body;
+interactive stdin mode (SearchService.cpp:157-199).
+
+TPU reshape: instead of one worker thread per query (boost thread_pool,
+SearchService.cpp:114-130), concurrent requests are COALESCED — an asyncio
+micro-batcher drains whatever queries arrived within `batch_window_ms` and
+executes them as one device batch (service.SearchExecutor.execute_batch),
+which is how the hardware wants its load delivered.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from sptag_tpu.serve import wire
+from sptag_tpu.serve.service import SearchExecutor, ServiceContext
+
+log = logging.getLogger(__name__)
+
+
+class SearchServer:
+    def __init__(self, context: ServiceContext,
+                 batch_window_ms: float = 2.0,
+                 max_batch: int = 1024):
+        self.context = context
+        self.executor = SearchExecutor(context)
+        self.batch_window = batch_window_ms / 1000.0
+        self.max_batch = max_batch
+        self._next_cid = 1
+        self._conns: Dict[int, asyncio.StreamWriter] = {}
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._batcher_task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self, host: Optional[str] = None,
+                    port: Optional[int] = None) -> Tuple[str, int]:
+        host = host or self.context.settings.listen_addr
+        port = port if port is not None else self.context.settings.listen_port
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        self._batcher_task = asyncio.create_task(self._batcher())
+        addr = self._server.sockets[0].getsockname()
+        log.info("search server listening on %s:%d", addr[0], addr[1])
+        return addr[0], addr[1]
+
+    async def stop(self) -> None:
+        if self._batcher_task:
+            self._batcher_task.cancel()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------ connection
+
+    async def _on_client(self, reader: asyncio.StreamReader,
+                         writer: asyncio.StreamWriter) -> None:
+        cid = self._next_cid
+        self._next_cid += 1
+        self._conns[cid] = writer
+        try:
+            while True:
+                head = await reader.readexactly(wire.HEADER_SIZE)
+                header = wire.PacketHeader.unpack(head)
+                body = (await reader.readexactly(header.body_length)
+                        if header.body_length else b"")
+                await self._dispatch(cid, writer, header, body)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            self._conns.pop(cid, None)
+            writer.close()
+
+    async def _dispatch(self, cid: int, writer: asyncio.StreamWriter,
+                        header: wire.PacketHeader, body: bytes) -> None:
+        t = header.packet_type
+        if t == wire.PacketType.RegisterRequest:
+            # Connection::HandleRegisterRequest (Connection.cpp:351-363)
+            resp = wire.PacketHeader(wire.PacketType.RegisterResponse,
+                                     wire.PacketProcessStatus.Ok, 0, cid,
+                                     header.resource_id)
+            writer.write(resp.pack())
+            await writer.drain()
+        elif t == wire.PacketType.HeartbeatRequest:
+            resp = wire.PacketHeader(wire.PacketType.HeartbeatResponse,
+                                     wire.PacketProcessStatus.Ok, 0,
+                                     header.connection_id,
+                                     header.resource_id)
+            writer.write(resp.pack())
+            await writer.drain()
+        elif t == wire.PacketType.SearchRequest:
+            query = wire.RemoteQuery.unpack(body)
+            await self._queue.put((cid, header, query))
+        elif wire.is_request(t):
+            # HandleNoHandlerResponse (Connection.cpp:374-398)
+            resp = wire.PacketHeader(wire.response_type(t),
+                                     wire.PacketProcessStatus.Dropped, 0,
+                                     cid, header.resource_id)
+            writer.write(resp.pack())
+            await writer.drain()
+
+    # --------------------------------------------------------- batched serve
+
+    async def _batcher(self) -> None:
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = asyncio.get_event_loop().time() + self.batch_window
+            while len(batch) < self.max_batch:
+                timeout = deadline - asyncio.get_event_loop().time()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self._queue.get(), timeout))
+                except asyncio.TimeoutError:
+                    break
+            await self._serve_batch(batch)
+
+    async def _serve_batch(self, batch) -> None:
+        texts = []
+        for cid, header, query in batch:
+            texts.append(query.query if query is not None else "")
+        loop = asyncio.get_event_loop()
+        try:
+            results = await loop.run_in_executor(
+                None, self.executor.execute_batch, texts)
+        except Exception:
+            log.exception("batch execution failed")
+            results = [wire.RemoteSearchResult(
+                wire.ResultStatus.FailedExecute, [])] * len(batch)
+        for (cid, header, query), result in zip(batch, results):
+            writer = self._conns.get(cid)
+            if writer is None:
+                continue
+            if query is None:
+                result = wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedExecute, [])
+            body = result.pack()
+            resp = wire.PacketHeader(
+                wire.PacketType.SearchResponse,
+                wire.PacketProcessStatus.Ok, len(body), cid,
+                header.resource_id)
+            try:
+                writer.write(resp.pack() + body)
+                await writer.drain()
+            except ConnectionResetError:
+                pass
+
+
+def run_interactive(context: ServiceContext) -> None:
+    """Interactive stdin mode (SearchService.cpp:157-199)."""
+    executor = SearchExecutor(context)
+    import sys
+    print("sptag_tpu search server (interactive). Empty line quits.")
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            break
+        result = executor.execute(line)
+        print(f"status={wire.ResultStatus(result.status).name}")
+        for idx_res in result.results:
+            print(f"[{idx_res.index_name}]")
+            for rank, (vid, dist) in enumerate(
+                    zip(idx_res.ids, idx_res.dists)):
+                meta = ""
+                if idx_res.metas is not None:
+                    meta = " " + idx_res.metas[rank].decode("utf-8",
+                                                            "replace")
+                print(f"  {rank}: id={vid} dist={dist:.6g}{meta}")
+
+
+def main(argv=None) -> int:
+    """`python -m sptag_tpu.serve.server -m socket -c config.ini` — parity
+    with the reference server CLI (src/Server/main.cpp)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="sptag_tpu search server")
+    parser.add_argument("-c", "--config", required=True)
+    parser.add_argument("-m", "--mode", choices=("socket", "interactive"),
+                        default="interactive")
+    args = parser.parse_args(argv)
+    context = ServiceContext.from_ini(args.config)
+    if args.mode == "interactive":
+        run_interactive(context)
+        return 0
+
+    async def serve():
+        server = SearchServer(context)
+        await server.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

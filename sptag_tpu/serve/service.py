@@ -1,0 +1,195 @@
+"""Search service context + executor.
+
+Parity: ServiceContext/ServiceSettings (/root/reference/AnnService/src/
+Server/ServiceContext.cpp:13-61) — ini sections ``[Service]`` (ListenAddr,
+ListenPort, ThreadNumber, SocketThreadNumber), ``[QueryConfig]``
+(DefaultMaxResultNumber, DefaultSeparator) and ``[Index]``/``[Index_<name>]``
+(List=, IndexFolder=) — and SearchExecutor (src/Server/SearchExecutor.cpp:
+25-112): parse -> select indexes -> type/dim check -> SearchIndex per index
+-> RemoteSearchResult.
+
+TPU-first departure: the executor exposes `execute_batch` so the socket
+front-end can coalesce concurrent queries into one device batch (the
+reference runs one OpenMP thread per query instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from sptag_tpu.core.index import VectorIndex, load_index
+from sptag_tpu.serve.protocol import (
+    DEFAULT_SEPARATOR,
+    ParsedQuery,
+    parse_query,
+)
+from sptag_tpu.serve.wire import (
+    IndexSearchResult,
+    RemoteSearchResult,
+    ResultStatus,
+)
+from sptag_tpu.utils.ini import IniReader
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServiceSettings:
+    listen_addr: str = "0.0.0.0"
+    listen_port: int = 8000
+    thread_num: int = 8
+    socket_thread_num: int = 8
+    default_max_result: int = 10
+    vector_separator: str = DEFAULT_SEPARATOR
+
+
+class ServiceContext:
+    """Loads settings + named indexes from a service ini file."""
+
+    def __init__(self, settings: Optional[ServiceSettings] = None):
+        self.settings = settings or ServiceSettings()
+        self.indexes: Dict[str, VectorIndex] = {}
+
+    @classmethod
+    def from_ini(cls, path: str) -> "ServiceContext":
+        reader = IniReader.load(path)
+        s = ServiceSettings(
+            listen_addr=reader.get_parameter("Service", "ListenAddr",
+                                             "0.0.0.0"),
+            listen_port=int(reader.get_parameter("Service", "ListenPort",
+                                                 "8000")),
+            thread_num=int(reader.get_parameter("Service", "ThreadNumber",
+                                                "8")),
+            socket_thread_num=int(reader.get_parameter(
+                "Service", "SocketThreadNumber", "8")),
+            default_max_result=int(reader.get_parameter(
+                "QueryConfig", "DefaultMaxResultNumber", "10")),
+            vector_separator=reader.get_parameter(
+                "QueryConfig", "DefaultSeparator", DEFAULT_SEPARATOR),
+        )
+        ctx = cls(s)
+        index_list = reader.get_parameter("Index", "List", "")
+        for name in (t.strip() for t in index_list.split(",")):
+            if not name:
+                continue
+            folder = reader.get_parameter(f"Index_{name}", "IndexFolder", "")
+            if not folder:
+                continue
+            try:
+                ctx.indexes[name] = load_index(folder)
+                log.info("loaded index %s from %s", name, folder)
+            except Exception:
+                log.exception("Failed loading index: %s", name)
+        return ctx
+
+    def add_index(self, name: str, index: VectorIndex) -> None:
+        self.indexes[name] = index
+
+
+class SearchExecutor:
+    """Parity: SearchExecutor::Execute (SearchExecutor.cpp:25-112)."""
+
+    def __init__(self, context: ServiceContext):
+        self.context = context
+
+    def execute(self, query_text: str) -> RemoteSearchResult:
+        parsed = parse_query(query_text)
+        return self._run(parsed)
+
+    def _select_indexes(self, parsed: ParsedQuery) -> Dict[str, VectorIndex]:
+        names = parsed.index_names
+        if not names:
+            # singleton service: an unnamed query hits the only index
+            # (SearchExecutor.cpp:55-63)
+            if len(self.context.indexes) == 1:
+                return dict(self.context.indexes)
+            return {}
+        return {n: self.context.indexes[n] for n in names
+                if n in self.context.indexes}
+
+    def _run(self, parsed: ParsedQuery) -> RemoteSearchResult:
+        selected = self._select_indexes(parsed)
+        if not selected:
+            return RemoteSearchResult(ResultStatus.FailedExecute, [])
+        k = parsed.result_num or self.context.settings.default_max_result
+        out = RemoteSearchResult(ResultStatus.Success, [])
+        for name, index in selected.items():
+            vec = parsed.extract_vector(
+                parsed.data_type or index.value_type,
+                self.context.settings.vector_separator)
+            if vec is None or vec.shape[-1] != index.feature_dim:
+                return RemoteSearchResult(ResultStatus.FailedExecute, [])
+            try:
+                res = index.search(vec.astype(
+                    np.dtype(vec.dtype), copy=False), k=k,
+                    with_metadata=parsed.extract_metadata)
+            except Exception:
+                log.exception("search failed on index %s", name)
+                return RemoteSearchResult(ResultStatus.FailedExecute, [])
+            out.results.append(IndexSearchResult(
+                name, [int(v) for v in res.ids],
+                [float(d) for d in res.dists],
+                res.metas if parsed.extract_metadata else None))
+        return out
+
+    def execute_batch(self, query_texts: List[str]
+                      ) -> List[RemoteSearchResult]:
+        """Coalesced execution: groups parsed queries by (index set, k,
+        meta) and runs each group's vectors as ONE device batch."""
+        parsed = [parse_query(t) for t in query_texts]
+        results: List[Optional[RemoteSearchResult]] = [None] * len(parsed)
+        groups: Dict[tuple, List[int]] = {}
+        for i, p in enumerate(parsed):
+            sel = tuple(sorted(self._select_indexes(p)))
+            key = (sel, p.result_num
+                   or self.context.settings.default_max_result,
+                   p.extract_metadata)
+            groups.setdefault(key, []).append(i)
+        for (sel, k, with_meta), idxs in groups.items():
+            if not sel:
+                for i in idxs:
+                    results[i] = RemoteSearchResult(
+                        ResultStatus.FailedExecute, [])
+                continue
+            for name in sel:
+                index = self.context.indexes[name]
+                vecs = []
+                ok: List[int] = []
+                for i in idxs:
+                    v = parsed[i].extract_vector(
+                        parsed[i].data_type or index.value_type,
+                        self.context.settings.vector_separator)
+                    if v is None or v.shape[-1] != index.feature_dim:
+                        results[i] = RemoteSearchResult(
+                            ResultStatus.FailedExecute, [])
+                    else:
+                        vecs.append(v)
+                        ok.append(i)
+                if not ok:
+                    continue
+                try:
+                    dists, ids = index.search_batch(np.stack(vecs), k)
+                except Exception:
+                    log.exception("batch search failed on index %s", name)
+                    for i in ok:
+                        results[i] = RemoteSearchResult(
+                            ResultStatus.FailedExecute, [])
+                    continue
+                for row, i in enumerate(ok):
+                    metas = None
+                    if with_meta and index.metadata is not None:
+                        metas = [index.metadata.get_metadata(int(v))
+                                 if v >= 0 else b"" for v in ids[row]]
+                    if results[i] is None:
+                        results[i] = RemoteSearchResult(
+                            ResultStatus.Success, [])
+                    results[i].results.append(IndexSearchResult(
+                        name, [int(v) for v in ids[row]],
+                        [float(d) for d in dists[row]], metas))
+        return [r if r is not None
+                else RemoteSearchResult(ResultStatus.FailedExecute, [])
+                for r in results]

@@ -1,0 +1,59 @@
+"""Native C++ host library tests: build, parse parity with the Python
+parser, and the reader integration."""
+
+import numpy as np
+import pytest
+
+from sptag_tpu import native
+from sptag_tpu.core.types import VectorValueType
+from sptag_tpu.io.reader import ReaderOptions, VectorSetReader
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def test_native_count_lines(lib):
+    blob = b"a\t1|2\nb\t3|4\n\nc\t5|6"
+    assert lib.sptag_count_lines(blob, len(blob)) == 3
+
+
+def test_native_parse_matches_python(lib, tmp_path):
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((400, 16)).astype(np.float32)
+    metas = [f"meta-{i}".encode() for i in range(400)]
+    lines = []
+    for row, meta in zip(data, metas):
+        lines.append(meta + b"\t"
+                     + "|".join(repr(float(x)) for x in row).encode())
+    blob = b"\n".join(lines) + b"\n"
+
+    parsed = native.parse_tsv(blob, "|", 16, 4)
+    assert parsed is not None
+    vec, got_metas = parsed
+    np.testing.assert_allclose(vec, data, rtol=1e-6)
+    assert got_metas == metas
+
+
+def test_native_rejects_ragged(lib):
+    blob = b"a\t1|2|3\nb\t4|5\n"
+    assert native.parse_tsv(blob, "|", 3, 2) is None
+
+
+def test_reader_uses_native_and_matches(tmp_path):
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((200, 8)).astype(np.float32)
+    path = str(tmp_path / "x.tsv")
+    with open(path, "wb") as f:
+        for i, row in enumerate(data):
+            f.write(f"m{i}\t".encode()
+                    + "|".join(repr(float(x)) for x in row).encode() + b"\n")
+    reader = VectorSetReader(ReaderOptions(
+        value_type=VectorValueType.Float, dimension=8, thread_num=4))
+    assert reader.load_file(path)
+    np.testing.assert_allclose(reader.vectors, data, rtol=1e-6)
+    assert reader.metadata[13] == b"m13"

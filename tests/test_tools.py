@@ -1,0 +1,99 @@
+"""Reader + CLI tools tests (reference has none for its CLIs; the reader
+format follows DefaultReader semantics, SURVEY.md §2d S6-S8)."""
+
+import subprocess
+import sys
+
+import numpy as np
+
+import sptag_tpu as sp
+from sptag_tpu.core.types import VectorValueType
+from sptag_tpu.io import format as fmt
+from sptag_tpu.io.reader import ReaderOptions, VectorSetReader, load_vectors
+from sptag_tpu.tools import index_builder, index_searcher
+
+
+def _write_tsv(path, data, metas, delim="|"):
+    with open(path, "wb") as f:
+        for row, meta in zip(data, metas):
+            vec = delim.join(repr(float(x)) for x in row)
+            f.write(meta + b"\t" + vec.encode() + b"\n")
+
+
+def test_reader_parses_tsv_parallel(tmp_path):
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((500, 10)).astype(np.float32)
+    metas = [f"meta{i}".encode() for i in range(500)]
+    path = str(tmp_path / "vec.tsv")
+    _write_tsv(path, data, metas)
+
+    reader = VectorSetReader(ReaderOptions(
+        value_type=VectorValueType.Float, dimension=10, thread_num=8))
+    assert reader.load_file(path)
+    np.testing.assert_allclose(reader.vectors, data, rtol=1e-6)
+    assert reader.metadata == metas
+
+    # round-trip through the reference binary triple
+    reader.save(str(tmp_path))
+    back = fmt.read_matrix(str(tmp_path / "vectors.bin"), np.float32)
+    np.testing.assert_allclose(back, data, rtol=1e-6)
+    ms = sp.MetadataSet.load(str(tmp_path / "metadata.bin"),
+                             str(tmp_path / "metadataIndex.bin"))
+    assert ms.get_metadata(7) == b"meta7"
+
+
+def test_load_vectors_bin_prefix(tmp_path):
+    data = np.arange(24, dtype=np.float32).reshape(6, 4)
+    path = str(tmp_path / "v.bin")
+    fmt.write_matrix(path, data)
+    vs, meta = load_vectors("BIN:" + path, ReaderOptions(
+        value_type=VectorValueType.Float))
+    np.testing.assert_allclose(vs.data, data)
+    assert meta is None
+
+
+def test_builder_and_searcher_cli(tmp_path):
+    rng = np.random.default_rng(1)
+    centers = rng.standard_normal((8, 12)).astype(np.float32) * 4
+    data = (centers[rng.integers(0, 8, 300)]
+            + rng.standard_normal((300, 12)).astype(np.float32))
+    metas = [f"m{i}".encode() for i in range(300)]
+    tsv = str(tmp_path / "corpus.tsv")
+    _write_tsv(tsv, data, metas)
+
+    out = str(tmp_path / "index")
+    rc = index_builder.main([
+        "-d", "12", "-v", "Float", "-i", tsv, "-o", out, "-a", "BKT",
+        "-t", "4",
+        "Index.DistCalcMethod=L2", "Index.BKTKmeansK=8",
+        "Index.TPTNumber=4", "Index.TPTLeafSize=64",
+        "Index.NeighborhoodSize=16", "Index.CEF=64",
+        "Index.MaxCheckForRefineGraph=128", "Index.RefineIterations=1",
+        "Index.Samples=100", "Index.DenseClusterSize=64"])
+    assert rc == 0
+
+    # exact truth for recall
+    qs = data[:40]
+    diff = qs[:, None, :] - data[None, :, :]
+    exact = np.argsort((diff * diff).sum(-1), axis=1)[:, :5]
+    truth_path = str(tmp_path / "truth.txt")
+    with open(truth_path, "w") as f:
+        for row in exact:
+            f.write(" ".join(str(int(v)) for v in row) + "\n")
+    qtsv = str(tmp_path / "queries.tsv")
+    _write_tsv(qtsv, qs, [b""] * len(qs))
+
+    rc = index_searcher.main([
+        "-x", out, "-q", qtsv, "-r", truth_path, "-k", "5",
+        "-m", "256", "-o", str(tmp_path / "results.txt")])
+    assert rc == 0
+    lines = open(str(tmp_path / "results.txt")).read().splitlines()
+    assert len(lines) == 40
+    first = [int(t) for t in lines[0].split()]
+    assert first[0] == 0      # self-query
+
+
+def test_calc_recall():
+    ids = np.asarray([[0, 1, 2], [3, 4, 5]])
+    truth = [{0, 1, 9}, {9, 8, 7}]
+    assert index_searcher.calc_recall(ids, truth, 3) == (2 / 3 + 0) / 2

@@ -1,0 +1,70 @@
+"""AnnIndex/AnnClient wrapper surface tests — models the reference's
+documented wrapper usage (docs/GettingStart.md code samples; the SWIG layer
+itself ships untested in the reference, SURVEY.md §4)."""
+
+import numpy as np
+
+import sptag_tpu as sp
+from sptag_tpu.wrappers import AnnIndex
+
+
+def _data(n=300, d=10, seed=4):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((8, d)).astype(np.float32) * 4
+    return (centers[rng.integers(0, 8, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+
+
+def _small_params(idx: AnnIndex):
+    for name, value in [("DistCalcMethod", "L2"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "4"), ("TPTLeafSize", "64"),
+                        ("NeighborhoodSize", "16"), ("CEF", "64"),
+                        ("AddCEF", "32"), ("MaxCheckForRefineGraph", "128"),
+                        ("MaxCheck", "512"), ("RefineIterations", "1"),
+                        ("Samples", "100"), ("DenseClusterSize", "64")]:
+        idx.SetBuildParam(name, value)
+
+
+def test_wrapper_lifecycle_bytes_boundary(tmp_path):
+    data = _data()
+    idx = AnnIndex("BKT", "Float", 10)
+    _small_params(idx)
+    metas = b"\n".join(f"m{i}".encode() for i in range(len(data))) + b"\n"
+    # raw-bytes boundary, exactly like the SWIG typemaps
+    assert idx.BuildWithMetaData(data.tobytes(), metas, len(data), True)
+    assert idx.ReadyToServe()
+
+    res = idx.SearchWithMetaData(data[17].tobytes(), 5)
+    assert res.ids[0] == 17
+    assert res.metas[0] == b"m17"
+
+    batch = idx.BatchSearch(data[:6].tobytes(), 6, 3, True)
+    assert len(batch) == 6
+    assert batch[2].ids[0] == 2
+
+    assert idx.Add(data[:2] + 0.001, 2)
+    assert idx.DeleteByMetaData(b"m17")
+    res2 = idx.Search(data[17].tobytes(), 1)
+    assert res2.ids[0] != 17
+
+    folder = str(tmp_path / "widx")
+    assert idx.Save(folder)
+    loaded = AnnIndex.Load(folder)
+    res3 = loaded.Search(data[23].tobytes(), 1)
+    assert res3.ids[0] == 23
+
+
+def test_wrapper_merge(tmp_path):
+    data = _data(n=200)
+    a = AnnIndex("FLAT", "Float", 10)
+    a.SetBuildParam("DistCalcMethod", "L2")
+    assert a.Build(data[:100], 100)
+    b = AnnIndex("FLAT", "Float", 10)
+    b.SetBuildParam("DistCalcMethod", "L2")
+    assert b.Build(data[100:], 100)
+    fa, fb = str(tmp_path / "a"), str(tmp_path / "b")
+    assert a.Save(fa) and b.Save(fb)
+    merged = AnnIndex.Merge(fa, fb)
+    assert merged.index.num_samples == 200
+    res = merged.Search(data[150].tobytes(), 1)
+    assert res.dists[0] < 1e-4
