@@ -20,8 +20,9 @@ from sptag_tpu.core.types import (
     IndexAlgoType,
     VectorValueType,
 )
-from sptag_tpu.core.vectorset import VectorSet, MetadataSet
-from sptag_tpu.core.index import VectorIndex, create_instance, load_index
+from sptag_tpu.core.vectorset import VectorSet, MetadataSet, FileMetadataSet
+from sptag_tpu.core.index import (VectorIndex, create_instance, load_index,
+                                  load_index_blobs)
 
 # Importing algo modules registers them with the factory.
 import sptag_tpu.algo.flat  # noqa: F401  (IndexAlgoType.FLAT)
@@ -39,7 +40,9 @@ __all__ = [
     "VectorValueType",
     "VectorSet",
     "MetadataSet",
+    "FileMetadataSet",
     "VectorIndex",
     "create_instance",
     "load_index",
+    "load_index_blobs",
 ]

@@ -62,6 +62,11 @@ class BKTIndex(VectorIndex):
         self._dirty = True
         self._tombstones_dirty = False
         self._adds_since_rebuild = 0
+        self._rebuild_thread = None
+        self._rebuild_pending = False
+        # bumped whenever row ids are remapped (build / compaction) so an
+        # in-flight background rebuild can detect its snapshot went stale
+        self._structure_gen = 0
 
     def _make_params(self) -> BKTParams:
         return BKTParams()
@@ -196,6 +201,7 @@ class BKTIndex(VectorIndex):
         self._deleted = np.zeros(self._n, bool)
         self._num_deleted = 0
         self._adds_since_rebuild = 0
+        self._structure_gen += 1
 
         self._tree = self._new_tree()
         self._tree.build(self._host[:self._n])
@@ -255,13 +261,58 @@ class BKTIndex(VectorIndex):
         self._link_new_rows(engine, begin, count)
         self._adds_since_rebuild += count
         if self._adds_since_rebuild >= self.params.add_count_for_rebuild:
-            # reference queues an async RebuildJob (BKTIndex.cpp:39-49);
-            # here: synchronous forest rebuild + snapshot swap
-            self._tree = self._new_tree()
-            self._tree.build(self._host[:self._n])
             self._adds_since_rebuild = 0
+            self._schedule_rebuild()
         self._dirty = True
         return begin
+
+    # ---- background tree rebuild (P4) --------------------------------------
+
+    def _schedule_rebuild(self) -> None:
+        """Queue a tree-forest rebuild on a background thread — searches keep
+        serving on the current immutable snapshot while it runs (reference
+        RebuildJob on the thread pool, BKTIndex.cpp:39-49, ThreadPool.h:18).
+        Called under the writer lock.  At most one rebuild runs; a request
+        arriving mid-rebuild coalesces into one follow-up pass."""
+        import threading
+
+        # the worker clears _rebuild_thread under this same lock before it
+        # exits, so "thread slot occupied" and "worker will still see the
+        # pending flag" are one atomic condition (no lost-request TOCTOU)
+        if self._rebuild_thread is not None:
+            self._rebuild_pending = True
+            return
+        self._rebuild_pending = False
+        self._rebuild_thread = threading.Thread(
+            target=self._rebuild_job, daemon=True)
+        self._rebuild_thread.start()
+
+    def _rebuild_job(self) -> None:
+        while True:
+            with self._lock:
+                gen = self._structure_gen
+                n = self._n
+                snapshot = self._host[:n].copy()
+            tree = self._new_tree()
+            tree.build(snapshot)          # the long pass — no lock held
+            with self._lock:
+                # a compaction/rebuild remaps ids; drop a stale result
+                # (BKTree::Rebuild swaps under a unique_lock, BKTree.h:132-141)
+                if self._structure_gen == gen:
+                    self._tree = tree
+                    self._dirty = True    # pivot set changed
+                if not self._rebuild_pending:
+                    self._rebuild_thread = None   # exit decided under lock
+                    return
+                self._rebuild_pending = False
+
+    def wait_for_rebuild(self, timeout: Optional[float] = None) -> None:
+        """Block until any in-flight background rebuild completes (the
+        reference test waits with a sleep, AlgoTest.cpp:95; this is
+        deterministic)."""
+        t = self._rebuild_thread
+        if t is not None:
+            t.join(timeout)
 
     def _link_new_rows(self, engine: GraphSearchEngine, begin: int,
                        count: int) -> None:
@@ -295,61 +346,54 @@ class BKTIndex(VectorIndex):
                        -1)
         grown[begin:begin + count, :m] = sel
 
-        # reverse edges, one host insertion per (neighbor, new) pair
-        for i in range(count):
-            vid = begin + i
-            for j in range(m):
-                g = int(sel[i, j])
-                if g < 0:
-                    break
-                self._insert_neighbor(grown, g, vid,
-                                      float(d[i, int(keep[i, j])]))
+        # Reverse edges: batched RNG re-prune of every touched row, in ONE
+        # device pass.  Deliberate reshape of the reference's per-pair
+        # InsertNeighbors insertion sort under a per-row lock
+        # (RelativeNeighborhoodGraph.h:37-71): each target row's existing
+        # neighbors plus all its inserts are re-sorted by distance and
+        # re-pruned with the same RNG occlusion rule (RebuildNeighbors,
+        # :18-35) — applied uniformly, including to rows with empty slots,
+        # which the per-slot variant skipped.
+        pairs = sel >= 0                                    # (count, m)
+        if pairs.any():
+            tgt = sel[pairs].astype(np.int64)               # (P,) old nodes
+            vid = np.broadcast_to(
+                np.arange(begin, begin + count)[:, None], sel.shape)[pairs]
+            uniq, inv = np.unique(tgt, return_inverse=True)
+            U = len(uniq)
+            # pack each target's inserted ids into a (U, max_ins) pad table
+            order = np.argsort(inv, kind="stable")
+            sorted_inv = inv[order]
+            group_start = np.searchsorted(sorted_inv, np.arange(U))
+            pos = np.arange(len(tgt)) - group_start[sorted_inv]
+            max_ins = int(pos.max()) + 1
+            ins = np.full((U, max_ins), -1, np.int64)
+            ins[sorted_inv, pos] = vid[order]
+
+            cand = np.concatenate([grown[uniq].astype(np.int64), ins], axis=1)
+            valid = cand >= 0
+            cvecs = self._host[np.maximum(cand, 0)].astype(np.float32)
+            tvecs = self._host[uniq].astype(np.float32)
+            cd = np.asarray(graph_ops.node_candidate_dists(
+                jnp.asarray(tvecs), jnp.asarray(cvecs),
+                int(self.dist_calc_method), self.base))
+            cd = np.where(valid, cd, np.float32(MAX_DIST))
+            ordc = np.argsort(cd, axis=1, kind="stable")
+            cand_s = np.take_along_axis(cand, ordc, axis=1)
+            cd_s = np.take_along_axis(cd, ordc, axis=1)
+            valid_s = np.take_along_axis(valid, ordc, axis=1)
+            keep_r = np.asarray(graph_ops.rng_select(
+                jnp.asarray(tvecs),
+                jnp.asarray(np.take_along_axis(
+                    cvecs, ordc[:, :, None], axis=1)),
+                jnp.asarray(cd_s), jnp.asarray(valid_s), grown.shape[1],
+                int(self.dist_calc_method), self.base))
+            new_rows = np.where(
+                keep_r >= 0,
+                np.take_along_axis(cand_s, np.maximum(keep_r, 0), axis=1),
+                -1).astype(np.int32)
+            grown[uniq] = new_rows
         self._graph.graph = grown
-
-    def _insert_neighbor(self, graph: np.ndarray, node: int, insert_id: int,
-                         insert_dist: float) -> None:
-        """Parity: RelativeNeighborhoodGraph::InsertNeighbors
-        (RelativeNeighborhoodGraph.h:37-71): keep `node`'s row distance-
-        sorted, reject an insert occluded by an earlier neighbor, shift the
-        tail while each shifted neighbor stays non-occluded by the insert."""
-        row = graph[node]
-        m = len(row)
-        nv = self._host[node].astype(np.float32)
-        iv = self._host[insert_id].astype(np.float32)
-        for k in range(m):
-            tmp = int(row[k])
-            if tmp == insert_id:
-                return
-            if tmp < 0:
-                row[k] = insert_id
-                return
-            tmp_dist = self._row_dist(nv, self._host[tmp])
-            if tmp_dist > insert_dist or (tmp_dist == insert_dist
-                                          and insert_id < tmp):
-                for t in range(k):
-                    if self._row_dist(iv, self._host[int(row[t])]) \
-                            < insert_dist:
-                        return
-                carry = tmp
-                row[k] = insert_id
-                kk = k
-                while carry >= 0 and kk + 1 < m:
-                    kk += 1
-                    if self._row_dist(self._host[carry].astype(np.float32),
-                                      self._host[insert_id]) < \
-                            self._row_dist(nv, self._host[carry]):
-                        break
-                    carry, row[kk] = int(row[kk]), carry
-                return
-
-    def _row_dist(self, a, b) -> float:
-        """Host scalar distance matching the device convention."""
-        af = np.asarray(a, np.float32)
-        bf = np.asarray(b, np.float32)
-        if int(self.dist_calc_method) == 1:
-            return float(self.base) * float(self.base) - float(af @ bf)
-        diff = af - bf
-        return float(diff @ diff)
 
     def _delete_id(self, vid: int) -> bool:
         if self._deleted[vid]:
@@ -366,6 +410,7 @@ class BKTIndex(VectorIndex):
         """Parity: BKT::RefineIndex (BKTIndex.cpp:308-398): drop tombstoned
         rows, remap ids, rebuild the tree forest, re-run one graph refine
         pass over the compacted corpus."""
+        self._structure_gen += 1     # invalidate in-flight background rebuild
         keep = np.flatnonzero(~self._deleted[:self._n])
         remap = np.full(self._n, -1, np.int64)
         remap[keep] = np.arange(len(keep))
@@ -399,33 +444,62 @@ class BKTIndex(VectorIndex):
 
     # ---- persistence ------------------------------------------------------
 
-    def _save_index_data(self, folder: str) -> None:
+    def _blob_writers(self):
+        """Blob order parity: vectors, tree, graph, deletes
+        (SaveIndexDataFromMemory, reference BKTIndex.cpp:64-77)."""
         p = self.params
-        fmt.write_matrix(os.path.join(folder, p.vector_file),
-                         self._host[:self._n])
-        self._tree.save(os.path.join(folder, p.tree_file))
-        fmt.write_graph(os.path.join(folder, p.graph_file),
-                        self._graph.graph)
-        fmt.write_deletes(os.path.join(folder, p.delete_file),
-                          self._deleted[:self._n])
+        return [
+            (p.vector_file,
+             lambda f: fmt.write_matrix(f, self._host[:self._n])),
+            (p.tree_file, lambda f: self._tree.save(f)),
+            (p.graph_file, lambda f: fmt.write_graph(f, self._graph.graph)),
+            (p.delete_file,
+             lambda f: fmt.write_deletes(f, self._deleted[:self._n])),
+        ]
 
-    def _load_index_data(self, folder: str) -> None:
-        p = self.params
-        data = fmt.read_matrix(os.path.join(folder, p.vector_file),
-                               dtype_of(self.value_type))
+    def _load_vectors_stream(self, f) -> None:
+        data = fmt.read_matrix(f, dtype_of(self.value_type))
         self._host = np.ascontiguousarray(data)
         self._n = data.shape[0]
         self._deleted = np.zeros(self._n, bool)
         self._num_deleted = 0
-        delete_path = os.path.join(folder, p.delete_file)
-        if os.path.exists(delete_path):
-            mask = fmt.read_deletes(delete_path)
-            self._deleted[:len(mask)] = mask[:self._n]
-            self._num_deleted = int(self._deleted.sum())
-        self._tree = self._load_tree(os.path.join(folder, p.tree_file))
-        self._graph = self._new_graph()
-        self._graph.graph = fmt.read_graph(
-            os.path.join(folder, p.graph_file))
-        self._graph.neighborhood_size = self._graph.graph.shape[1]
         self._adds_since_rebuild = 0
+        self._structure_gen += 1     # invalidate in-flight background rebuild
+
+    def _load_tree_stream(self, f) -> None:
+        self._tree = self._load_tree(f)
+
+    def _load_graph_stream(self, f) -> None:
+        self._graph = self._new_graph()
+        self._graph.graph = fmt.read_graph(f)
+        self._graph.neighborhood_size = self._graph.graph.shape[1]
         self._dirty = True
+
+    def _load_deletes_stream(self, f) -> None:
+        mask = fmt.read_deletes(f)
+        self._deleted[:len(mask)] = mask[:self._n]
+        self._num_deleted = int(self._deleted.sum())
+
+    def _blob_loaders(self):
+        p = self.params
+        return [
+            (p.vector_file, self._load_vectors_stream, False),
+            (p.tree_file, self._load_tree_stream, False),
+            (p.graph_file, self._load_graph_stream, False),
+            (p.delete_file, self._load_deletes_stream, True),
+        ]
+
+    def _save_index_data(self, folder: str) -> None:
+        for name, writer in self._blob_writers():
+            with open(os.path.join(folder, name), "wb") as f:
+                writer(f)
+
+    def _load_index_data(self, folder: str) -> None:
+        for name, loader, optional in self._blob_loaders():
+            path = os.path.join(folder, name)
+            if not os.path.exists(path):
+                if optional:
+                    continue
+                raise FileNotFoundError(path)
+            with open(path, "rb") as f:
+                loader(f)

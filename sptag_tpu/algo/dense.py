@@ -37,7 +37,7 @@ from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.utils import query_bucket, round_up
 
-MAX_DIST = jnp.float32(3.4e38)
+MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
 # score-buffer budget per kernel call (bytes): Q * nprobe * P * D * 4
 _GATHER_BUDGET = 1 << 28

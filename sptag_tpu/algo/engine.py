@@ -52,7 +52,7 @@ from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.utils import query_bucket
 
-MAX_DIST = jnp.float32(3.4e38)
+MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
 # visited-table memory budget per search call (bytes)
 _VISITED_BUDGET = 1 << 29

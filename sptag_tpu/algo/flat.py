@@ -199,18 +199,39 @@ class FlatIndex(VectorIndex):
             self.build_meta_mapping()
         self._dirty = True
 
+    def _blob_writers(self):
+        return [
+            (self.params.vector_file,
+             lambda f: fmt.write_matrix(f, self._host[:self._n])),
+            (self.params.delete_file,
+             lambda f: fmt.write_deletes(f, self._deleted[:self._n])),
+        ]
+
+    def _load_vectors_stream(self, f) -> None:
+        self._build(fmt.read_matrix(f, dtype_of(self.value_type)))
+
+    def _load_deletes_stream(self, f) -> None:
+        mask = fmt.read_deletes(f)
+        self._deleted[:len(mask)] = mask
+        self._num_deleted = int(mask.sum())
+
+    def _blob_loaders(self):
+        return [
+            (self.params.vector_file, self._load_vectors_stream, False),
+            (self.params.delete_file, self._load_deletes_stream, True),
+        ]
+
     def _save_index_data(self, folder: str) -> None:
-        fmt.write_matrix(os.path.join(folder, self.params.vector_file),
-                         self._host[:self._n])
-        fmt.write_deletes(os.path.join(folder, self.params.delete_file),
-                          self._deleted[:self._n])
+        for name, writer in self._blob_writers():
+            with open(os.path.join(folder, name), "wb") as f:
+                writer(f)
 
     def _load_index_data(self, folder: str) -> None:
-        data = fmt.read_matrix(os.path.join(folder, self.params.vector_file),
-                               dtype_of(self.value_type))
-        self._build(data)
-        delete_path = os.path.join(folder, self.params.delete_file)
-        if os.path.exists(delete_path):
-            mask = fmt.read_deletes(delete_path)
-            self._deleted[:len(mask)] = mask
-            self._num_deleted = int(mask.sum())
+        for name, loader, optional in self._blob_loaders():
+            path = os.path.join(folder, name)
+            if not os.path.exists(path):
+                if optional:
+                    continue
+                raise FileNotFoundError(path)
+            with open(path, "rb") as f:
+                loader(f)

@@ -387,7 +387,67 @@ class VectorIndex(abc.ABC):
             self._save_index_data(folder)
         return ErrorCode.Success
 
-    def load_index_data(self, folder: str, reader: IniReader) -> None:
+    # ---- in-memory blob persistence (embedding-host path) -----------------
+
+    def _blob_writers(self):
+        """Ordered (name, write(stream)) pairs for the index's binary blobs.
+        Subclasses override; shared by folder save and blob save."""
+        raise NotImplementedError
+
+    def _blob_loaders(self):
+        """Ordered (name, load(stream), optional) triples mirroring
+        `_blob_writers`."""
+        raise NotImplementedError
+
+    def save_index_blobs(self) -> Tuple[str, List[bytes]]:
+        """Serialize the whole index into caller-held memory buffers — the
+        reference's embedding-host path, SaveIndex(config, blobs)
+        (VectorIndex.cpp:126-158).  Returns (config_str, blobs) with blobs
+        ordered [vectors, <index structures...>, deletes][, metadata,
+        metadataIndex]; each blob is byte-identical to its folder file."""
+        import io as _io
+
+        with self._lock:
+            if self.need_refine:
+                self._refine_impl()
+            config = self.save_index_config()
+            blobs: List[bytes] = []
+            for _name, writer in self._blob_writers():
+                buf = _io.BytesIO()
+                writer(buf)
+                blobs.append(buf.getvalue())
+            if self.metadata is not None:
+                mb, ib = _io.BytesIO(), _io.BytesIO()
+                self.metadata.save(mb, ib)
+                blobs.extend([mb.getvalue(), ib.getvalue()])
+        return config, blobs
+
+    def load_index_blobs_data(self, config: str,
+                              blobs: Sequence[bytes]) -> None:
+        """Counterpart of `save_index_blobs` for an existing instance;
+        module-level `load_index_blobs` is the factory entry point
+        (reference LoadIndex from blobs, VectorIndex.cpp:364-400)."""
+        import io as _io
+
+        reader = IniReader.loads(config)
+        self.params.load_config(reader.section_items("Index"))
+        pos = 0
+        for _name, loader, optional in self._blob_loaders():
+            if pos >= len(blobs):
+                if optional:
+                    continue
+                raise ValueError(f"missing index blob #{pos} ({_name})")
+            loader(_io.BytesIO(blobs[pos]))
+            pos += 1
+        if reader.does_section_exist("MetaData") and pos + 1 < len(blobs):
+            self.metadata = MetadataSet.load(_io.BytesIO(blobs[pos]),
+                                             _io.BytesIO(blobs[pos + 1]))
+            if reader.get_parameter("MetaData", "MetaDataToVectorIndex",
+                                    "") == "true":
+                self.build_meta_mapping()
+
+    def load_index_data(self, folder: str, reader: IniReader,
+                        lazy_metadata: bool = False) -> None:
         self.params.load_config(reader.section_items("Index"))
         self._load_index_data(folder)
         if reader.does_section_exist("MetaData"):
@@ -395,21 +455,43 @@ class VectorIndex(abc.ABC):
                 "MetaData", "MetaDataFilePath", self._meta_file)
             self._meta_index_file = reader.get_parameter(
                 "MetaData", "MetaDataIndexPath", self._meta_index_file)
-            self.metadata = MetadataSet.load(
-                os.path.join(folder, self._meta_file),
-                os.path.join(folder, self._meta_index_file))
+            meta_path = os.path.join(folder, self._meta_file)
+            index_path = os.path.join(folder, self._meta_index_file)
+            if lazy_metadata:
+                # FileMetadataSet: offsets resident, payload read on demand
+                # (reference inc/Core/MetadataSet.h:46)
+                from sptag_tpu.core.vectorset import FileMetadataSet
+                self.metadata = FileMetadataSet(meta_path, index_path)
+            else:
+                self.metadata = MetadataSet.load(meta_path, index_path)
             if reader.get_parameter("MetaData", "MetaDataToVectorIndex",
                                     "") == "true":
                 self.build_meta_mapping()
 
 
-def load_index(folder: str) -> VectorIndex:
-    """Parity: VectorIndex::LoadIndex(folder) (VectorIndex.cpp:324-360)."""
+def load_index(folder: str, lazy_metadata: bool = False) -> VectorIndex:
+    """Parity: VectorIndex::LoadIndex(folder) (VectorIndex.cpp:324-360).
+    `lazy_metadata=True` loads metadata as a FileMetadataSet (offsets only
+    resident; payload read per lookup)."""
     reader = IniReader.load(os.path.join(folder, "indexloader.ini"))
     algo = reader.get_parameter("Index", "IndexAlgoType")
     value_type = reader.get_parameter("Index", "ValueType")
     if algo is None or value_type is None:
         raise ValueError("indexloader.ini missing IndexAlgoType/ValueType")
     index = create_instance(algo, value_type)
-    index.load_index_data(folder, reader)
+    index.load_index_data(folder, reader, lazy_metadata=lazy_metadata)
+    return index
+
+
+def load_index_blobs(config: str, blobs: Sequence[bytes]) -> VectorIndex:
+    """Load an index entirely from memory buffers produced by
+    `save_index_blobs` — zero filesystem use (reference LoadIndex from
+    blobs, VectorIndex.cpp:364-400)."""
+    reader = IniReader.loads(config)
+    algo = reader.get_parameter("Index", "IndexAlgoType")
+    value_type = reader.get_parameter("Index", "ValueType")
+    if algo is None or value_type is None:
+        raise ValueError("config missing IndexAlgoType/ValueType")
+    index = create_instance(algo, value_type)
+    index.load_index_blobs_data(config, blobs)
     return index

@@ -123,6 +123,90 @@ class MetadataSet:
         return cls.from_lines(blob, offsets.tolist())
 
 
+class FileMetadataSet(MetadataSet):
+    """Lazy file-backed metadata: only the (count+1) offset table is held in
+    memory; each `get_metadata` seeks and reads its payload from disk.
+
+    Parity: reference `FileMetadataSet` (inc/Core/MetadataSet.h:46,
+    src/Core/MetadataSet.cpp) — the variant used when the metadata blob is
+    too large to keep resident (LAION-400M-class configs, BASELINE.md).
+    Mutations (add) are held in memory and merged on `save`, like the
+    reference's m_newdata staging.
+    """
+
+    def __init__(self, meta_path: str, index_path: str):
+        super().__init__()
+        self._meta_path = meta_path
+        self._file = open(meta_path, "rb")
+        from sptag_tpu.io import format as fmt
+        with fmt.open_read(index_path) as f:
+            idx = f.read()
+        (self._count,) = struct.unpack_from("<i", idx, 0)
+        self._offsets = np.frombuffer(
+            idx, dtype=np.uint64, count=self._count + 1,
+            offset=4).astype(np.int64)
+
+    @property
+    def count(self) -> int:
+        return self._count + len(self._metas)
+
+    def get_metadata(self, i: int) -> bytes:
+        if i < 0 or i >= self.count:
+            return b""
+        if i >= self._count:                     # staged in-memory add
+            return self._metas[i - self._count]
+        start = int(self._offsets[i])
+        end = int(self._offsets[i + 1])
+        self._file.seek(start)
+        return self._file.read(end - start)
+
+    def refine(self, indices: Sequence[int]) -> MetadataSet:
+        # compaction materializes the survivors (they are a strict subset)
+        return MetadataSet(self.get_metadata(i) for i in indices)
+
+    def save(self, meta_path_or_stream, index_path_or_stream) -> None:
+        import os
+        from sptag_tpu.io import format as fmt
+
+        # Saving over the backing file would truncate it while get_metadata
+        # still reads from the stale handle — materialize every payload
+        # BEFORE opening the target for write.  (Streams and unrelated paths
+        # stream one payload at a time.)
+        in_place = isinstance(meta_path_or_stream, str) and \
+            os.path.realpath(meta_path_or_stream) == \
+            os.path.realpath(self._meta_path)
+        staged = [self.get_metadata(i) for i in range(self.count)] \
+            if in_place else None
+
+        sizes = []
+        with fmt.open_write(meta_path_or_stream) as f:
+            for i in range(self.count):
+                m = staged[i] if staged is not None else self.get_metadata(i)
+                sizes.append(len(m))
+                f.write(m)
+        offsets = np.zeros(self.count + 1, dtype=np.uint64)
+        np.cumsum(sizes, out=offsets[1:])
+        with fmt.open_write(index_path_or_stream) as f:
+            f.write(struct.pack("<i", self.count) + offsets.tobytes())
+
+        if in_place:
+            # rebind to the rewritten file: staged adds are now on disk
+            self._file.close()
+            self._file = open(self._meta_path, "rb")
+            self._count = len(offsets) - 1
+            self._offsets = offsets.astype(np.int64)
+            self._metas = []
+
+    def close(self) -> None:
+        self._file.close()
+
+    def __del__(self):                            # pragma: no cover
+        try:
+            self._file.close()
+        except Exception:
+            pass
+
+
 def metadata_from_texts(texts: Iterable[Union[str, bytes]]) -> MetadataSet:
     return MetadataSet(
         t.encode() if isinstance(t, str) else bytes(t) for t in texts)

@@ -26,8 +26,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-MAX_DIST = jnp.float32(3.4e38)
+MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
 
 def _batch_pairwise(a: jax.Array, b: jax.Array, metric: int,
@@ -111,6 +112,15 @@ def merge_candidates(cand_ids: jax.Array, cand_d: jax.Array,
     out_ids = jnp.take_along_axis(ids_s, pos, axis=1)
     out_ids = jnp.where(out_d >= MAX_DIST, -1, out_ids)
     return out_ids.astype(jnp.int32), out_d
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "base"))
+def node_candidate_dists(node_vecs: jax.Array, cand_vecs: jax.Array,
+                         metric: int, base: int) -> jax.Array:
+    """(U, D) node vectors x (U, C, D) per-node candidates -> (U, C)
+    distances — one batched contraction feeding `rng_select`."""
+    return _batch_pairwise(node_vecs[:, None, :], cand_vecs, metric,
+                           base)[:, 0, :]
 
 
 @functools.partial(jax.jit, static_argnames=("m", "metric", "base"))

@@ -30,8 +30,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-MAX_DIST = jnp.float32(3.4e38)
+MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
 
 def _pairwise(data: jax.Array, centers: jax.Array, metric: int,

@@ -140,3 +140,206 @@ class ShardedFlatIndex:
             ids = np.concatenate(
                 [ids, np.full((q, k - k_final), -1, np.int32)], 1)
         return dists, ids
+
+
+# --------------------------------------------------------------------------
+# Sharded GRAPH search — the flagship BKT/KDT engine over a mesh
+# --------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k_local", "k_final", "L", "B", "T", "metric", "base",
+                     "nbp_limit", "mesh"))
+def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
+                         pivot_mask, queries, k_local: int, k_final: int,
+                         L: int, B: int, T: int,
+                         metric: int, base: int, nbp_limit: int, mesh: Mesh):
+    """One program: per-shard pivot-seeded beam walk over the shard's OWN
+    RNG graph (local ids), then ICI all-gather of each shard's (dist,
+    global-id) top-k and a global top-k re-rank.  This subsumes the
+    reference's Server-per-shard + Aggregator flat-merge topology
+    (AggregatorService.cpp:206-366) — and re-ranks globally, which the
+    reference leaves to the client."""
+    from sptag_tpu.algo.engine import _beam_search_kernel
+
+    def local_search(data_s, sqnorm_s, graph_s, deleted_s, pids_s, pvecs_s,
+                     pmask_s, q_s):
+        n_local = data_s.shape[0]
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        d, ids = _beam_search_kernel(
+            data_s, sqnorm_s, graph_s, deleted_s, pids_s[0], pvecs_s[0],
+            pmask_s[0], q_s, k_local, L, B, T, metric, base, nbp_limit)
+        gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
+        all_d = jax.lax.all_gather(d, SHARD_AXIS, axis=1, tiled=True)
+        all_i = jax.lax.all_gather(gids, SHARD_AXIS, axis=1, tiled=True)
+        gneg, gpos = jax.lax.top_k(-all_d, k_final)
+        gd = -gneg
+        gi = jnp.take_along_axis(all_i, gpos, axis=1)
+        gi = jnp.where(gd >= jnp.float32(MAX_DIST), -1, gi)
+        return gd, gi
+
+    return jax.shard_map(
+        local_search,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None),
+                  P(SHARD_AXIS), P(SHARD_AXIS, None),
+                  P(SHARD_AXIS, None, None), P(SHARD_AXIS, None),
+                  P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs, pivot_mask,
+      queries)
+
+
+class ShardedBKTIndex:
+    """The flagship graph index, corpus-sharded over a device mesh.
+
+    Each device holds an INDEPENDENT shard index — its block of the corpus
+    plus a BKT forest + RNG graph built over that block with shard-local
+    ids — exactly as each reference Server owns an independent index over
+    its partition.  Search runs the batched beam walk on every shard
+    simultaneously inside one `shard_map` program and merges with an
+    all-gather + `lax.top_k` over ICI (SURVEY.md §7.9, milestone C).
+
+    Across hosts the same program runs under multi-host jax.distributed
+    over DCN.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.metric = DistCalcMethod.L2
+        self.base = 1
+        self.n = 0
+        self.n_local = 0
+        self.max_check = 2048
+        self.nbp_limit = 3
+
+    @classmethod
+    def build(cls, data: np.ndarray,
+              metric: DistCalcMethod = DistCalcMethod.L2,
+              mesh: Optional[Mesh] = None,
+              value_type=None,
+              params: Optional[dict] = None) -> "ShardedBKTIndex":
+        """Partition `data` into contiguous equal blocks, build one BKT
+        sub-index per shard (host-side, device-batched k-means/graph build),
+        and lay the per-shard arrays out over the mesh."""
+        from sptag_tpu.algo.bkt import BKTIndex
+        from sptag_tpu.core.types import value_type_of
+
+        self = cls(mesh)
+        self.metric = DistCalcMethod(metric)
+        n_dev = self.mesh.devices.size
+        n = data.shape[0]
+        if n < n_dev:
+            raise ValueError(f"corpus ({n}) smaller than mesh ({n_dev})")
+        n_local = -(-n // n_dev)
+        self.n = n
+        self.n_local = n_local
+
+        if value_type is None:
+            value_type = value_type_of(np.asarray(data).dtype)
+
+        blocks_data, blocks_graph, blocks_del = [], [], []
+        blocks_pid, blocks_pvec, blocks_pmask = [], [], []
+        m_width = 0
+        shard_indexes = []
+        for s in range(n_dev):
+            block = np.asarray(data[s * n_local:(s + 1) * n_local])
+            sub = BKTIndex(value_type)
+            sub.set_parameter("DistCalcMethod",
+                              "Cosine" if self.metric ==
+                              DistCalcMethod.Cosine else "L2")
+            for name, value in (params or {}).items():
+                sub.set_parameter(name, str(value))
+            sub.build(block)
+            shard_indexes.append(sub)
+            m_width = max(m_width, sub._graph.graph.shape[1])
+        self.base = shard_indexes[0].base
+        self.params = shard_indexes[0].params
+
+        from sptag_tpu.algo.engine import _num_words
+        words = _num_words(n_local)
+        max_p = max(len(sub._pivot_ids()) for sub in shard_indexes)
+        for s, sub in enumerate(shard_indexes):
+            nb = sub._n
+            # rows are normalized at ingest for cosine — take the INDEX's
+            # copy, not the raw input block
+            block = np.zeros((n_local, data.shape[1]), sub._host.dtype)
+            block[:nb] = sub._host[:nb]
+            g = np.full((n_local, m_width), -1, np.int32)
+            g[:nb, :sub._graph.graph.shape[1]] = sub._graph.graph
+            dele = np.ones(n_local, bool)          # padding rows = deleted
+            dele[:nb] = sub._deleted[:nb]
+            pids = np.full(max_p, -1, np.int32)
+            got = np.asarray(sub._pivot_ids(), np.int32)
+            pids[:len(got)] = got
+            pvec = block[np.maximum(pids, 0)].astype(block.dtype)
+            mask = np.zeros(words, np.uint32)
+            np.bitwise_or.at(mask, got >> 5,
+                             np.uint32(1) << (got.astype(np.uint32) & 31))
+            blocks_data.append(block)
+            blocks_graph.append(g)
+            blocks_del.append(dele)
+            blocks_pid.append(pids)
+            blocks_pvec.append(pvec)
+            blocks_pmask.append(mask.view(np.int32))
+        self.max_check = int(getattr(self.params, "max_check", 2048))
+        self.nbp_limit = int(getattr(
+            self.params, "no_better_propagation_limit", 3))
+        self._place(np.concatenate(blocks_data),
+                    np.concatenate(blocks_graph),
+                    np.concatenate(blocks_del),
+                    np.stack(blocks_pid), np.stack(blocks_pvec),
+                    np.stack(blocks_pmask))
+        return self
+
+    def _place(self, data, graph, deleted, pivot_ids, pivot_vecs,
+               pivot_mask) -> None:
+        """device_put the stacked per-shard arrays with row sharding."""
+        mesh = self.mesh
+        rows = NamedSharding(mesh, P(SHARD_AXIS, None))
+        vec = NamedSharding(mesh, P(SHARD_AXIS))
+        rows3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+        self.data = jax.device_put(data, rows)
+        self.sqnorm = jax.jit(dist_ops.row_sqnorms,
+                              out_shardings=vec)(self.data)
+        self.graph = jax.device_put(graph, rows)
+        self.deleted = jax.device_put(deleted, vec)
+        self.pivot_ids = jax.device_put(pivot_ids, rows)
+        self.pivot_vecs = jax.device_put(pivot_vecs, rows3)
+        self.pivot_mask = jax.device_put(pivot_mask, rows)
+
+    def search(self, queries: np.ndarray, k: int = 10,
+               max_check: Optional[int] = None,
+               beam_width: int = 16,
+               pool_size: Optional[int] = None,
+               normalized: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched mesh search; same knob semantics as
+        GraphSearchEngine.search, applied per shard."""
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if self.metric == DistCalcMethod.Cosine and not normalized:
+            queries = dist_ops.normalize(queries, self.base)
+        max_check = max_check if max_check is not None else self.max_check
+        n_dev = self.mesh.devices.size
+        k_local = min(k, self.n_local)     # per-shard beam cap
+        k_final = min(k, self.n, k_local * n_dev)   # global merge cap
+        L = pool_size or max(2 * k_local, 64)
+        L = min(max(L, k_local), self.n_local)
+        B = max(1, min(beam_width, L))
+        T = max(1, -(-max_check // B))
+        limit = max(self.nbp_limit, (max_check // 64) // B, 1)
+        d, ids = _sharded_beam_kernel(
+            self.data, self.sqnorm, self.graph, self.deleted,
+            self.pivot_ids, self.pivot_vecs, self.pivot_mask,
+            jnp.asarray(queries), k_local, k_final, L, B, T,
+            int(self.metric), self.base, limit, self.mesh)
+        d, ids = np.asarray(d), np.asarray(ids)
+        if k_final < k:
+            q = d.shape[0]
+            d = np.concatenate(
+                [d, np.full((q, k - k_final), MAX_DIST, np.float32)], 1)
+            ids = np.concatenate(
+                [ids, np.full((q, k - k_final), -1, np.int32)], 1)
+        return d, ids

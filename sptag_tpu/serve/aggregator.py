@@ -43,10 +43,34 @@ class RemoteServer:
     port: int
     reader: Optional[asyncio.StreamReader] = None
     writer: Optional[asyncio.StreamWriter] = None
+    # in-flight requests keyed by resource_id — the asyncio analog of the
+    # reference's ResourceManager callback registry
+    # (inc/Socket/ResourceManager.h:31-184).  A dedicated reader task
+    # dispatches each response to its future, so requests PIPELINE on one
+    # connection (no per-round-trip lock) and a timed-out request leaves the
+    # stream aligned: the late reply is read and discarded by resource_id.
+    pending: dict = dataclasses.field(default_factory=dict)
+    reader_task: Optional[asyncio.Task] = None
+    next_rid: int = 1
 
     @property
     def connected(self) -> bool:
         return self.writer is not None and not self.writer.is_closing()
+
+    def drop(self) -> None:
+        """Tear down the connection and fail every in-flight request."""
+        if self.reader_task is not None and \
+                self.reader_task is not asyncio.current_task():
+            self.reader_task.cancel()
+        self.reader_task = None
+        if self.writer is not None:
+            self.writer.close()
+        self.reader = None
+        self.writer = None
+        pending, self.pending = self.pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(OSError("connection dropped"))
 
 
 class AggregatorContext:
@@ -84,11 +108,9 @@ class AggregatorService:
         self.context = context
         self._server: Optional[asyncio.AbstractServer] = None
         self._reconnect_task: Optional[asyncio.Task] = None
-        self._locks: List[asyncio.Lock] = []
 
     async def start(self, host: Optional[str] = None,
                     port: Optional[int] = None):
-        self._locks = [asyncio.Lock() for _ in self.context.servers]
         await self._connect_all()
         self._reconnect_task = asyncio.create_task(self._reconnect_loop())
         host = host or self.context.listen_addr
@@ -106,8 +128,7 @@ class AggregatorService:
             self._server.close()
             await self._server.wait_closed()
         for s in self.context.servers:
-            if s.writer is not None:
-                s.writer.close()
+            s.drop()
 
     # ---------------------------------------------------------- connections
 
@@ -123,11 +144,30 @@ class AggregatorService:
             wire.PacketHeader.unpack(head)
             server.reader = reader
             server.writer = writer
+            server.reader_task = asyncio.create_task(
+                self._read_responses(server))
             log.info("aggregator connected to %s:%d", server.address,
                      server.port)
         except OSError:
             server.reader = None
             server.writer = None
+
+    async def _read_responses(self, server: RemoteServer) -> None:
+        """Per-connection response pump: match replies to pending futures by
+        resource_id (ResourceManager semantics); unmatched (late) replies are
+        discarded harmlessly."""
+        try:
+            while True:
+                head = await server.reader.readexactly(wire.HEADER_SIZE)
+                header = wire.PacketHeader.unpack(head)
+                body = (await server.reader.readexactly(header.body_length)
+                        if header.body_length else b"")
+                fut = server.pending.pop(header.resource_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result((header, body))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError,
+                asyncio.CancelledError):
+            server.drop()
 
     async def _connect_all(self) -> None:
         await asyncio.gather(*(self._connect(s)
@@ -197,31 +237,30 @@ class AggregatorService:
         return merged
 
     async def _query_one(self, idx: int, server: RemoteServer, body: bytes):
-        lock = self._locks[idx]
+        rid = server.next_rid
+        server.next_rid += 1
         header = wire.PacketHeader(wire.PacketType.SearchRequest,
                                    wire.PacketProcessStatus.Ok, len(body),
-                                   0, 1)
+                                   0, rid)
+        fut = asyncio.get_event_loop().create_future()
+        server.pending[rid] = fut
         try:
-            async with lock:
-                server.writer.write(header.pack() + body)
-                await server.writer.drain()
-                rhead_raw = await asyncio.wait_for(
-                    server.reader.readexactly(wire.HEADER_SIZE),
-                    self.context.search_timeout_s)
-                rhead = wire.PacketHeader.unpack(rhead_raw)
-                rbody = (await asyncio.wait_for(
-                    server.reader.readexactly(rhead.body_length),
-                    self.context.search_timeout_s)
-                    if rhead.body_length else b"")
+            server.writer.write(header.pack() + body)
+            await server.writer.drain()
+            _, rbody = await asyncio.wait_for(
+                fut, self.context.search_timeout_s)
             result = wire.RemoteSearchResult.unpack(rbody)
             if result is None:
                 return wire.ResultStatus.FailedNetwork, []
             return result.status, result.results
         except asyncio.TimeoutError:
+            # the connection stays up and aligned — the reader task will
+            # drop the late reply when it arrives (no resource_id match)
+            server.pending.pop(rid, None)
             return wire.ResultStatus.Timeout, []
         except OSError:
-            server.reader = None
-            server.writer = None
+            server.pending.pop(rid, None)
+            server.drop()
             return wire.ResultStatus.FailedNetwork, []
 
 

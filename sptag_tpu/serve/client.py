@@ -81,6 +81,10 @@ class AnnClient:
                             wire.RemoteSearchResult(
                                 wire.ResultStatus.FailedNetwork, [])
             except socket.timeout:
+                # a timeout can fire mid-message (header read, body pending),
+                # leaving the stream misaligned — drop the connection so the
+                # next search re-dials cleanly (like the OSError path)
+                self.close()
                 return wire.RemoteSearchResult(wire.ResultStatus.Timeout, [])
             except OSError:
                 self.close()

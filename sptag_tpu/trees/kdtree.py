@@ -31,7 +31,6 @@ import numpy as np
 
 from sptag_tpu.io import format as fmt
 
-_MAX_DEPTH = 64
 
 
 class KDTree:
@@ -187,7 +186,11 @@ class KDTree:
         ptr = start.astype(np.int64).copy()
         others: List[np.ndarray] = []
         bounds: List[np.ndarray] = []
-        for _ in range(_MAX_DEPTH):
+        # loop until every active pointer reaches a leaf — mean-value splits
+        # can be arbitrarily unbalanced on skewed data, so no fixed depth cap
+        # (the reference recurses to a leaf unconditionally, KDTree.h:178-215);
+        # node-count bound = hard stop against a malformed (cyclic) tree
+        for _ in range(len(self.nodes) + 1):
             internal = active & (ptr >= 0)
             if not internal.any():
                 break

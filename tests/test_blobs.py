@@ -1,0 +1,134 @@
+"""In-memory blob save/load (the embedding-host path) and FileMetadataSet.
+
+Parity: VectorIndex::SaveIndex(config, blobs) / LoadIndex from blobs
+(/root/reference/AnnService/src/Core/VectorIndex.cpp:126-158, :364-400) and
+the lazy FileMetadataSet (inc/Core/MetadataSet.h:46)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.core.vectorset import FileMetadataSet, MetadataSet
+
+PARAMS = [("DistCalcMethod", "L2"), ("BKTKmeansK", "8"), ("TPTNumber", "4"),
+          ("TPTLeafSize", "128"), ("NeighborhoodSize", "16"), ("CEF", "64"),
+          ("MaxCheckForRefineGraph", "128"), ("MaxCheck", "512"),
+          ("RefineIterations", "1"), ("Samples", "100")]
+
+
+def _build(algo="BKT", n=500, d=12, with_meta=True):
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    index = sp.create_instance(algo, "Float")
+    for name, value in PARAMS:
+        index.set_parameter(name, value)
+    meta = sp.MetadataSet(f"m{i}".encode() for i in range(n)) \
+        if with_meta else None
+    assert index.build(data, meta,
+                       with_meta_index=with_meta) == sp.ErrorCode.Success
+    return index, data
+
+
+@pytest.mark.parametrize("algo", ["BKT", "FLAT"])
+def test_blob_roundtrip_zero_filesystem(algo):
+    index, data = _build(algo)
+    config, blobs = index.save_index_blobs()
+    assert isinstance(config, str) and "IndexAlgoType" in config
+    assert all(isinstance(b, bytes) for b in blobs)
+
+    loaded = sp.load_index_blobs(config, blobs)
+    assert loaded.num_samples == index.num_samples
+    assert loaded.metadata is not None
+    assert loaded.metadata.get_metadata(7) == b"m7"
+    d1, i1 = index.search_batch(data[:16], 5)
+    d2, i2 = loaded.search_batch(data[:16], 5)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-5)
+    # delete-by-metadata works through the rebuilt meta mapping
+    assert loaded.delete_by_metadata(b"m3") == sp.ErrorCode.Success
+    _, i3 = loaded.search_batch(data[3][None, :], 1)
+    assert i3[0, 0] != 3
+
+
+def test_blobs_byte_identical_to_folder_files(tmp_path):
+    """Each blob must be byte-identical to the corresponding folder file —
+    the two paths share one serializer."""
+    index, _ = _build("BKT")
+    config, blobs = index.save_index_blobs()
+    folder = str(tmp_path / "idx")
+    assert index.save_index(folder) == sp.ErrorCode.Success
+    names = [name for name, _ in index._blob_writers()] + [
+        "metadata.bin", "metadataIndex.bin"]
+    assert len(blobs) == len(names)
+    for name, blob in zip(names, blobs):
+        with open(os.path.join(folder, name), "rb") as f:
+            assert f.read() == blob, name
+
+
+def test_blob_roundtrip_without_metadata():
+    index, data = _build("BKT", with_meta=False)
+    config, blobs = index.save_index_blobs()
+    loaded = sp.load_index_blobs(config, blobs)
+    assert loaded.metadata is None
+    _, ids = loaded.search_batch(data[:4], 1)
+    assert list(ids[:, 0]) == [0, 1, 2, 3]
+
+
+def test_file_metadata_set_lazy(tmp_path):
+    metas = MetadataSet([f"payload-{i}".encode() for i in range(100)])
+    mp, ip = str(tmp_path / "metadata.bin"), str(tmp_path / "metaidx.bin")
+    metas.save(mp, ip)
+
+    fms = FileMetadataSet(mp, ip)
+    assert fms.count == 100
+    assert fms.get_metadata(0) == b"payload-0"
+    assert fms.get_metadata(99) == b"payload-99"
+    assert fms.get_metadata(100) == b""
+    # lazy: no full-blob copy resident — only the offsets table
+    assert not fms._metas
+    assert fms._offsets.nbytes < os.path.getsize(mp)
+
+    # staged adds merge on save
+    fms.add(b"appended")
+    assert fms.count == 101
+    assert fms.get_metadata(100) == b"appended"
+    mp2, ip2 = str(tmp_path / "m2.bin"), str(tmp_path / "i2.bin")
+    fms.save(mp2, ip2)
+    again = MetadataSet.load(mp2, ip2)
+    assert again.count == 101
+    assert again.get_metadata(50) == b"payload-50"
+    assert again.get_metadata(100) == b"appended"
+    fms.close()
+
+
+def test_file_metadata_in_place_save(tmp_path):
+    """Saving a FileMetadataSet over its own backing files must not
+    truncate-before-read (the checkpoint-in-place round trip)."""
+    metas = MetadataSet([f"v{i}".encode() for i in range(20)])
+    mp, ip = str(tmp_path / "metadata.bin"), str(tmp_path / "metaidx.bin")
+    metas.save(mp, ip)
+    fms = FileMetadataSet(mp, ip)
+    fms.add(b"new")
+    fms.save(mp, ip)                       # same paths — in-place
+    assert fms.get_metadata(3) == b"v3"    # still readable post-rewrite
+    assert fms.get_metadata(20) == b"new"
+    again = MetadataSet.load(mp, ip)
+    assert again.count == 21
+    assert [again.get_metadata(i) for i in (0, 19, 20)] == \
+        [b"v0", b"v19", b"new"]
+    fms.close()
+
+
+def test_index_load_with_lazy_metadata(tmp_path):
+    index, data = _build("BKT")
+    folder = str(tmp_path / "idx")
+    assert index.save_index(folder) == sp.ErrorCode.Success
+    loaded = sp.load_index(folder, lazy_metadata=True)
+    assert isinstance(loaded.metadata, FileMetadataSet)
+    assert loaded.metadata.get_metadata(5) == b"m5"
+    res = loaded.search(data[8], k=3, with_metadata=True)
+    # metadata rides the lazy file reads and matches the returned ids
+    assert res.metas == [b"m%d" % v for v in res.ids]

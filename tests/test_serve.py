@@ -152,8 +152,26 @@ class _ServerThread(threading.Thread):
             fut.result(timeout=5)
         except Exception:
             pass
+
+        # cancel leftover tasks and drain transport close callbacks inside
+        # the loop BEFORE stopping it, so no transport is finalized against
+        # a closed loop (the 'Event loop is closed' teardown warning)
+        async def _shutdown():
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await asyncio.sleep(0)
+
+        fut2 = asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
+        try:
+            fut2.result(timeout=5)
+        except Exception:
+            pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self.join(timeout=5)
+        self.loop.close()
 
 
 def test_server_client_end_to_end():
@@ -174,6 +192,96 @@ def test_server_client_end_to_end():
         client.close()
     finally:
         t.stop()
+
+
+class _LaggyServer:
+    """Wire-speaking stub server whose FIRST search response is delayed;
+    used to prove a timed-out request does not desynchronize the
+    aggregator's connection (late replies are discarded by resource_id)."""
+
+    def __init__(self, first_delay_s: float):
+        self.first_delay_s = first_delay_s
+        self._nsearch = 0
+        self._server = None
+
+    async def start(self, host, port):
+        self._server = await asyncio.start_server(self._on_client, host,
+                                                  port)
+        return self._server.sockets[0].getsockname()[:2]
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_client(self, reader, writer):
+        try:
+            while True:
+                head = await reader.readexactly(wire.HEADER_SIZE)
+                header = wire.PacketHeader.unpack(head)
+                if header.body_length:
+                    await reader.readexactly(header.body_length)
+                if header.packet_type == wire.PacketType.RegisterRequest:
+                    writer.write(wire.PacketHeader(
+                        wire.PacketType.RegisterResponse,
+                        wire.PacketProcessStatus.Ok, 0, 1,
+                        header.resource_id).pack())
+                    await writer.drain()
+                elif header.packet_type == wire.PacketType.SearchRequest:
+                    self._nsearch += 1
+                    n = self._nsearch
+                    # the reply carries its request ordinal as the single
+                    # result id, so the test can detect a stale reply
+                    body = wire.RemoteSearchResult(
+                        wire.ResultStatus.Success,
+                        [wire.IndexSearchResult("lag", [n], [float(n)],
+                                                None)]).pack()
+                    resp = wire.PacketHeader(
+                        wire.PacketType.SearchResponse,
+                        wire.PacketProcessStatus.Ok, len(body),
+                        header.connection_id, header.resource_id).pack()
+                    if n == 1:
+                        asyncio.get_event_loop().call_later(
+                            self.first_delay_s,
+                            lambda: (writer.write(resp + body)))
+                    else:
+                        writer.write(resp + body)
+                        await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+
+def test_aggregator_timeout_does_not_desync_connection():
+    lag = _LaggyServer(first_delay_s=1.0)
+    tl = _ServerThread(lag)
+    tl.start()
+    hl, pl = tl.wait_ready()
+
+    agg_ctx = AggregatorContext(search_timeout_s=0.3)
+    agg_ctx.servers = [RemoteServer(hl, pl)]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+    try:
+        client = AnnClient(hg, pg, timeout_s=10.0)
+        client.connect()
+        res1 = client.search("q1")
+        assert res1.status == wire.ResultStatus.Timeout
+        # wait past the late reply; the reader task must discard it
+        time.sleep(1.2)
+        res2 = client.search("q2")
+        assert res2.status == wire.ResultStatus.Success
+        # the answer must be reply #2, NOT the stale buffered reply #1
+        assert res2.results[0].ids == [2]
+        res3 = client.search("q3")
+        assert res3.results[0].ids == [3]
+        client.close()
+    finally:
+        tg.stop()
+        tl.stop()
 
 
 def test_aggregator_scatter_gather_and_partial_timeout():
@@ -207,13 +315,20 @@ def test_aggregator_scatter_gather_and_partial_timeout():
         for r in res.results:
             assert r.ids[0] == 5
 
-        # kill one backing server: partial results + degraded status
+        # kill one backing server: the reader task sees EOF and marks it
+        # Disconnected (the reference's on-close event,
+        # AggregatorService.cpp:65-76), so the next query either skips the
+        # dead server (Success, shard_b only) or — if the query raced the
+        # close — degrades to FailedNetwork/Timeout with partial results
         ta.stop()
         time.sleep(0.2)
         res2 = client.search(qtext)
-        assert res2.status in (wire.ResultStatus.FailedNetwork,
-                               wire.ResultStatus.Timeout)
         assert any(r.index_name == "shard_b" for r in res2.results)
+        if res2.status == wire.ResultStatus.Success:
+            assert all(r.index_name == "shard_b" for r in res2.results)
+        else:
+            assert res2.status in (wire.ResultStatus.FailedNetwork,
+                                   wire.ResultStatus.Timeout)
         client.close()
     finally:
         tg.stop()
