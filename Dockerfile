@@ -1,0 +1,19 @@
+# Parity with the reference Dockerfile (build + test in one container).
+# CPU image: the TPU runtime is provided by the deployment environment
+# (libtpu + a real chip); this image runs the full test suite on the CPU
+# backend with a virtual 8-device mesh.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY . .
+
+RUN pip install --no-cache-dir "jax[cpu]" numpy pytest
+RUN g++ -O2 -shared -fPIC -std=c++17 -pthread \
+    -o native/libsptag_host.so native/sptag_host.cpp
+
+RUN python -m pytest tests/ -q
+
+CMD ["python", "-m", "sptag_tpu.serve.server", "--help"]

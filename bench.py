@@ -31,7 +31,7 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".bench_cache")
-CACHE_VERSION = 2          # bump when index params/format change
+CACHE_VERSION = 3          # bump when index params/format change
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
 DEFAULT_BUDGET_S = 3000.0
@@ -226,6 +226,7 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
         import sptag_tpu as sp
+        from sptag_tpu.utils import trace
 
         data, queries = make_dataset(n=n)
 
@@ -240,10 +241,12 @@ def main():
             index.build(data)
             return index
 
-        index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build,
-                                               budget_s)
-        ids_all, qps, batch_times = timed_sweep(index, queries, k, batch,
-                                                budget_s)
+        with trace.span("bench.build_or_load"):
+            index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build,
+                                                   budget_s)
+        with trace.span("bench.sweep"):
+            ids_all, qps, batch_times = timed_sweep(index, queries, k, batch,
+                                                    budget_s)
         recall = recall_at_k(ids_all, truth, k)
 
         result.update({

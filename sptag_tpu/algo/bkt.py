@@ -155,42 +155,45 @@ class BKTIndex(VectorIndex):
                     self._tombstones_dirty = False
         return self._engine
 
-    def _get_dense(self) -> DenseTreeSearcher:
-        """Lazy cluster-contiguous snapshot for the dense search mode.
+    def _build_dense_searcher(self) -> DenseTreeSearcher:
+        """Cluster-contiguous snapshot from the current tree.
 
         Rows appended after the last tree rebuild are not under any tree
         node yet; they are assigned to their nearest cut-center cluster so
         the partition always covers the whole corpus.
         """
+        data = self._host[:self._n]
+        centers, clusters = partition_from_tree(
+            self._tree, self._n, self.params.dense_cluster_size)
+        covered = np.zeros(self._n, bool)
+        for c in clusters:
+            covered[c] = True
+        missing = np.flatnonzero(~covered)
+        if len(missing):
+            import jax.numpy as jnp
+
+            from sptag_tpu.ops import distance as dist_ops
+            d = np.asarray(dist_ops.pairwise_distance(
+                jnp.asarray(data[missing]),
+                jnp.asarray(data[centers]),
+                self.dist_calc_method))
+            owner = d.argmin(axis=1)
+            for ci in range(len(clusters)):
+                extra = missing[owner == ci]
+                if len(extra):
+                    clusters[ci] = np.concatenate(
+                        [clusters[ci], extra])
+        return DenseTreeSearcher(
+            data, centers, clusters, self._deleted[:self._n],
+            self.dist_calc_method, self.base)
+
+    def _get_dense(self) -> DenseTreeSearcher:
+        """Lazy dense snapshot for the dense search mode."""
         self._get_engine()          # refresh dirty state under one lock
         if self._dense is None:
             with self._lock:
                 if self._dense is None:
-                    data = self._host[:self._n]
-                    centers, clusters = partition_from_tree(
-                        self._tree, self._n,
-                        self.params.dense_cluster_size)
-                    covered = np.zeros(self._n, bool)
-                    for c in clusters:
-                        covered[c] = True
-                    missing = np.flatnonzero(~covered)
-                    if len(missing):
-                        import jax.numpy as jnp
-
-                        from sptag_tpu.ops import distance as dist_ops
-                        d = np.asarray(dist_ops.pairwise_distance(
-                            jnp.asarray(data[missing]),
-                            jnp.asarray(data[centers]),
-                            self.dist_calc_method))
-                        owner = d.argmin(axis=1)
-                        for ci in range(len(clusters)):
-                            extra = missing[owner == ci]
-                            if len(extra):
-                                clusters[ci] = np.concatenate(
-                                    [clusters[ci], extra])
-                    self._dense = DenseTreeSearcher(
-                        data, centers, clusters, self._deleted[:self._n],
-                        self.dist_calc_method, self.base)
+                    self._dense = self._build_dense_searcher()
         return self._dense
 
     # ---- build ------------------------------------------------------------
@@ -215,15 +218,34 @@ class BKTIndex(VectorIndex):
     def _refine_search_factory(self, graph: np.ndarray):
         """SearchFn over a mid-build graph snapshot, at the refine budget
         (MaxCheckForRefineGraph — reference RefineSearchIndex,
-        BKTIndex.cpp:266-276)."""
+        BKTIndex.cpp:266-276).
+
+        RefineSearchMode=dense (default) routes the per-node refine
+        searches through the MXU cluster scan instead of the beam walk —
+        graph build becomes matmul-bound (the beam-refine pass measured
+        ~20x the rest of the build combined off-TPU)."""
+        p = self.params
+        budget = p.max_check_for_refine_graph
+        # dense refine needs the BKT tree partition + its params; KDT (which
+        # shares this class) keeps the beam refine
+        if getattr(p, "refine_search_mode", "beam") == "dense" and \
+                isinstance(self._tree, BKTree):
+            searcher = self._build_dense_searcher()
+
+            def search(queries: np.ndarray, k: int):
+                # a candidate pool at least as big as k keeps the RNG prune
+                # supplied even when the budget knob is set below CEF
+                return searcher.search(queries, k,
+                                       max_check=max(budget, 2 * k))
+            return search
+
         engine = self._make_engine(graph)
-        budget = self.params.max_check_for_refine_graph
 
         def search(queries: np.ndarray, k: int):
             return engine.search(
                 queries, k, max_check=budget,
                 pool_size=max(2 * k, 64),
-                nbp_limit=self.params.no_better_propagation_limit)
+                nbp_limit=p.no_better_propagation_limit)
         return search
 
     # ---- search -----------------------------------------------------------
@@ -239,7 +261,8 @@ class BKTIndex(VectorIndex):
         else:
             d, ids = self._get_engine().search(
                 queries, min(k, self._n), max_check=p.max_check,
-                nbp_limit=p.no_better_propagation_limit)
+                nbp_limit=p.no_better_propagation_limit,
+                dynamic_pivots=p.other_dynamic_pivots)
         if ids.shape[1] < k:
             q = ids.shape[0]
             d = np.concatenate(
@@ -439,6 +462,7 @@ class BKTIndex(VectorIndex):
             self._refine_search_factory(self._graph.graph),
             self._graph.neighborhood_size, int(self.dist_calc_method),
             self.base)
+        self._graph.repair_connectivity()
         self._adds_since_rebuild = 0
         self._dirty = True
 

@@ -126,13 +126,21 @@ def _sorted_dup_mask(ids: jax.Array):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
+    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit",
+                     "inject"))
 def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                         pivot_mask, queries, k: int, L: int, B: int, T: int,
-                        metric: int, base: int, nbp_limit: int):
+                        metric: int, base: int, nbp_limit: int,
+                        inject: int = 4):
     """Shared-pivot seeding (BKT): one dense (Q, P) matmul scores the whole
     pivot set; the top-L pivots initialize every query's beam.  `pivot_mask`
-    (W,) int32 is the precomputed packed bitset of the pivot ids."""
+    (W,) int32 is the precomputed packed bitset of the pivot ids.
+
+    Pivots beyond the top L form a per-query sorted SPARE queue — the walk
+    injects the next `inject` of them whenever the frontier falls behind
+    the best unvisited pivot, mirroring the reference's mid-walk
+    `SearchTrees` refill (`NGQueue.top > SPTQueue.top`, BKTIndex.cpp:153-155;
+    `NumberOfOtherDynamicPivots` is the refill size)."""
     Q = queries.shape[0]
     N = data.shape[0]
     P = pivot_ids.shape[0]
@@ -146,16 +154,21 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
             [pivot_ids, jnp.full((L - P,), -1, jnp.int32)])
     else:
         seed_ids = pivot_ids
-    neg, pos = jax.lax.top_k(-d0, L)
-    cand_d = -neg                                               # (Q, L)
-    cand_ids = jnp.where(cand_d < MAX_DIST, seed_ids[pos], -1)
+    order = jnp.argsort(d0, axis=1)                             # ascending
+    sorted_d = jnp.take_along_axis(d0, order, axis=1)
+    sorted_ids = jnp.where(sorted_d < MAX_DIST, seed_ids[order], -1)
+    cand_d = sorted_d[:, :L]
+    cand_ids = sorted_ids[:, :L]
+    spare_ids = sorted_ids[:, L:]
+    spare_d = sorted_d[:, L:]
 
     # every pivot was scored: mark visited so the walk never re-scores one
     visited = jnp.broadcast_to(pivot_mask[None, :],
                                (Q, pivot_mask.shape[0])).astype(jnp.int32)
 
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
-                 visited, k, L, B, T, metric, base, nbp_limit)
+                 visited, k, L, B, T, metric, base, nbp_limit,
+                 spare_ids=spare_ids, spare_d=spare_d, inject=inject)
 
 
 @functools.partial(
@@ -197,24 +210,27 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
 
 def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
           k: int, L: int, B: int, T: int, metric: int, base: int,
-          nbp_limit: int):
+          nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0):
     Q = queries.shape[0]
     N = data.shape[0]
+    Ps = 0 if spare_ids is None else spare_ids.shape[1]
+    use_spares = Ps > 0 and inject > 0
 
     # expanded has a dump slot at column L; visited a dump slot at row N
     expanded = jnp.concatenate(
         [cand_ids < 0, jnp.zeros((Q, 1), bool)], axis=1)        # (Q, L+1)
     no_better = jnp.zeros((Q,), jnp.int32)
+    ptr = jnp.zeros((Q,), jnp.int32)      # next un-injected spare pivot
     k_eff = min(k, L)
 
     def cond(state):
-        cand_ids, cand_d, expanded, visited, no_better, it = state
+        cand_ids, cand_d, expanded, visited, no_better, ptr, it = state
         active = no_better < nbp_limit
         has_work = jnp.any((~expanded[:, :L]) & (cand_ids >= 0), axis=1)
         return (it < T) & jnp.any(active & has_work)
 
     def body(state):
-        cand_ids, cand_d, expanded, visited, no_better, it = state
+        cand_ids, cand_d, expanded, visited, no_better, ptr, it = state
         active = no_better < nbp_limit                           # (Q,)
 
         # ---- pop best B unexpanded entries --------------------------------
@@ -250,11 +266,33 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
             queries, cvecs, DistCalcMethod(metric), base, csq)
         nd = jnp.where(fresh, nd, MAX_DIST)
 
+        # ---- mid-walk re-seed: inject spare pivots when the frontier falls
+        # behind the next unvisited pivot (SearchTrees-on-demand,
+        # BKTIndex.cpp:153-155) ---------------------------------------------
+        if use_spares:
+            next_d = jnp.take_along_axis(
+                spare_d, jnp.minimum(ptr, Ps - 1)[:, None], axis=1)[:, 0]
+            trigger = active & (ptr < Ps) & ((-sneg[:, 0]) > next_d)
+            idxs = ptr[:, None] + jnp.arange(inject, dtype=jnp.int32)
+            ok = trigger[:, None] & (idxs < Ps)
+            safe = jnp.minimum(idxs, Ps - 1)
+            inj_ids = jnp.where(ok, jnp.take_along_axis(spare_ids, safe,
+                                                        axis=1), -1)
+            inj_d = jnp.where(ok & (inj_ids >= 0),
+                              jnp.take_along_axis(spare_d, safe, axis=1),
+                              MAX_DIST)
+            ptr = jnp.where(trigger, ptr + inject, ptr)
+            nd = jnp.concatenate([nd, inj_d], axis=1)
+            flat_m = jnp.concatenate([flat, inj_ids], axis=1)
+        else:
+            flat_m = flat
+
         # ---- merge beam + candidates, keep top-L --------------------------
         all_d = jnp.concatenate([cand_d, nd], axis=1)
-        all_ids = jnp.concatenate([cand_ids, flat], axis=1)
+        all_ids = jnp.concatenate([cand_ids, flat_m], axis=1)
         all_exp = jnp.concatenate(
-            [expanded[:, :L], jnp.zeros_like(fresh)], axis=1)
+            [expanded[:, :L],
+             jnp.zeros((Q, all_d.shape[1] - L), bool)], axis=1)
         mneg, mpos = jax.lax.top_k(-all_d, L)
         cand_d = -mneg
         cand_ids = jnp.take_along_axis(all_ids, mpos, axis=1)
@@ -266,9 +304,9 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
         no_better = jnp.where(frontier_worse,
                               jnp.where(active, no_better + 1, no_better),
                               0)
-        return cand_ids, cand_d, expanded, visited, no_better, it + 1
+        return cand_ids, cand_d, expanded, visited, no_better, ptr, it + 1
 
-    state = (cand_ids, cand_d, expanded, visited, no_better,
+    state = (cand_ids, cand_d, expanded, visited, no_better, ptr,
              jnp.int32(0))
     cand_ids, cand_d, *_ = jax.lax.while_loop(cond, body, state)
 
@@ -319,20 +357,27 @@ class GraphSearchEngine:
 
     def search(self, queries: np.ndarray, k: int, max_check: int = 2048,
                beam_width: int = 16, pool_size: Optional[int] = None,
-               nbp_limit: int = 3, seeds: Optional[np.ndarray] = None
+               nbp_limit: int = 3, seeds: Optional[np.ndarray] = None,
+               dynamic_pivots: int = 4
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched search; returns ((Q, k) dists, (Q, k) int32 ids),
         ascending, -1 / MAX_DIST padded.
 
         `seeds` (Q, S) int32 overrides the engine's shared pivot seeding
         with per-query seed ids (KDT tree-descent seeding), -1 padded.
+        `dynamic_pivots` = spare pivots injected per mid-walk re-seed
+        (reference NumberOfOtherDynamicPivots); 0 disables re-seeding.
         """
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
         nq = queries.shape[0]
         k_eff = min(k, self.n)
-        L = pool_size or max(2 * k_eff, 64)
+        # pool (beam) capacity scales with the budget — a fixed frontier
+        # saturates and flattens the recall/MaxCheck curve (the reference's
+        # NG queue holds maxCheck*30 cells, WorkSpace.h:182-208; measured
+        # here: recall stuck at 0.82 from MaxCheck 512 to 8192 with L=64)
+        L = pool_size or max(2 * k_eff, min(64 + max_check // 8, 1024))
         L = min(max(L, k_eff), self.n)
         B = max(1, min(beam_width, L))
         T = max(1, -(-max_check // B))
@@ -356,7 +401,8 @@ class GraphSearchEngine:
                     self.data, self.sqnorm, self.graph, self.deleted,
                     self.pivot_ids, self.pivot_vecs, self.pivot_mask,
                     jnp.asarray(q),
-                    k_eff, L, B, T, int(self.metric), self.base, limit)
+                    k_eff, L, B, T, int(self.metric), self.base, limit,
+                    inject=dynamic_pivots)
             else:
                 s = seeds[off:off + qn].astype(np.int32, copy=False)
                 if q_pad != qn:

@@ -132,6 +132,11 @@ class BKTParams(ParamSet):
             # target cluster size
             _spec("search_mode", str, "dense", "SearchMode"),
             _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
+            # which engine runs the per-node refine searches during graph
+            # build: "dense" (MXU cluster scan — build time is matmuls) or
+            # "beam" (reference RefineGraph semantics, NeighborhoodGraph.h:
+            # 113-143, far slower off-TPU)
+            _spec("refine_search_mode", str, "dense", "RefineSearchMode"),
         ]
         + _GRAPH_SPECS[:2]
         + [_spec("tpt_top_dims", int, 5, "NumTopDimensionTpTreeSplit")]

@@ -92,6 +92,60 @@ class RelativeNeighborhoodGraph:
                 self.refine_once(data, search_fn_factory(self.graph),
                                  width, metric, base)
             log.info("RNG refine pass %d/%d width=%d", it + 1, passes, width)
+        self.repair_connectivity()
+
+    def repair_connectivity(self) -> None:
+        """Give every zero-in-degree node a reverse edge from its own
+        nearest stored neighbor.
+
+        The reference tolerates unreachable graph nodes because its walk can
+        re-descend the space-partition trees to ANY leaf sample mid-search
+        (SearchTrees refill, BKTIndex.cpp:153-155) — the tree, not the
+        graph, guarantees reachability.  The batched device walk seeds from
+        a bounded pivot set, so the graph itself must be navigable: an
+        orphan row is findable by no budget at all.  Overwriting the last
+        (farthest) slot of the neighbor's row costs the least-useful edge.
+        """
+        g = self.graph
+        n = g.shape[0]
+        if n == 0:
+            return
+        indeg = np.bincount(np.clip(g[g >= 0].ravel(), 0, n - 1),
+                            minlength=n)
+        fixed = 0
+        # displacing a row's tail removes one of ITS in-edges — only evict
+        # tails with other in-edges or the repair just moves the orphan
+        # around; the in-degree ledger makes each fix permanent
+        for _ in range(16):                    # cascade bound (paranoia)
+            orphans = np.flatnonzero(indeg[:n] == 0)
+            progress = False
+            for v in orphans:
+                nbrs = g[v][g[v] >= 0]
+                placed = False
+                for t in nbrs:                 # free slot costs nothing
+                    row = g[t]
+                    empty = np.flatnonzero(row < 0)
+                    if len(empty):
+                        row[empty[0]] = v
+                        placed = True
+                        break
+                if not placed:
+                    for t in nbrs:
+                        row = g[t]
+                        tail = int(row[-1])
+                        if tail >= 0 and tail != v and indeg[tail] > 1:
+                            row[-1] = v
+                            indeg[tail] -= 1
+                            placed = True
+                            break
+                if placed:
+                    indeg[v] += 1
+                    fixed += 1
+                    progress = True
+            if not progress or not len(orphans):
+                break
+        if fixed:
+            log.info("connectivity repair: %d orphan nodes linked", fixed)
 
     def build_candidates(self, data: np.ndarray, metric: int, base: int,
                          seed: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -20,14 +20,18 @@ from sptag_tpu.serve import wire
 
 class AnnClient:
     def __init__(self, host: str, port: int,
-                 timeout_s: float = 9.0):
+                 timeout_s: float = 9.0,
+                 heartbeat_interval_s: float = 0.0):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        self.heartbeat_interval_s = heartbeat_interval_s
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._next_resource = 1
         self._remote_cid = wire.INVALID_CONNECTION_ID
+        self._hb_stop: Optional[threading.Event] = None
+        self._hb_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ connection
 
@@ -41,15 +45,56 @@ class AnnClient:
         header, _ = self._recv()
         if header.packet_type == wire.PacketType.RegisterResponse:
             self._remote_cid = header.connection_id
+        if self.heartbeat_interval_s > 0 and self._hb_thread is None:
+            self.start_heartbeat(self.heartbeat_interval_s)
 
     @property
     def is_connected(self) -> bool:
         return self._sock is not None
 
     def close(self) -> None:
+        self.stop_heartbeat()
         if self._sock is not None:
             self._sock.close()
             self._sock = None
+
+    # ------------------------------------------------------------- heartbeat
+
+    def start_heartbeat(self, interval_s: float = 10.0) -> None:
+        """Periodic HeartbeatRequest pump — keeps NAT/proxy state warm and
+        surfaces dead connections early (parity: Connection::StartHeartbeat,
+        reference inc/Socket/Connection.h:38; interval is a Socket::Client
+        ctor arg there, inc/Socket/Client.h:29).
+
+        Send-only under the client lock: the heartbeat RESPONSES are drained
+        by the next search's resource-id matching loop (it skips every
+        non-matching packet), so the pump never races a search read."""
+        self.stop_heartbeat()
+        self._hb_stop = threading.Event()
+
+        def pump(stop: threading.Event) -> None:
+            while not stop.wait(interval_s):
+                with self._lock:
+                    if self._sock is None:
+                        continue
+                    try:
+                        self._send(wire.PacketHeader(
+                            wire.PacketType.HeartbeatRequest,
+                            wire.PacketProcessStatus.Ok, 0,
+                            self._remote_cid, 0), b"")
+                    except OSError:
+                        self._sock.close()
+                        self._sock = None
+
+        self._hb_thread = threading.Thread(
+            target=pump, args=(self._hb_stop,), daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        self._hb_thread = None
+        self._hb_stop = None
 
     # ---------------------------------------------------------------- search
 

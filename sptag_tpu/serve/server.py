@@ -22,6 +22,7 @@ from typing import Dict, Optional, Tuple
 
 from sptag_tpu.serve import wire
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
+from sptag_tpu.utils import trace
 
 log = logging.getLogger(__name__)
 
@@ -131,8 +132,10 @@ class SearchServer:
             texts.append(query.query if query is not None else "")
         loop = asyncio.get_event_loop()
         try:
-            results = await loop.run_in_executor(
-                None, self.executor.execute_batch, texts)
+            def run_batch():
+                with trace.span("server.execute_batch"):
+                    return self.executor.execute_batch(texts)
+            results = await loop.run_in_executor(None, run_batch)
         except Exception:
             log.exception("batch execution failed")
             results = [wire.RemoteSearchResult(

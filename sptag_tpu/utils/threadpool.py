@@ -1,0 +1,69 @@
+"""ThreadPool — background job queue with the reference's surface.
+
+Parity: Helper::ThreadPool (/root/reference/AnnService/inc/Helper/
+ThreadPool.h:18-111): `init(threads)` spawns workers draining a shared job
+queue; `add(job)` enqueues; jobs run `exec()` and are owned by the pool.
+Python reshape: jobs are plain callables; `concurrent.futures` would cover
+most uses (io/reader.py uses it for block parsing), but services that need
+the reference's fire-and-forget + drain semantics (the async RebuildJob
+pattern, BKTIndex.cpp:39-49) get them here without dragging in executor
+futures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+
+class ThreadPool:
+    def __init__(self):
+        self._queue: "queue.Queue[Optional[Callable[[], None]]]" = \
+            queue.Queue()
+        self._workers: list = []
+        self._stopped = False
+
+    def init(self, threads: int = 1) -> None:
+        """Spawn `threads` daemon workers (ThreadPool.h:25-43)."""
+        for _ in range(max(1, threads)):
+            t = threading.Thread(target=self._run, daemon=True)
+            t.start()
+            self._workers.append(t)
+
+    def add(self, job: Callable[[], None]) -> None:
+        """Enqueue a job; runs on some worker (ThreadPool.h:53-60)."""
+        if self._stopped:
+            raise RuntimeError("ThreadPool is stopped")
+        self._queue.put(job)
+
+    def current_jobs(self) -> int:
+        """Approximate queued-but-unstarted job count (ThreadPool.h:96)."""
+        return self._queue.qsize()
+
+    def join(self) -> None:
+        """Block until every queued job has finished."""
+        self._queue.join()
+
+    def stop(self) -> None:
+        """Drain and terminate the workers."""
+        self._stopped = True
+        for _ in self._workers:
+            self._queue.put(None)
+        for t in self._workers:
+            t.join(timeout=10)
+        self._workers.clear()
+
+    def _run(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            try:
+                job()
+            except Exception:                          # noqa: BLE001
+                import logging
+                logging.getLogger(__name__).exception("ThreadPool job failed")
+            finally:
+                self._queue.task_done()

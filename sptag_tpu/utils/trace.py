@@ -1,0 +1,83 @@
+"""Tracing / profiling subsystem.
+
+The reference has NO tracing (SURVEY.md §5: only per-query clock() math in
+IndexSearcher, /root/reference/AnnService/src/IndexSearcher/main.cpp:109,
+143-171); the survey assigns a first-class tracing subsystem to the new
+framework.  Two cooperating layers:
+
+* host spans — `span("name")` context managers record wall-time into a
+  process-wide registry; `report()` aggregates count/total/mean/max per
+  name.  Cheap enough to leave on in production paths (a perf_counter pair
+  and a dict update per span).
+* device tracing — the same `span` emits a `jax.profiler.TraceAnnotation`
+  when a jax profiler trace is active, so host spans line up with device
+  timelines in TensorBoard/Perfetto; `start_trace(logdir)` / `stop_trace()`
+  wrap `jax.profiler` for callers that should not import jax eagerly.
+
+Used by bench.py and the server batch path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+_lock = threading.Lock()
+_spans: Dict[str, list] = {}      # name -> [count, total_s, max_s]
+_trace_active = False
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Record one host span; annotate the device trace when one is live."""
+    ann = None
+    if _trace_active:
+        import jax.profiler
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        with _lock:
+            rec = _spans.setdefault(name, [0, 0.0, 0.0])
+            rec[0] += 1
+            rec[1] += dt
+            rec[2] = max(rec[2], dt)
+
+
+def report() -> Dict[str, Dict[str, float]]:
+    """Snapshot of all spans: {name: {count, total_s, mean_s, max_s}}."""
+    with _lock:
+        return {
+            name: {"count": c, "total_s": round(t, 6),
+                   "mean_s": round(t / c, 6) if c else 0.0,
+                   "max_s": round(mx, 6)}
+            for name, (c, t, mx) in _spans.items()
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def start_trace(logdir: str) -> None:
+    """Begin a jax profiler trace (XLA device timeline + host annotations).
+    View with TensorBoard's profile plugin or Perfetto."""
+    global _trace_active
+    import jax.profiler
+    jax.profiler.start_trace(logdir)
+    _trace_active = True
+
+
+def stop_trace() -> None:
+    global _trace_active
+    import jax.profiler
+    _trace_active = False
+    jax.profiler.stop_trace()

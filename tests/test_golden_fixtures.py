@@ -1,0 +1,167 @@
+"""Golden byte fixtures pinning the reference-compatible layouts.
+
+Every expected byte string below is HAND-ASSEMBLED from the documented
+reference layouts — not produced by the code under test — so wire/format
+compatibility claims are pinned by bytes, not comments:
+
+* PacketHeader: u8 type, u8 status, u32 bodyLength, u32 connectionID,
+  u32 resourceID, 2 pad bytes to the 16-byte buffer
+  (/root/reference/AnnService/inc/Socket/Packet.h:52-76,
+  src/Socket/Packet.cpp:41-66).
+* SimpleSerialization: PODs little-endian; strings/ByteArrays as u32
+  length + payload (inc/Socket/SimpleSerialization.h:21-168).
+* RemoteQuery: u16 major=1, u16 mirror=0, u8 type, string
+  (inc/Socket/RemoteSearchQuery.h:23-46, src/Socket/RemoteSearchQuery.cpp:
+  30-41).
+* RemoteSearchResult: u16 major=1, u16 mirror=0, u8 status, u32 count,
+  then per index {string name, u32 num, bool withMeta, num x (i32 VID,
+  f32 Dist), [num x ByteArray]} (src/Socket/RemoteSearchQuery.cpp:94-210).
+* Dataset<T>: i32 rows, i32 cols, row-major payload (inc/Core/Common/
+  Dataset.h:144-158).
+* NeighborhoodGraph: i32 rows, i32 neighborhoodSize, i32 rows of ids
+  (inc/Core/Common/NeighborhoodGraph.h:366-386).
+* BKTree: i32 treeNumber, i32 starts[treeNumber], i32 nodeCount, nodes of
+  {i32 centerid, childStart, childEnd} (inc/Core/Common/BKTree.h:219-276).
+* Labelset: i32 deletedCount + Dataset<int8> (N, 1)
+  (inc/Core/Common/Labelset.h:47-81).
+"""
+
+import io
+import struct
+
+import numpy as np
+
+from sptag_tpu.io import format as fmt
+from sptag_tpu.serve import wire
+
+
+def test_packet_header_golden_bytes():
+    golden = bytes([
+        0x03,                       # PacketType::SearchRequest
+        0x01,                       # PacketProcessStatus::Timeout
+        0x2A, 0x00, 0x00, 0x00,     # bodyLength = 42 LE
+        0x07, 0x00, 0x00, 0x00,     # connectionID = 7
+        0x63, 0x00, 0x00, 0x00,     # resourceID = 99
+        0x00, 0x00,                 # pad to c_bufferSize = 16
+    ])
+    h = wire.PacketHeader(wire.PacketType.SearchRequest,
+                          wire.PacketProcessStatus.Timeout, 42, 7, 99)
+    assert h.pack() == golden
+    h2 = wire.PacketHeader.unpack(golden)
+    assert (h2.packet_type, h2.process_status, h2.body_length,
+            h2.connection_id, h2.resource_id) == (0x03, 0x01, 42, 7, 99)
+
+
+def test_remote_query_golden_bytes():
+    golden = (
+        b"\x01\x00"                 # MajorVersion = 1 (u16 LE)
+        b"\x00\x00"                 # MirrorVersion = 0
+        b"\x00"                     # QueryType::String
+        b"\x05\x00\x00\x00"         # string length 5
+        b"1|2|3"                    # query text
+    )
+    q = wire.RemoteQuery("1|2|3")
+    assert q.pack() == golden
+    q2 = wire.RemoteQuery.unpack(golden)
+    assert q2.query == "1|2|3" and q2.query_type == 0
+
+
+def test_remote_search_result_golden_bytes():
+    golden = (
+        b"\x01\x00"                 # MajorVersion
+        b"\x00\x00"                 # MirrorVersion
+        b"\x00"                     # ResultStatus::Success
+        b"\x01\x00\x00\x00"         # one IndexSearchResult
+        b"\x03\x00\x00\x00" b"idx"  # index name
+        b"\x02\x00\x00\x00"         # two results
+        b"\x01"                     # withMeta = true
+        + struct.pack("<if", 5, 0.25)
+        + struct.pack("<if", -1, 3.5)
+        + b"\x02\x00\x00\x00" b"m5"  # metadata ByteArrays
+        + b"\x00\x00\x00\x00"        # empty metadata for the -1 slot
+    )
+    r = wire.RemoteSearchResult(wire.ResultStatus.Success, [
+        wire.IndexSearchResult("idx", [5, -1], [0.25, 3.5], [b"m5", b""])])
+    assert r.pack() == golden
+    r2 = wire.RemoteSearchResult.unpack(golden)
+    assert r2.status == wire.ResultStatus.Success
+    assert r2.results[0].ids == [5, -1]
+    assert r2.results[0].metas == [b"m5", b""]
+    np.testing.assert_allclose(r2.results[0].dists, [0.25, 3.5])
+
+
+def test_vectors_bin_golden_bytes():
+    golden = (
+        b"\x02\x00\x00\x00"         # rows = 2
+        b"\x03\x00\x00\x00"         # cols = 3
+        + struct.pack("<6f", 1.0, 2.0, 3.0, 4.0, 5.0, 6.0)
+    )
+    arr = np.asarray([[1, 2, 3], [4, 5, 6]], np.float32)
+    buf = io.BytesIO()
+    fmt.write_matrix(buf, arr)
+    assert buf.getvalue() == golden
+    back = fmt.read_matrix(io.BytesIO(golden), np.float32)
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_graph_bin_golden_bytes():
+    golden = (
+        b"\x02\x00\x00\x00"         # rows = 2
+        b"\x02\x00\x00\x00"         # neighborhoodSize = 2
+        + struct.pack("<4i", 1, -1, 0, -1)
+    )
+    g = np.asarray([[1, -1], [0, -1]], np.int32)
+    buf = io.BytesIO()
+    fmt.write_graph(buf, g)
+    assert buf.getvalue() == golden
+    np.testing.assert_array_equal(fmt.read_graph(io.BytesIO(golden)), g)
+
+
+def test_bkt_tree_bin_golden_bytes():
+    golden = (
+        b"\x01\x00\x00\x00"         # treeNumber = 1
+        b"\x00\x00\x00\x00"         # treeStart[0] = 0
+        b"\x03\x00\x00\x00"         # nodeCount = 3
+        + struct.pack("<3i", 2, 1, 3)      # root {centerid=2, cs=1, ce=3}
+        + struct.pack("<3i", 0, -1, 0)     # leaf {centerid=0}
+        + struct.pack("<3i", 1, -1, 0)     # leaf {centerid=1}
+    )
+    starts = np.asarray([0], np.int32)
+    nodes = np.zeros(3, fmt.BKT_NODE_DTYPE)
+    nodes[0] = (2, 1, 3)
+    nodes[1] = (0, -1, 0)
+    nodes[2] = (1, -1, 0)
+    buf = io.BytesIO()
+    fmt.write_tree_forest(buf, starts, nodes)
+    assert buf.getvalue() == golden
+    s2, n2 = fmt.read_tree_forest(io.BytesIO(golden), fmt.BKT_NODE_DTYPE)
+    np.testing.assert_array_equal(s2, starts)
+    assert n2.tobytes() == nodes.tobytes()
+
+
+def test_deletes_bin_golden_bytes():
+    golden = (
+        b"\x01\x00\x00\x00"         # deletedCount = 1
+        b"\x03\x00\x00\x00"         # Dataset rows = 3
+        b"\x01\x00\x00\x00"         # Dataset cols = 1
+        b"\x00\x01\x00"             # flags: row 1 deleted
+    )
+    mask = np.asarray([False, True, False])
+    buf = io.BytesIO()
+    fmt.write_deletes(buf, mask)
+    assert buf.getvalue() == golden
+    np.testing.assert_array_equal(fmt.read_deletes(io.BytesIO(golden)), mask)
+
+
+def test_metadata_bin_golden_bytes():
+    from sptag_tpu.core.vectorset import MetadataSet
+    meta_golden = b"alphabeta"      # raw concatenation
+    idx_golden = (
+        b"\x02\x00\x00\x00"                          # count = 2 (i32)
+        + struct.pack("<3Q", 0, 5, 9)                # (count+1) u64 offsets
+    )
+    m = MetadataSet([b"alpha", b"beta"])
+    mb, ib = io.BytesIO(), io.BytesIO()
+    m.save(mb, ib)
+    assert mb.getvalue() == meta_golden
+    assert ib.getvalue() == idx_golden

@@ -194,6 +194,33 @@ def test_server_client_end_to_end():
         t.stop()
 
 
+def test_client_heartbeat_does_not_desync_search():
+    """A heartbeat pump's responses are drained by the search matching
+    loop — searches stay correct with heartbeats interleaving
+    (Connection::StartHeartbeat parity, inc/Socket/Connection.h:38)."""
+    ctx, data = _make_context()
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        client = AnnClient(host, port, timeout_s=10.0,
+                           heartbeat_interval_s=0.05)
+        client.connect()
+        assert client._hb_thread is not None
+        time.sleep(0.3)                  # several heartbeats go out
+        for probe in (4, 9, 14):
+            qtext = "|".join(str(x) for x in data[probe])
+            res = client.search(qtext)
+            assert res.status == wire.ResultStatus.Success
+            assert res.results[0].ids[0] == probe
+            time.sleep(0.12)
+        client.close()
+        assert client._hb_thread is None
+    finally:
+        t.stop()
+
+
 class _LaggyServer:
     """Wire-speaking stub server whose FIRST search response is delayed;
     used to prove a timed-out request does not desynchronize the

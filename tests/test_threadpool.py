@@ -1,0 +1,45 @@
+"""Helper::ThreadPool parity (reference inc/Helper/ThreadPool.h:18-111)."""
+
+import threading
+import time
+
+from sptag_tpu.utils.threadpool import ThreadPool
+
+
+def test_threadpool_runs_all_jobs():
+    pool = ThreadPool()
+    pool.init(4)
+    hits = []
+    lock = threading.Lock()
+
+    def job(i):
+        with lock:
+            hits.append(i)
+
+    for i in range(100):
+        pool.add(lambda i=i: job(i))
+    pool.join()
+    assert sorted(hits) == list(range(100))
+    pool.stop()
+
+
+def test_threadpool_survives_job_exception():
+    pool = ThreadPool()
+    pool.init(2)
+    done = threading.Event()
+    pool.add(lambda: 1 / 0)
+    pool.add(done.set)
+    assert done.wait(10)
+    pool.join()
+    pool.stop()
+
+
+def test_threadpool_stop_rejects_new_jobs():
+    pool = ThreadPool()
+    pool.init(1)
+    pool.stop()
+    try:
+        pool.add(lambda: None)
+        raise AssertionError("expected RuntimeError after stop")
+    except RuntimeError:
+        pass

@@ -1,0 +1,98 @@
+"""MaxCheck sweep: beam vs dense recall/latency curves (VERDICT item 5).
+
+Mirrors the reference IndexSearcher harness loop
+(/root/reference/AnnService/src/IndexSearcher/main.cpp:131-190): one index,
+a list of MaxCheck values, per-value recall@10 + latency percentiles — run
+for BOTH search modes so the TPU-only dense mode's curve can be compared
+against the reference-semantics beam walk's.
+
+Writes a markdown table to reports/MAXCHECK_SWEEP.md and prints it.
+
+Usage: python tools/sweep_modes.py [n] [out_path]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    out_path = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "reports", "MAXCHECK_SWEEP.md")
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    import sptag_tpu as sp
+    from bench import make_dataset, _bkt_params, l2_truth, build_or_load
+
+    k = 10
+    batch = 256
+    data, queries = make_dataset(n=n)
+    queries = queries[:512]
+    truth = l2_truth(data, queries, k)
+
+    def build():
+        index = sp.create_instance("BKT", "Float")
+        index.set_parameter("DistCalcMethod", "L2")
+        _bkt_params(index, n)
+        index.build(data)
+        return index
+
+    index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build, 1e9)
+    dev = jax.devices()[0].platform
+
+    lines = [
+        "# MaxCheck sweep — beam vs dense recall/latency",
+        "",
+        f"Corpus: synthetic clustered SIFT-like, n={n}, d=128, L2; "
+        f"{len(queries)} queries, recall@{k} vs exact ground truth; "
+        f"platform={dev}; build_s={build_s:.1f} (cached={cached}).",
+        "",
+        "Harness parity: reference IndexSearcher MaxCheck sweep "
+        "(src/IndexSearcher/main.cpp:131-190).",
+        "",
+        "| MaxCheck | mode | recall@10 | avg ms/query | p95 batch ms | "
+        "p99 batch ms |",
+        "|---|---|---|---|---|---|",
+    ]
+    for max_check in (512, 1024, 2048, 4096, 8192):
+        index.set_parameter("MaxCheck", str(max_check))
+        for mode in ("beam", "dense"):
+            index.set_parameter("SearchMode", mode)
+            index.search_batch(queries[:batch], k)      # compile/warm
+            times = []
+            ids_all = np.zeros((len(queries), k), np.int64)
+            for i in range(0, len(queries), batch):
+                t0 = time.perf_counter()
+                _, ids = index.search_batch(queries[i:i + batch], k)
+                times.append(time.perf_counter() - t0)
+                ids_all[i:i + batch] = ids[:, :k]
+            recall = float(np.mean([
+                len(set(ids_all[i]) & set(truth[i])) / k
+                for i in range(len(queries))]))
+            total = sum(times)
+            lines.append(
+                f"| {max_check} | {mode} | {recall:.4f} | "
+                f"{total / len(queries) * 1000:.2f} | "
+                f"{np.percentile(times, 95) * 1000:.1f} | "
+                f"{np.percentile(times, 99) * 1000:.1f} |")
+            print(lines[-1], flush=True)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

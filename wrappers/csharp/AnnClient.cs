@@ -1,0 +1,197 @@
+using System;
+using System.Collections.Generic;
+using System.IO;
+using System.Net.Sockets;
+using System.Text;
+
+namespace SPTAG
+{
+    /// <summary>
+    /// Remote search client over the sptag_tpu wire protocol.
+    ///
+    /// Parity: the reference's SWIG C# AnnClient (Wrappers/inc/
+    /// ClientInterface.h:15, CsharpCore.i) — re-designed as a pure-.NET
+    /// socket client because the new framework's index core is Python/JAX;
+    /// non-Python languages reach it through the byte-compatible wire
+    /// protocol (framing inc/Socket/Packet.h:52-76; bodies
+    /// inc/Socket/RemoteSearchQuery.h + SimpleSerialization.h; byte layouts
+    /// pinned by tests/test_golden_fixtures.py).
+    ///
+    /// NOTE: no .NET SDK exists in the build image, so this file is
+    /// review-tested against the golden byte fixtures rather than
+    /// compile-tested.
+    /// </summary>
+    public sealed class AnnClient : IDisposable
+    {
+        public sealed class IndexResult
+        {
+            public string IndexName = "";
+            public int[] Ids = Array.Empty<int>();
+            public float[] Dists = Array.Empty<float>();
+            public byte[][]? Metas;   // null when the server sent none
+        }
+
+        public sealed class SearchResult
+        {
+            /// 0 Success, 1 Timeout, 2 FailedNetwork, 3 FailedExecute,
+            /// 4 Dropped (inc/Socket/RemoteSearchQuery.h:61-72).
+            public int Status;
+            public List<IndexResult> Results = new List<IndexResult>();
+        }
+
+        private const int HeaderSize = 16;
+        private const byte TypeRegisterRequest = 0x02;
+        private const byte TypeSearchRequest = 0x03;
+        private const byte TypeRegisterResponse = 0x82;
+        private const byte TypeSearchResponse = 0x83;
+
+        private readonly string _host;
+        private readonly int _port;
+        private readonly int _timeoutMs;
+        private TcpClient? _client;
+        private NetworkStream? _stream;
+        private uint _remoteConnectionId;
+        private uint _nextResourceId = 1;
+        private readonly object _lock = new object();
+
+        public AnnClient(string host, int port, int timeoutMs = 9000)
+        {
+            _host = host;
+            _port = port;
+            _timeoutMs = timeoutMs;
+        }
+
+        public void Connect()
+        {
+            lock (_lock)
+            {
+                _client = new TcpClient(_host, _port);
+                _client.ReceiveTimeout = _timeoutMs;
+                _client.SendTimeout = _timeoutMs;
+                _stream = _client.GetStream();
+                SendHeader(TypeRegisterRequest, 0, 0, 0);
+                var header = ReadExact(HeaderSize);
+                if (header[0] == TypeRegisterResponse)
+                {
+                    _remoteConnectionId = BitConverter.ToUInt32(header, 6);
+                }
+                int bodyLen = BitConverter.ToInt32(header, 2);
+                if (bodyLen > 0) ReadExact(bodyLen);
+            }
+        }
+
+        /// Send one text-protocol query; blocks for the matching response.
+        public SearchResult Search(string query)
+        {
+            lock (_lock)
+            {
+                uint rid = _nextResourceId++;
+                byte[] text = Encoding.UTF8.GetBytes(query);
+                using var body = new MemoryStream();
+                using var w = new BinaryWriter(body);
+                w.Write((ushort)1);                    // MajorVersion
+                w.Write((ushort)0);                    // MirrorVersion
+                w.Write((byte)0);                      // QueryType::String
+                w.Write(text.Length);
+                w.Write(text);
+                byte[] payload = body.ToArray();
+                SendHeader(TypeSearchRequest, payload.Length,
+                           _remoteConnectionId, rid);
+                _stream!.Write(payload, 0, payload.Length);
+
+                while (true)
+                {
+                    var header = ReadExact(HeaderSize);
+                    byte type = header[0];
+                    int bodyLen = BitConverter.ToInt32(header, 2);
+                    uint resourceId = BitConverter.ToUInt32(header, 10);
+                    byte[] resp = bodyLen > 0 ? ReadExact(bodyLen)
+                                              : Array.Empty<byte>();
+                    if (type == TypeSearchResponse && resourceId == rid)
+                    {
+                        return ParseSearchResult(resp);
+                    }
+                    // non-matching packet (heartbeat/late reply): discard
+                }
+            }
+        }
+
+        public void Dispose()
+        {
+            lock (_lock)
+            {
+                _stream?.Dispose();
+                _client?.Dispose();
+                _stream = null;
+                _client = null;
+            }
+        }
+
+        // -------------------------------------------------------------- wire
+
+        private void SendHeader(byte type, int bodyLength, uint connectionId,
+                                uint resourceId)
+        {
+            var buf = new byte[HeaderSize];
+            buf[0] = type;
+            buf[1] = 0;                                // ProcessStatus::Ok
+            BitConverter.GetBytes(bodyLength).CopyTo(buf, 2);
+            BitConverter.GetBytes(connectionId).CopyTo(buf, 6);
+            BitConverter.GetBytes(resourceId).CopyTo(buf, 10);
+            _stream!.Write(buf, 0, buf.Length);        // bytes 14-15 pad
+        }
+
+        private byte[] ReadExact(int n)
+        {
+            var buf = new byte[n];
+            int off = 0;
+            while (off < n)
+            {
+                int got = _stream!.Read(buf, off, n - off);
+                if (got <= 0) throw new IOException("connection closed");
+                off += got;
+            }
+            return buf;
+        }
+
+        private static SearchResult ParseSearchResult(byte[] buf)
+        {
+            using var r = new BinaryReader(new MemoryStream(buf));
+            ushort major = r.ReadUInt16();
+            r.ReadUInt16();                            // mirror version
+            var result = new SearchResult();
+            if (major != 1)
+            {
+                result.Status = 2;                     // FailedNetwork
+                return result;
+            }
+            result.Status = r.ReadByte();
+            int count = r.ReadInt32();
+            for (int i = 0; i < count; ++i)
+            {
+                var idx = new IndexResult();
+                idx.IndexName = Encoding.UTF8.GetString(
+                    r.ReadBytes(r.ReadInt32()));
+                int num = r.ReadInt32();
+                bool withMeta = r.ReadBoolean();
+                idx.Ids = new int[num];
+                idx.Dists = new float[num];
+                for (int j = 0; j < num; ++j)
+                {
+                    idx.Ids[j] = r.ReadInt32();
+                    idx.Dists[j] = r.ReadSingle();
+                }
+                if (withMeta)
+                {
+                    idx.Metas = new byte[num][];
+                    for (int j = 0; j < num; ++j)
+                    {
+                        idx.Metas[j] = r.ReadBytes(r.ReadInt32());
+                    }
+                }
+                result.Results.Add(idx);
+            }
+            return result;
+        }
+    }
+}

@@ -1,0 +1,192 @@
+package sptag;
+
+import java.io.DataInputStream;
+import java.io.DataOutputStream;
+import java.io.IOException;
+import java.net.Socket;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Remote search client over the sptag_tpu wire protocol.
+ *
+ * Parity: the reference's SWIG Java AnnClient (Wrappers/inc/
+ * ClientInterface.h:15, JavaCore.i) — re-designed as a pure-JVM socket
+ * client because the new framework's index core is Python/JAX, not C++;
+ * every non-Python language reaches it through the byte-compatible wire
+ * protocol (packet framing: inc/Socket/Packet.h:52-76; bodies:
+ * inc/Socket/RemoteSearchQuery.h, SimpleSerialization.h — the exact byte
+ * layouts are pinned by tests/test_golden_fixtures.py in the repo root).
+ *
+ * NOTE: no JDK exists in the build image, so this file is review-tested
+ * against the golden byte fixtures rather than compile-tested.
+ */
+public final class AnnClient implements AutoCloseable {
+
+    public static final class IndexResult {
+        public final String indexName;
+        public final int[] ids;
+        public final float[] dists;
+        public final byte[][] metas;   // null when the server sent none
+
+        IndexResult(String name, int[] ids, float[] dists, byte[][] metas) {
+            this.indexName = name;
+            this.ids = ids;
+            this.dists = dists;
+            this.metas = metas;
+        }
+    }
+
+    public static final class SearchResult {
+        /** 0 Success, 1 Timeout, 2 FailedNetwork, 3 FailedExecute, 4 Dropped
+         *  (inc/Socket/RemoteSearchQuery.h:61-72). */
+        public final int status;
+        public final List<IndexResult> results;
+
+        SearchResult(int status, List<IndexResult> results) {
+            this.status = status;
+            this.results = results;
+        }
+    }
+
+    private static final int HEADER_SIZE = 16;
+    private static final byte TYPE_REGISTER_REQUEST = 0x02;
+    private static final byte TYPE_SEARCH_REQUEST = 0x03;
+    private static final byte TYPE_SEARCH_RESPONSE = (byte) 0x83;
+
+    private final String host;
+    private final int port;
+    private final int timeoutMs;
+    private Socket socket;
+    private DataInputStream in;
+    private DataOutputStream out;
+    private int remoteConnectionId = 0;
+    private int nextResourceId = 1;
+
+    public AnnClient(String host, int port, int timeoutMs) {
+        this.host = host;
+        this.port = port;
+        this.timeoutMs = timeoutMs;
+    }
+
+    public synchronized void connect() throws IOException {
+        socket = new Socket(host, port);
+        socket.setSoTimeout(timeoutMs);
+        in = new DataInputStream(socket.getInputStream());
+        out = new DataOutputStream(socket.getOutputStream());
+        sendHeader(TYPE_REGISTER_REQUEST, 0, 0, 0);
+        ByteBuffer header = readHeader();
+        byte type = header.get(0);
+        if (type == (byte) 0x82) {                     // RegisterResponse
+            remoteConnectionId = header.getInt(6);
+        }
+        skipBody(header);
+    }
+
+    /** Send one text-protocol query ("$option:value ... v1|v2|..." or
+     *  "#&lt;base64&gt;"); blocks for the matching SearchResponse. */
+    public synchronized SearchResult search(String query) throws IOException {
+        int rid = nextResourceId++;
+        byte[] queryBytes = query.getBytes(StandardCharsets.UTF_8);
+        ByteBuffer body = ByteBuffer.allocate(2 + 2 + 1 + 4 + queryBytes.length)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        body.putShort((short) 1);                      // MajorVersion
+        body.putShort((short) 0);                      // MirrorVersion
+        body.put((byte) 0);                            // QueryType::String
+        body.putInt(queryBytes.length);
+        body.put(queryBytes);
+        sendHeader(TYPE_SEARCH_REQUEST, body.capacity(), remoteConnectionId,
+                   rid);
+        out.write(body.array());
+        out.flush();
+
+        while (true) {
+            ByteBuffer header = readHeader();
+            byte type = header.get(0);
+            int bodyLen = header.getInt(2);
+            int resourceId = header.getInt(10);
+            byte[] payload = new byte[bodyLen];
+            in.readFully(payload);
+            if (type == TYPE_SEARCH_RESPONSE && resourceId == rid) {
+                return parseSearchResult(ByteBuffer.wrap(payload)
+                        .order(ByteOrder.LITTLE_ENDIAN));
+            }
+            // non-matching packet (heartbeat response, late reply): discard
+        }
+    }
+
+    @Override
+    public synchronized void close() throws IOException {
+        if (socket != null) {
+            socket.close();
+            socket = null;
+        }
+    }
+
+    // ------------------------------------------------------------------ wire
+
+    private void sendHeader(byte type, int bodyLength, int connectionId,
+                            int resourceId) throws IOException {
+        ByteBuffer buf = ByteBuffer.allocate(HEADER_SIZE)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        buf.put(type);
+        buf.put((byte) 0);                             // ProcessStatus::Ok
+        buf.putInt(bodyLength);
+        buf.putInt(connectionId);
+        buf.putInt(resourceId);
+        // 2 pad bytes remain zero (c_bufferSize = 16, 14 serialized)
+        out.write(buf.array());
+        out.flush();
+    }
+
+    private ByteBuffer readHeader() throws IOException {
+        byte[] raw = new byte[HEADER_SIZE];
+        in.readFully(raw);
+        return ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    }
+
+    private void skipBody(ByteBuffer header) throws IOException {
+        int bodyLen = header.getInt(2);
+        if (bodyLen > 0) {
+            in.readFully(new byte[bodyLen]);
+        }
+    }
+
+    private static SearchResult parseSearchResult(ByteBuffer buf) {
+        short major = buf.getShort();
+        buf.getShort();                                // mirror version
+        if (major != 1) {
+            return new SearchResult(2, new ArrayList<>());
+        }
+        int status = buf.get() & 0xFF;
+        int count = buf.getInt();
+        List<IndexResult> results = new ArrayList<>(count);
+        for (int i = 0; i < count; ++i) {
+            byte[] name = new byte[buf.getInt()];
+            buf.get(name);
+            int num = buf.getInt();
+            boolean withMeta = buf.get() != 0;
+            int[] ids = new int[num];
+            float[] dists = new float[num];
+            for (int j = 0; j < num; ++j) {
+                ids[j] = buf.getInt();
+                dists[j] = buf.getFloat();
+            }
+            byte[][] metas = null;
+            if (withMeta) {
+                metas = new byte[num][];
+                for (int j = 0; j < num; ++j) {
+                    metas[j] = new byte[buf.getInt()];
+                    buf.get(metas[j]);
+                }
+            }
+            results.add(new IndexResult(
+                    new String(name, StandardCharsets.UTF_8), ids, dists,
+                    metas));
+        }
+        return new SearchResult(status, results);
+    }
+}
