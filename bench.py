@@ -291,6 +291,11 @@ def main():
                 })
             except Exception as e:                       # noqa: BLE001
                 result["int8_error"] = repr(e)[:300]
+
+        # host-span tracing report (utils/trace.py) — where the wall time
+        # went, for the judge and for regression diffing
+        result["trace"] = {name: rec["total_s"]
+                           for name, rec in trace.report().items()}
     except Exception as e:                               # noqa: BLE001
         import traceback
         result["error"] = repr(e)[:300]

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -62,8 +63,11 @@ class BKTIndex(VectorIndex):
         self._dirty = True
         self._tombstones_dirty = False
         self._adds_since_rebuild = 0
-        self._rebuild_thread = None
+        self._rebuild_pool = None         # lazy 1-worker ThreadPool
+        self._rebuild_done = threading.Event()
+        self._rebuild_done.set()          # no rebuild in flight
         self._rebuild_pending = False
+        self._refine_dense_cache = None   # (key, DenseTreeSearcher)
         # bumped whenever row ids are remapped (build / compaction) so an
         # in-flight background rebuild can detect its snapshot went stale
         self._structure_gen = 0
@@ -211,8 +215,13 @@ class BKTIndex(VectorIndex):
         log.info("BKT forest built: %d nodes", self._tree.num_nodes)
 
         self._graph = self._new_graph()
-        self._graph.build(self._host[:self._n], int(self.dist_calc_method),
-                          self.base, self._refine_search_factory)
+        try:
+            self._graph.build(self._host[:self._n],
+                              int(self.dist_calc_method), self.base,
+                              self._refine_search_factory)
+        finally:
+            # free the mid-build device snapshot even when the build dies
+            self._refine_dense_cache = None
         self._dirty = True
 
     def _refine_search_factory(self, graph: np.ndarray):
@@ -230,7 +239,16 @@ class BKTIndex(VectorIndex):
         # shares this class) keeps the beam refine
         if getattr(p, "refine_search_mode", "beam") == "dense" and \
                 isinstance(self._tree, BKTree):
-            searcher = self._build_dense_searcher()
+            # the dense searcher depends on the TREE, not the graph snapshot
+            # this factory receives — cache it across the refine passes of
+            # one build (each pass re-invokes the factory)
+            key = (id(self._tree), self._structure_gen)
+            cached = self._refine_dense_cache
+            if cached is not None and cached[0] == key:
+                searcher = cached[1]
+            else:
+                searcher = self._build_dense_searcher()
+                self._refine_dense_cache = (key, searcher)
 
             def search(queries: np.ndarray, k: int):
                 # a candidate pool at least as big as k keeps the RNG prune
@@ -292,50 +310,81 @@ class BKTIndex(VectorIndex):
     # ---- background tree rebuild (P4) --------------------------------------
 
     def _schedule_rebuild(self) -> None:
-        """Queue a tree-forest rebuild on a background thread — searches keep
-        serving on the current immutable snapshot while it runs (reference
-        RebuildJob on the thread pool, BKTIndex.cpp:39-49, ThreadPool.h:18).
-        Called under the writer lock.  At most one rebuild runs; a request
-        arriving mid-rebuild coalesces into one follow-up pass."""
-        import threading
-
-        # the worker clears _rebuild_thread under this same lock before it
-        # exits, so "thread slot occupied" and "worker will still see the
-        # pending flag" are one atomic condition (no lost-request TOCTOU)
-        if self._rebuild_thread is not None:
+        """Queue a tree-forest rebuild on the index's background pool —
+        searches keep serving on the current immutable snapshot while it runs
+        (reference RebuildJob on Helper::ThreadPool, BKTIndex.cpp:39-49,
+        ThreadPool.h:18).  Called under the writer lock.  At most one rebuild
+        runs; a request arriving mid-rebuild coalesces into one follow-up
+        pass."""
+        # the worker sets _rebuild_done under this same lock before it
+        # exits, so "job in flight" and "worker will still see the pending
+        # flag" are one atomic condition (no lost-request TOCTOU)
+        if not self._rebuild_done.is_set():
             self._rebuild_pending = True
             return
+        if self._rebuild_pool is None:
+            from sptag_tpu.utils.threadpool import ThreadPool
+
+            self._rebuild_pool = ThreadPool()
+            self._rebuild_pool.init(1)    # one worker = reference cadence
         self._rebuild_pending = False
-        self._rebuild_thread = threading.Thread(
-            target=self._rebuild_job, daemon=True)
-        self._rebuild_thread.start()
+        # enqueue BEFORE clearing the event: if add() raises (pool stopped
+        # by a concurrent close()), _rebuild_done must stay set or no
+        # rebuild would ever be schedulable again
+        self._rebuild_pool.add(self._rebuild_job)
+        self._rebuild_done.clear()
 
     def _rebuild_job(self) -> None:
-        while True:
+        try:
+            while True:
+                with self._lock:
+                    gen = self._structure_gen
+                    n = self._n
+                    snapshot = self._host[:n].copy()
+                tree = self._new_tree()
+                tree.build(snapshot)      # the long pass — no lock held
+                with self._lock:
+                    # a compaction/rebuild remaps ids; drop a stale result
+                    # (BKTree::Rebuild swaps under a unique_lock,
+                    # BKTree.h:132-141)
+                    if self._structure_gen == gen:
+                        self._tree = tree
+                        self._dirty = True    # pivot set changed
+                    if not self._rebuild_pending:
+                        self._rebuild_done.set()  # exit decided under lock
+                        return
+                    self._rebuild_pending = False
+        except BaseException:
+            # a failed rebuild (XLA OOM, MemoryError) must not wedge the
+            # machinery: leave the old tree serving, unblock waiters, let
+            # the next add schedule a fresh attempt
             with self._lock:
-                gen = self._structure_gen
-                n = self._n
-                snapshot = self._host[:n].copy()
-            tree = self._new_tree()
-            tree.build(snapshot)          # the long pass — no lock held
-            with self._lock:
-                # a compaction/rebuild remaps ids; drop a stale result
-                # (BKTree::Rebuild swaps under a unique_lock, BKTree.h:132-141)
-                if self._structure_gen == gen:
-                    self._tree = tree
-                    self._dirty = True    # pivot set changed
-                if not self._rebuild_pending:
-                    self._rebuild_thread = None   # exit decided under lock
-                    return
                 self._rebuild_pending = False
+                self._rebuild_done.set()
+            raise
 
     def wait_for_rebuild(self, timeout: Optional[float] = None) -> None:
         """Block until any in-flight background rebuild completes (the
         reference test waits with a sleep, AlgoTest.cpp:95; this is
         deterministic)."""
-        t = self._rebuild_thread
-        if t is not None:
-            t.join(timeout)
+        self._rebuild_done.wait(timeout)
+
+    def close(self) -> None:
+        """Stop the background rebuild worker (idempotent).  A discarded
+        index otherwise leaks one idle daemon thread per ThreadPool.
+        The pool swap happens under the writer lock (so _schedule_rebuild
+        can't enqueue onto a stopping pool); the join happens outside it
+        (a running rebuild job needs the lock to finish)."""
+        with self._lock:
+            pool, self._rebuild_pool = self._rebuild_pool, None
+        if pool is not None:
+            pool.stop()
+
+    def __del__(self):                    # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:                              # noqa: BLE001
+            pass
 
     def _link_new_rows(self, engine: GraphSearchEngine, begin: int,
                        count: int) -> None:
@@ -457,11 +506,15 @@ class BKTIndex(VectorIndex):
 
         self._tree = self._new_tree()
         self._tree.build(self._host[:self._n])
-        self._graph.refine_once(
-            self._host[:self._n],
-            self._refine_search_factory(self._graph.graph),
-            self._graph.neighborhood_size, int(self.dist_calc_method),
-            self.base)
+        try:
+            self._graph.refine_once(
+                self._host[:self._n],
+                self._refine_search_factory(self._graph.graph),
+                self._graph.neighborhood_size, int(self.dist_calc_method),
+                self.base)
+        finally:
+            # free the refine-time device snapshot (same as _build's clear)
+            self._refine_dense_cache = None
         self._graph.repair_connectivity()
         self._adds_since_rebuild = 0
         self._dirty = True

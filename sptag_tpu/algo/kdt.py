@@ -33,9 +33,10 @@ from sptag_tpu.trees.kdtree import KDTree
 
 log = logging.getLogger(__name__)
 
-# other-children branches greedily descended per tree at seed time (the
-# reference's SPTQueue backtracking, KDTree.h:157-215)
-_BACKTRACK = 15
+# floor for other-children branches descended per tree at seed time (the
+# reference's SPTQueue backtracking, KDTree.h:157-215); the effective value
+# scales with the search budget — see _backtrack_for().
+_MIN_BACKTRACK = 4
 
 
 @register_algo
@@ -58,8 +59,23 @@ class KDTIndex(BKTIndex):
         count = min(n, max(64, self.params.initial_dynamic_pivots * 32))
         return np.linspace(0, n - 1, count, dtype=np.int32)
 
+    def _backtrack_for(self, max_check: int) -> int:
+        """Per-tree seed budget, coupled to the search budget.
+
+        The reference keeps tree-checked >= checked/10 by re-descending the
+        trees mid-walk (KDTIndex.cpp:105-141, `m_iNumberOfOtherDynamicPivots`
+        refills); the batched walk seeds up front, so the up-front budget is
+        the same total: ~max_check/10 tree-derived candidates split across
+        the forest, floored by NumberOfInitialDynamicPivots.
+        """
+        p = self.params
+        trees = max(p.tree_number, 1)
+        per_tree = max(max_check // 10, p.initial_dynamic_pivots) // trees
+        return int(np.clip(per_tree, _MIN_BACKTRACK, 64))
+
     def _seeds_for(self, queries: np.ndarray) -> np.ndarray:
-        return self._tree.collect_seeds(queries, backtrack=_BACKTRACK)
+        backtrack = self._backtrack_for(self.params.max_check)
+        return self._tree.collect_seeds(queries, backtrack=backtrack)
 
     def _search_batch(self, queries: np.ndarray,
                       k: int) -> Tuple[np.ndarray, np.ndarray]:

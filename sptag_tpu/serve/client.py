@@ -27,7 +27,10 @@ class AnnClient:
         self.timeout_s = timeout_s
         self.heartbeat_interval_s = heartbeat_interval_s
         self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        # RLock: search() calls close() from inside its locked region on
+        # error paths, and close() itself must hold the lock (the heartbeat
+        # pump mutates _sock concurrently)
+        self._lock = threading.RLock()
         self._next_resource = 1
         self._remote_cid = wire.INVALID_CONNECTION_ID
         self._hb_stop: Optional[threading.Event] = None
@@ -36,15 +39,27 @@ class AnnClient:
     # ------------------------------------------------------------ connection
 
     def connect(self) -> None:
-        sock = socket.create_connection((self.host, self.port),
-                                        timeout=self.timeout_s)
-        sock.settimeout(self.timeout_s)
-        self._sock = sock
-        # register handshake (Connection.cpp:301-312, 367-371)
-        self._send(wire.PacketHeader(wire.PacketType.RegisterRequest), b"")
-        header, _ = self._recv()
-        if header.packet_type == wire.PacketType.RegisterResponse:
-            self._remote_cid = header.connection_id
+        # dial-and-handshake entirely under the lock: two racing callers
+        # (or search()'s auto-reconnect racing an explicit connect()) must
+        # not both dial and leak the loser's socket
+        with self._lock:
+            if self._sock is not None:
+                return
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout_s)
+            sock.settimeout(self.timeout_s)
+            try:
+                # register handshake (Connection.cpp:301-312, 367-371)
+                self._send(sock,
+                           wire.PacketHeader(wire.PacketType.RegisterRequest),
+                           b"")
+                header, _ = self._recv(sock)
+            except OSError:
+                sock.close()
+                raise
+            self._sock = sock
+            if header.packet_type == wire.PacketType.RegisterResponse:
+                self._remote_cid = header.connection_id
         if self.heartbeat_interval_s > 0 and self._hb_thread is None:
             self.start_heartbeat(self.heartbeat_interval_s)
 
@@ -54,9 +69,10 @@ class AnnClient:
 
     def close(self) -> None:
         self.stop_heartbeat()
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
 
     # ------------------------------------------------------------- heartbeat
 
@@ -75,15 +91,16 @@ class AnnClient:
         def pump(stop: threading.Event) -> None:
             while not stop.wait(interval_s):
                 with self._lock:
-                    if self._sock is None:
+                    sock = self._sock
+                    if sock is None:
                         continue
                     try:
-                        self._send(wire.PacketHeader(
+                        self._send(sock, wire.PacketHeader(
                             wire.PacketType.HeartbeatRequest,
                             wire.PacketProcessStatus.Ok, 0,
                             self._remote_cid, 0), b"")
                     except OSError:
-                        self._sock.close()
+                        sock.close()
                         self._sock = None
 
         self._hb_thread = threading.Thread(
@@ -104,21 +121,31 @@ class AnnClient:
         (status Timeout / FailedNetwork on failure, matching the
         aggregator's partial-result statuses)."""
         if self._sock is None:
-            self.connect()
+            try:
+                self.connect()
+            except OSError:
+                return wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedNetwork, [])
         with self._lock:
+            # re-check under the lock: the heartbeat pump may have dropped
+            # the connection between the check above and lock acquisition
+            sock = self._sock
+            if sock is None:
+                return wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedNetwork, [])
             rid = self._next_resource
             self._next_resource += 1
             body = wire.RemoteQuery(query).pack()
             header = wire.PacketHeader(
                 wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
                 len(body), self._remote_cid, rid)
-            old_timeout = self._sock.gettimeout()
+            old_timeout = sock.gettimeout()
             if timeout_s is not None:
-                self._sock.settimeout(timeout_s)
+                sock.settimeout(timeout_s)
             try:
-                self._send(header, body)
+                self._send(sock, header, body)
                 while True:
-                    rhead, rbody = self._recv()
+                    rhead, rbody = self._recv(sock)
                     if rhead.packet_type == wire.PacketType.SearchResponse \
                             and rhead.resource_id == rid:
                         result = wire.RemoteSearchResult.unpack(rbody)
@@ -141,22 +168,23 @@ class AnnClient:
 
     # ------------------------------------------------------------------- io
 
-    def _send(self, header: wire.PacketHeader, body: bytes) -> None:
+    def _send(self, sock: socket.socket, header: wire.PacketHeader,
+              body: bytes) -> None:
         header.body_length = len(body)
-        self._sock.sendall(header.pack() + body)
+        sock.sendall(header.pack() + body)
 
-    def _recv(self):
-        head = self._read_exact(wire.HEADER_SIZE)
+    def _recv(self, sock: socket.socket):
+        head = self._read_exact(sock, wire.HEADER_SIZE)
         header = wire.PacketHeader.unpack(head)
-        body = self._read_exact(header.body_length) \
+        body = self._read_exact(sock, header.body_length) \
             if header.body_length else b""
         return header, body
 
-    def _read_exact(self, n: int) -> bytes:
+    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
         chunks = []
         remaining = n
         while remaining:
-            chunk = self._sock.recv(remaining)
+            chunk = sock.recv(remaining)
             if not chunk:
                 raise OSError("connection closed")
             chunks.append(chunk)

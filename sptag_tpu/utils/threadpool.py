@@ -67,3 +67,7 @@ class ThreadPool:
                 logging.getLogger(__name__).exception("ThreadPool job failed")
             finally:
                 self._queue.task_done()
+                # drop the reference before blocking in get(): a retained
+                # bound method would pin its owner (and everything it
+                # holds) for as long as the worker idles
+                job = None

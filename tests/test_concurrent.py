@@ -76,8 +76,95 @@ def test_concurrent_add_search_save(tmp_path):
         t.join(timeout=120)
     assert not errors, errors
     assert index.num_samples == 464
+    # quiesce the background rebuild before teardown — a daemon thread shut
+    # down mid-XLA-call aborts the interpreter at exit
+    index.wait_for_rebuild(timeout=120)
 
     # the last snapshot loads and searches
     loaded = sp.load_index(str(tmp_path / "snap2"))
     _, ids = loaded.search_batch(data[:4], 1)
     assert (ids[:, 0] >= 0).all()
+
+
+def test_concurrent_delete_search_rebuild():
+    """Harsher race surface: deletes + adds + searches from 6 threads while
+    background rebuilds fire (AddCountForRebuild=32).  Exercises the
+    _dirty/_tombstones_dirty double-checked snapshot swap with readers
+    outside the lock: a deleted id must never appear in results after its
+    delete returns, and searches must stay well-formed throughout."""
+    rng = np.random.default_rng(3)
+    d = 12
+    data = rng.standard_normal((256, d)).astype(np.float32)
+
+    index = sp.create_instance("BKT", "Float")
+    for name, value in [("DistCalcMethod", "L2"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "64"),
+                        ("NeighborhoodSize", "12"), ("CEF", "48"),
+                        ("AddCEF", "24"), ("MaxCheckForRefineGraph", "96"),
+                        ("MaxCheck", "256"), ("RefineIterations", "1"),
+                        ("Samples", "100"), ("DenseClusterSize", "64"),
+                        ("AddCountForRebuild", "32")]:
+        index.set_parameter(name, value)
+    assert index.build(data) == sp.ErrorCode.Success
+
+    errors = []
+    stop = threading.Event()
+    deleted_lock = threading.Lock()
+    confirmed_deleted = set()
+
+    def deleter(ids_to_delete):
+        try:
+            for vid in ids_to_delete:
+                # delete-by-content (BKTIndex.cpp:439-453): tombstones rows
+                # at distance <= eps, i.e. exactly row `vid` (no duplicates
+                # in this corpus).  The search may legitimately miss the row
+                # (VectorNotFound) — only Successes become invariants.
+                rc = index.delete(data[vid:vid + 1])
+                if rc == sp.ErrorCode.Success:
+                    with deleted_lock:
+                        confirmed_deleted.add(vid)
+                time.sleep(0.002)
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    def adder():
+        try:
+            for _ in range(4):
+                new = rng.standard_normal((16, d)).astype(np.float32)
+                assert index.add(new) == sp.ErrorCode.Success
+                time.sleep(0.005)
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def searcher():
+        try:
+            while not stop.is_set():
+                with deleted_lock:
+                    banned = set(confirmed_deleted)
+                dists, ids = index.search_batch(data[:32], 8)
+                assert ids.shape == (32, 8)
+                assert np.all(np.diff(dists, axis=1) >= -1e-3)
+                hit = set(int(x) for x in ids.ravel() if x >= 0) & banned
+                assert not hit, f"deleted ids returned: {hit}"
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    # disjoint delete ranges per deleter thread
+    threads = ([threading.Thread(target=deleter,
+                                 args=(range(i * 40, i * 40 + 20),))
+                for i in range(2)]
+               + [threading.Thread(target=adder)]
+               + [threading.Thread(target=searcher) for _ in range(2)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, errors
+    assert index.num_samples == 256 + 64
+    index.wait_for_rebuild(timeout=120)
+    # post-quiescence: all confirmed deletes stay invisible
+    _, ids = index.search_batch(data[:64], 10)
+    leaked = set(int(x) for x in ids.ravel() if x >= 0) & confirmed_deleted
+    assert not leaked, leaked

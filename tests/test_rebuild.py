@@ -66,18 +66,16 @@ def test_background_rebuild_does_not_block_search():
            + rng.standard_normal((256, d)).astype(np.float32))
     assert index.add(new) == sp.ErrorCode.Success   # triggers the rebuild
 
-    # while the rebuild thread is alive, searches must proceed
+    # while the rebuild job is in flight, searches must proceed
     searched = 0
     t0 = time.perf_counter()
-    while index._rebuild_thread is not None \
-            and index._rebuild_thread.is_alive() \
+    while not index._rebuild_done.is_set() \
             and time.perf_counter() - t0 < 60:
         _, ids = index.search_batch(data[:8], 3)
         assert ids.shape == (8, 3)
         searched += 1
     index.wait_for_rebuild(timeout=120)
-    assert index._rebuild_thread is None or \
-        not index._rebuild_thread.is_alive()
+    assert index._rebuild_done.is_set()
 
     # post-swap: the new forest serves, including the added rows
     _, ids = index.search_batch(new[:8], 1)
