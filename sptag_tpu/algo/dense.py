@@ -35,12 +35,13 @@ import numpy as np
 
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.ops import pallas_kernels
 from sptag_tpu.utils import query_bucket, round_up
 
 MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
 # score-buffer budget per kernel call (bytes): Q * nprobe * P * D * 4
-_GATHER_BUDGET = 1 << 28
+_GATHER_BUDGET = 1 << 30
 
 
 def partition_from_tree(tree, n: int, target_size: int
@@ -122,26 +123,79 @@ def partition_from_tree(tree, n: int, target_size: int
     for s in loose:
         smallest = min(range(len(clusters)), key=lambda i: len(clusters[i]))
         clusters[smallest] = np.append(clusters[smallest], s)
-    return np.asarray(centers, np.int64), clusters
+
+    # ---- pack small subtrees into near-full blocks ------------------------
+    # A k-means tree cut yields MANY subtrees far below target_size (k=32
+    # fan-out: one level is ~N/32, the next ~N/1024), and the searcher pads
+    # every cluster to the max size: measured on a 200k corpus, 8371 raw
+    # clusters averaged 24 rows padded to 256 — 90% of every probe's score
+    # budget was padding, which both wastes HBM and guts recall at a given
+    # MaxCheck.  Greedily merging BFS-adjacent clusters (tree siblings ==
+    # spatially close by construction) into blocks of <= target_size makes
+    # blocks ~full, so a probe scores ~target_size REAL candidates.  The
+    # merged block keeps the center of its largest constituent.
+    packed_c: List[np.ndarray] = []
+    packed_id: List[int] = []
+    cur: List[np.ndarray] = []
+    cur_center, cur_best, cur_n = -1, -1, 0
+    for ci in range(len(clusters)):
+        sz = len(clusters[ci])
+        if cur_n and cur_n + sz > target_size:
+            packed_c.append(np.concatenate(cur))
+            packed_id.append(cur_center)
+            cur, cur_center, cur_best, cur_n = [], -1, -1, 0
+        cur.append(clusters[ci])
+        if sz > cur_best:
+            cur_best, cur_center = sz, centers[ci]
+        cur_n += sz
+    if cur_n:
+        packed_c.append(np.concatenate(cur))
+        packed_id.append(cur_center)
+    return np.asarray(packed_id, np.int64), packed_c
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "nprobe", "metric", "base"))
+                   static_argnames=("k", "nprobe", "metric", "base",
+                                    "use_pallas", "interpret"))
 def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
                         cent_sq, deleted, queries, k: int, nprobe: int,
-                        metric: int, base: int):
+                        metric: int, base: int, use_pallas: bool = False,
+                        interpret: bool = False):
     """One program: (Q,C) center scores -> top-nprobe block gather ->
-    (Q, nprobe*P) candidate scores -> masked top-k."""
+    (Q, nprobe*P) candidate scores -> masked top-k.
+
+    With `use_pallas`, the block gather + scoring runs as the Pallas DMA
+    kernel (ops/pallas_kernels.py) — the XLA gather materializes the
+    (Q, nprobe, P, D) candidate tensor in HBM; the kernel streams blocks
+    through VMEM instead."""
     Q = queries.shape[0]
     C, P, D = data_perm.shape
-    d0 = dist_ops.pairwise_distance(queries, centroids,
+    # centroids are float32 block MEANS even for integer corpora — score
+    # them with float queries (int8/int16 values are exact in f32; the
+    # integer dot branch would truncate the means to int32 and mis-rank
+    # blocks against the float cent_sq term)
+    d0 = dist_ops.pairwise_distance(queries.astype(jnp.float32), centroids,
                                     DistCalcMethod(metric), x_sqnorm=cent_sq)
     _, topc = jax.lax.top_k(-d0, nprobe)                     # (Q, nprobe)
-    vecs = data_perm[topc].reshape(Q, nprobe * P, D)
     ids = member_ids[topc].reshape(Q, nprobe * P)
     sq = member_sq[topc].reshape(Q, nprobe * P)
-    nd = dist_ops.batched_gathered_distance(
-        queries, vecs, DistCalcMethod(metric), base, sq)
+    if use_pallas:
+        from sptag_tpu.ops import pallas_kernels
+
+        dot = pallas_kernels.probe_block_dots(
+            data_perm, queries.astype(jnp.float32),
+            topc.astype(jnp.int32),
+            interpret=interpret).reshape(Q, nprobe * P)
+        if int(metric) == int(DistCalcMethod.Cosine):
+            nd = float(base) * float(base) - dot
+        else:
+            qf = queries.astype(jnp.float32)
+            qn = jnp.sum(qf * qf, axis=-1)[:, None]
+            nd = jnp.maximum(qn + sq - 2.0 * dot, 0.0)
+    else:
+        vecs = data_perm[topc].reshape(Q, nprobe * P, D)
+        nd = dist_ops.batched_gathered_distance(
+            queries, vecs, DistCalcMethod(metric), base, sq)
     dead = deleted[jnp.maximum(ids, 0)] | (ids < 0)
     nd = jnp.where(dead, MAX_DIST, nd)
     k_eff = min(k, nprobe * P)
@@ -152,8 +206,36 @@ def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
     return out_d, out_ids.astype(jnp.int32)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "metric", "base",
+                                    "use_pallas", "interpret"))
+def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
+                          cent_sq, deleted, queries3, k: int, nprobe: int,
+                          metric: int, base: int, use_pallas: bool = False,
+                          interpret: bool = False):
+    """(M, chunk, D) query chunks -> ((M, chunk, k), (M, chunk, k)).
+
+    `lax.map` over the chunk axis keeps the WHOLE multi-chunk search one
+    device program: one host->device upload, one dispatch, one
+    device->host read.  On a tunneled backend every host round trip costs
+    ~60 ms, so per-chunk Python loops serialize into RTT * chunks while
+    this stays at ~2 RTTs total.  Memory: chunks run sequentially, so the
+    per-chunk score buffer is reused rather than multiplied."""
+    def body(q):
+        return _dense_search_kernel(
+            data_perm, member_ids, member_sq, centroids, cent_sq, deleted,
+            q, k, nprobe, metric, base, use_pallas, interpret)
+    return jax.lax.map(body, queries3)
+
+
 class DenseTreeSearcher:
-    """Immutable device snapshot of the cluster-contiguous layout."""
+    """Immutable device snapshot of the cluster-contiguous layout.
+
+    Probe ranking uses per-block MEAN centroids computed here from
+    `clusters`; the `centers` medoid-sample ids are NOT used for ranking —
+    they only serve callers that need a representative sample per block
+    (BKTIndex._build_dense_searcher assigns tree-uncovered rows to their
+    nearest center)."""
 
     def __init__(self, data: np.ndarray, centers: np.ndarray,
                  clusters: List[np.ndarray],
@@ -179,7 +261,13 @@ class DenseTreeSearcher:
         # padding rows have sqnorm 0 == a real-looking vector; the id mask
         # already excludes them from the top-k
         self.member_sq = jnp.asarray(sq)
-        self.centroids = jnp.asarray(data[centers])
+        # probe ranking uses the block MEAN (an IVF-style centroid): packed
+        # blocks hold several tree subtrees, and a single medoid sample of
+        # one constituent ranks the block far worse than its mean does
+        means = np.stack([
+            data[members].astype(np.float32).mean(axis=0)
+            for members in clusters])
+        self.centroids = jnp.asarray(means)
         self.cent_sq = jax.jit(dist_ops.row_sqnorms)(self.centroids)
         if deleted is None:
             deleted = np.zeros(self.n, bool)
@@ -200,19 +288,41 @@ class DenseTreeSearcher:
         k_eff = min(k, nprobe * P, self.n)
 
         chunk = max(1, min(_GATHER_BUDGET // (nprobe * P * D * 4), 1024))
+        use_pallas = pallas_kernels.supported(self.data_perm)
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
-        for off in range(0, nq, chunk):
-            q = queries[off:off + chunk]
-            qn = q.shape[0]
-            q_pad = query_bucket(qn, chunk)
-            if q_pad != qn:
+        if nq <= chunk:
+            q_pad = query_bucket(nq, chunk)
+            q = queries
+            if q_pad != nq:
                 q = np.concatenate(
-                    [q, np.zeros((q_pad - qn, D), q.dtype)])
+                    [q, np.zeros((q_pad - nq, D), q.dtype)])
             d, ids = _dense_search_kernel(
                 self.data_perm, self.member_ids, self.member_sq,
                 self.centroids, self.cent_sq, self.deleted, jnp.asarray(q),
-                k_eff, nprobe, int(self.metric), self.base)
-            out_d[off:off + qn, :d.shape[1]] = np.asarray(d)[:qn]
-            out_i[off:off + qn, :ids.shape[1]] = np.asarray(ids)[:qn]
+                k_eff, nprobe, int(self.metric), self.base,
+                use_pallas=use_pallas,
+                interpret=pallas_kernels.interpret())
+            out_d[:, :d.shape[1]] = np.asarray(d)[:nq]
+            out_i[:, :ids.shape[1]] = np.asarray(ids)[:nq]
+            return out_d, out_i
+        # multi-chunk: ONE device program (lax.map over chunks) — a Python
+        # chunk loop would pay the tunneled backend's ~60 ms round trip per
+        # chunk; this costs ~2 round trips total for any batch size
+        m = -(-nq // chunk)
+        q = queries
+        if m * chunk != nq:
+            q = np.concatenate(
+                [q, np.zeros((m * chunk - nq, D), q.dtype)])
+        d, ids = _dense_search_chunked(
+            self.data_perm, self.member_ids, self.member_sq,
+            self.centroids, self.cent_sq, self.deleted,
+            jnp.asarray(q.reshape(m, chunk, D)),
+            k_eff, nprobe, int(self.metric), self.base,
+            use_pallas=use_pallas,
+            interpret=pallas_kernels.interpret())
+        d = np.asarray(d).reshape(m * chunk, -1)
+        ids = np.asarray(ids).reshape(m * chunk, -1)
+        out_d[:, :d.shape[1]] = d[:nq]
+        out_i[:, :ids.shape[1]] = ids[:nq]
         return out_d, out_i

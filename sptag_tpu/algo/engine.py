@@ -208,6 +208,40 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
                  visited, k, L, B, T, metric, base, nbp_limit)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit",
+                     "inject"))
+def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
+                         pivot_mask, queries3, k: int, L: int, B: int,
+                         T: int, metric: int, base: int, nbp_limit: int,
+                         inject: int = 4):
+    """(M, chunk, D) query chunks under one `lax.map` — a single device
+    program for any batch size (one upload, one dispatch, one read; the
+    tunneled backend costs ~60 ms per host round trip).  The per-chunk
+    visited bitset is reused across sequential chunks instead of scaling
+    with the total batch."""
+    def body(q):
+        return _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids,
+                                   pivot_vecs, pivot_mask, q, k, L, B, T,
+                                   metric, base, nbp_limit, inject)
+    return jax.lax.map(body, queries3)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
+def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
+                                queries3, k: int, L: int, B: int, T: int,
+                                metric: int, base: int, nbp_limit: int):
+    def body(args):
+        s, q = args
+        return _beam_search_seeded_kernel(data, sqnorm, graph, deleted, s,
+                                          q, k, L, B, T, metric, base,
+                                          nbp_limit)
+    return jax.lax.map(body, (seeds3, queries3))
+
+
 def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
           k: int, L: int, B: int, T: int, metric: int, base: int,
           nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0):
@@ -389,13 +423,13 @@ class GraphSearchEngine:
         chunk = max(1, min(_VISITED_BUDGET // max(self.n // 8, 1), 1024))
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
-        for off in range(0, nq, chunk):
-            q = queries[off:off + chunk]
-            qn = q.shape[0]
-            q_pad = query_bucket(qn, chunk)
-            if q_pad != qn:
+        D = queries.shape[1]
+        if nq <= chunk:
+            q_pad = query_bucket(nq, chunk)
+            q = queries
+            if q_pad != nq:
                 q = np.concatenate(
-                    [q, np.zeros((q_pad - qn, q.shape[1]), q.dtype)])
+                    [q, np.zeros((q_pad - nq, D), q.dtype)])
             if seeds is None:
                 d, ids = _beam_search_kernel(
                     self.data, self.sqnorm, self.graph, self.deleted,
@@ -404,16 +438,48 @@ class GraphSearchEngine:
                     k_eff, L, B, T, int(self.metric), self.base, limit,
                     inject=dynamic_pivots)
             else:
-                s = seeds[off:off + qn].astype(np.int32, copy=False)
-                if q_pad != qn:
+                s = seeds.astype(np.int32, copy=False)
+                if q_pad != nq:
                     s = np.concatenate(
-                        [s, np.full((q_pad - qn, s.shape[1]), -1, np.int32)])
+                        [s, np.full((q_pad - nq, s.shape[1]), -1,
+                                    np.int32)])
                 d, ids = _beam_search_seeded_kernel(
                     self.data, self.sqnorm, self.graph, self.deleted,
                     jnp.asarray(s), jnp.asarray(q),
                     k_eff, L, B, T, int(self.metric), self.base, limit)
-            out_d[off:off + qn, :k_eff] = np.asarray(d)[:qn]
-            out_i[off:off + qn, :k_eff] = np.asarray(ids)[:qn]
+            out_d[:, :k_eff] = np.asarray(d)[:nq]
+            out_i[:, :k_eff] = np.asarray(ids)[:nq]
+            return out_d, out_i
+        # multi-chunk: one lax.map device program (one upload / dispatch /
+        # read — a Python chunk loop pays the tunneled backend's ~60 ms
+        # round trip once PER chunk)
+        m = -(-nq // chunk)
+        q = queries
+        if m * chunk != nq:
+            q = np.concatenate(
+                [q, np.zeros((m * chunk - nq, D), q.dtype)])
+        if seeds is None:
+            d, ids = _beam_search_chunked(
+                self.data, self.sqnorm, self.graph, self.deleted,
+                self.pivot_ids, self.pivot_vecs, self.pivot_mask,
+                jnp.asarray(q.reshape(m, chunk, D)),
+                k_eff, L, B, T, int(self.metric), self.base, limit,
+                inject=dynamic_pivots)
+        else:
+            s = seeds.astype(np.int32, copy=False)
+            if m * chunk != nq:
+                s = np.concatenate(
+                    [s, np.full((m * chunk - nq, s.shape[1]), -1,
+                                np.int32)])
+            d, ids = _beam_search_seeded_chunked(
+                self.data, self.sqnorm, self.graph, self.deleted,
+                jnp.asarray(s.reshape(m, chunk, -1)),
+                jnp.asarray(q.reshape(m, chunk, D)),
+                k_eff, L, B, T, int(self.metric), self.base, limit)
+        d = np.asarray(d).reshape(m * chunk, -1)
+        ids = np.asarray(ids).reshape(m * chunk, -1)
+        out_d[:, :k_eff] = d[:nq]
+        out_i[:, :k_eff] = ids[:nq]
         return out_d, out_i
 
 

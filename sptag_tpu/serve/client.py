@@ -60,8 +60,10 @@ class AnnClient:
             self._sock = sock
             if header.packet_type == wire.PacketType.RegisterResponse:
                 self._remote_cid = header.connection_id
-        if self.heartbeat_interval_s > 0 and self._hb_thread is None:
-            self.start_heartbeat(self.heartbeat_interval_s)
+            # still under the lock: two racing connects must not both see
+            # _hb_thread None and start duplicate pump threads
+            if self.heartbeat_interval_s > 0 and self._hb_thread is None:
+                self.start_heartbeat(self.heartbeat_interval_s)
 
     @property
     def is_connected(self) -> bool:
