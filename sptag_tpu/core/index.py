@@ -182,6 +182,9 @@ class VectorIndex(abc.ABC):
     def build(self, vectors, metadata: Optional[MetadataSet] = None,
               with_meta_index: bool = False) -> ErrorCode:
         """Parity: VectorIndex::BuildIndex (reference VectorIndex.cpp:192-208)."""
+        from sptag_tpu.utils import enable_compile_cache
+
+        enable_compile_cache()    # build kernels are the compile-heavy ones
         data = self._prepare_vectors(vectors)
         if data.size == 0:
             return ErrorCode.EmptyData

@@ -32,7 +32,7 @@ import numpy as np
 from sptag_tpu.io import format as fmt
 from sptag_tpu.graph.tptree import tpt_partition
 from sptag_tpu.ops import graph as graph_ops
-from sptag_tpu.utils import round_up
+from sptag_tpu.utils import shape_bucket
 
 log = logging.getLogger(__name__)
 
@@ -45,6 +45,14 @@ _PRUNE_CHUNK = 4096
 
 # SearchFn(queries (Q, D), k) -> (dists (Q, k), ids (Q, k))
 SearchFn = Callable[[np.ndarray, int], Tuple[np.ndarray, np.ndarray]]
+
+
+def _pad_rows(arr: np.ndarray, rows: int, fill) -> np.ndarray:
+    """Pad arr's first axis up to `rows` with `fill`."""
+    if arr.shape[0] >= rows:
+        return arr
+    pad = np.full((rows - arr.shape[0],) + arr.shape[1:], fill, arr.dtype)
+    return np.concatenate([arr, pad])
 
 
 class RelativeNeighborhoodGraph:
@@ -181,11 +189,14 @@ class RelativeNeighborhoodGraph:
         new_ids = np.full((n, C), -1, np.int32)
         new_d = np.full((n, C), MAX_DIST, np.float32)
         max_leaf = max(len(leaf) for leaf in leaves)
-        P = max(round_up(max_leaf, 128), 128)
+        # bucket the leaf pad: max_leaf varies per tree, and every distinct
+        # (B, P) shape recompiles the all-pairs kernel (20-40 s each on a
+        # tunneled TPU)
+        P = shape_bucket(max(max_leaf, 128), lo=128)
         batch = max(1, _ALLPAIRS_BUDGET // (P * P))
         for off in range(0, len(leaves), batch):
             chunk = leaves[off:off + batch]
-            B = len(chunk)
+            B = shape_bucket(len(chunk), lo=1)
             ids_pad = np.full((B, P), -1, np.int64)
             vecs = np.zeros((B, P, data.shape[1]), np.float32)
             valid = np.zeros((B, P), bool)
@@ -216,18 +227,24 @@ class RelativeNeighborhoodGraph:
         n, C = cand_ids.shape
         out = np.full((n, width), -1, np.int32)
         for off in range(0, n, _PRUNE_CHUNK):
-            rows = slice(off, min(off + _PRUNE_CHUNK, n))
-            ids = cand_ids[rows]
-            d = cand_d[rows]
+            stop = min(off + _PRUNE_CHUNK, n)
+            cnt = stop - off
+            # pad the tail chunk to the fixed size — a remainder shape
+            # would compile a second rng_select kernel
+            pad = _PRUNE_CHUNK if n > _PRUNE_CHUNK else cnt
+            ids = _pad_rows(cand_ids[off:stop], pad, -1)
+            d = _pad_rows(cand_d[off:stop], pad, MAX_DIST)
             vecs = data[np.maximum(ids, 0)].astype(np.float32)
             keep = np.asarray(graph_ops.rng_select(
-                jnp.asarray(data[rows.start:rows.stop].astype(np.float32)),
+                jnp.asarray(_pad_rows(
+                    data[off:stop].astype(np.float32), pad, 0.0)),
                 jnp.asarray(vecs), jnp.asarray(d),
-                jnp.asarray(ids >= 0), width, metric, base))
+                jnp.asarray(ids >= 0), width, metric, base))[:cnt]
+            ids = ids[:cnt]
             sel = np.where(keep >= 0,
                            np.take_along_axis(ids, np.maximum(keep, 0),
                                               axis=1), -1)
-            out[rows] = sel
+            out[off:stop] = sel
         return out
 
     def refine_once(self, data: np.ndarray, search_fn: SearchFn, width: int,
@@ -242,11 +259,18 @@ class RelativeNeighborhoodGraph:
         k = min(self.cef + 1, n)
         new_graph = np.full((n, width), -1, np.int32)
         for off in range(0, n, _PRUNE_CHUNK):
-            rows = slice(off, min(off + _PRUNE_CHUNK, n))
-            queries = data[rows]
+            stop = min(off + _PRUNE_CHUNK, n)
+            cnt = stop - off
+            pad = _PRUNE_CHUNK if n > _PRUNE_CHUNK else cnt
+            # pad the tail chunk so the search + rng_select kernels keep one
+            # shape across the whole pass (padding rows repeat row `off`;
+            # their results are discarded)
+            queries = _pad_rows(data[off:stop], pad, 0)
+            if cnt < pad:
+                queries[cnt:] = data[off]
             d, ids = search_fn(queries, k)
             # drop self-hits, keep ascending order
-            node_ids = np.arange(rows.start, rows.stop)[:, None]
+            node_ids = np.arange(off, off + pad)[:, None]
             is_self = ids == node_ids
             d = np.where(is_self, MAX_DIST, d)
             order = np.argsort(d, axis=1, kind="stable")
@@ -260,8 +284,9 @@ class RelativeNeighborhoodGraph:
             keep = np.asarray(graph_ops.rng_select(
                 jnp.asarray(queries.astype(np.float32)),
                 jnp.asarray(vecs), jnp.asarray(d),
-                jnp.asarray(ids >= 0), width, metric, base))
-            new_graph[rows] = np.where(
+                jnp.asarray(ids >= 0), width, metric, base))[:cnt]
+            ids = ids[:cnt]
+            new_graph[off:stop] = np.where(
                 keep >= 0,
                 np.take_along_axis(ids, np.maximum(keep, 0), axis=1), -1)
         self.graph = new_graph

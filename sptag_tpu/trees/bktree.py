@@ -41,8 +41,13 @@ from sptag_tpu.ops import kmeans as km
 _MAX_BATCH_ROWS = 1 << 21
 
 
-def _next_pow2(x: int) -> int:
-    return 1 << max(0, (x - 1).bit_length())
+from sptag_tpu.utils import shape_bucket as _shape_bucket
+
+# Every distinct (B, P) pair compiles a fresh XLA kernel pair — measured
+# 77% of a 20k-corpus tree build was 37 recompiles (and a tunneled-TPU
+# compile costs 20-40 s, dominating the 200k build's hour).  The coarse
+# utils.shape_bucket ladder cuts the shape zoo at the cost of ≤4x padding
+# compute, which is cheap on the MXU.
 
 
 class BKTree:
@@ -134,10 +139,10 @@ class BKTree:
         results: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         buckets: Dict[int, List[int]] = {}
         for idx, (ni, ids, hc) in enumerate(km_items):
-            buckets.setdefault(_next_pow2(len(ids)), []).append(idx)
+            buckets.setdefault(_shape_bucket(len(ids)), []).append(idx)
 
         for p_full, idxs in sorted(buckets.items()):
-            p_sub = _next_pow2(min(p_full, self.samples))
+            p_sub = _shape_bucket(min(p_full, self.samples))
             max_b = max(1, _MAX_BATCH_ROWS // p_full)
             for off in range(0, len(idxs), max_b):
                 chunk = idxs[off:off + max_b]
@@ -194,9 +199,10 @@ class BKTree:
         # reference's per-node loop never hits this because it k-means only
         # nodes with > leaf_size samples and K <= default leaf budgets)
         K = min(self.kmeans_k, p_sub)
-        # pad the batch dim to a power of two so deep levels with varying
-        # node counts reuse compiled kernels instead of recompiling per shape
-        B = _next_pow2(len(chunk))
+        # bucket the batch dim too — same recompile argument as the row
+        # dim — but never past the device row budget the caller chunked by
+        max_b = max(1, _MAX_BATCH_ROWS // p_full)
+        B = min(_shape_bucket(len(chunk), lo=1), max_b)
         D = data.shape[1]
         sub = np.zeros((B, p_sub, D), np.float32)
         sub_valid = np.zeros((B, p_sub), bool)

@@ -15,3 +15,52 @@ def query_bucket(q: int, cap: int) -> int:
         if q <= b:
             return min(b, cap)
     return min(round_up(q, QUERY_BUCKETS[-1]), cap)
+
+
+_cache_enabled = False
+
+
+def enable_compile_cache() -> None:
+    """Point jax at a persistent compilation cache (idempotent).
+
+    Build kernels cost 20-40 s EACH to compile on a tunneled TPU backend;
+    the persistent cache makes repeat builds (and repeat processes) reuse
+    them.  Directory: $SPTAG_TPU_COMPILE_CACHE, default /tmp/jax_cache;
+    set it to "" to disable.  Called from the index build/search entry
+    points rather than import time so importing the library never
+    initializes a backend.
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    path = os.environ.get("SPTAG_TPU_COMPILE_CACHE", "/tmp/jax_cache")
+    if not path:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:                                  # noqa: BLE001
+        pass                      # older jax without the knobs
+
+
+def shape_bucket(x: int, lo: int = 32) -> int:
+    """Quantize a padded array dimension to a small ladder: powers of 4
+    below 2^15, powers of 2 above.  Every distinct padded shape compiles a
+    fresh XLA kernel (20-40 s each on a tunneled TPU backend); coarse
+    buckets trade ≤4x padding compute — cheap on the MXU — for an
+    order-of-magnitude fewer compiles across a build."""
+    if x >= (1 << 15):
+        return 1 << max(0, (x - 1).bit_length())
+    b = max(1, lo)
+    while b < x:
+        b *= 4
+    if b >= (1 << 15):
+        # the pow4 ladder overshot the crossover (e.g. lo=1 ladder misses
+        # 32768): fall back to pow2 so the function stays monotonic
+        return 1 << max(0, (x - 1).bit_length())
+    return b
