@@ -250,8 +250,9 @@ def main():
 
         # persistent XLA compile cache: repeat bench invocations skip the
         # 20-40s first-compiles
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        from sptag_tpu.utils import enable_compile_cache
+
+        enable_compile_cache()
 
         import sptag_tpu as sp
         from sptag_tpu.utils import trace
@@ -292,6 +293,29 @@ def main():
             "build_cached": cached,
             "batch": batch,
         })
+
+        # roofline accounting (SURVEY §7 hard part #2): per-query work of
+        # the dense path = center scoring (2*C*D flops) + candidate scoring
+        # (2*MaxCheck*D flops, MaxCheck*D*4 bytes of block reads).  Utils
+        # vs v5e peaks (197 Tf/s bf16 MXU, 819 GB/s HBM) say whether the
+        # engine is compute-, bandwidth-, or (here) round-trip-bound.
+        try:
+            dense = index._get_dense()
+            mc = int(index.params.max_check)
+            d_dim = data.shape[1]
+            flops_q = 2.0 * (dense.num_clusters + mc) * d_dim
+            bytes_q = float(mc * d_dim * 4)
+            result["roofline"] = {
+                "flops_per_query": int(flops_q),
+                "hbm_bytes_per_query": int(bytes_q),
+                "achieved_gflops": round(qps * flops_q / 1e9, 2),
+                "achieved_gbps": round(qps * bytes_q / 1e9, 2),
+                "mxu_util_pct_f32peak": round(
+                    100.0 * qps * flops_q / 49e12, 4),
+                "hbm_util_pct": round(100.0 * qps * bytes_q / 819e9, 2),
+            }
+        except Exception:                                # noqa: BLE001
+            pass
 
         # secondary metric: int8 cosine end-to-end (BASELINE.md config 4) —
         # exercises the `base^2 - dot` integer convention at index level

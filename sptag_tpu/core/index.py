@@ -85,6 +85,12 @@ class VectorIndex(abc.ABC):
     algo: IndexAlgoType = IndexAlgoType.Undefined
 
     def __init__(self, value_type: VectorValueType):
+        from sptag_tpu.utils import enable_compile_cache
+
+        # every index path (build, load+search) wants the persistent XLA
+        # compile cache; idempotent and backend-free, so ctor is the one
+        # place that covers them all
+        enable_compile_cache()
         self.value_type = VectorValueType(value_type)
         self.params: ParamSet = self._make_params()
         self.metadata: Optional[MetadataSet] = None
@@ -182,9 +188,6 @@ class VectorIndex(abc.ABC):
     def build(self, vectors, metadata: Optional[MetadataSet] = None,
               with_meta_index: bool = False) -> ErrorCode:
         """Parity: VectorIndex::BuildIndex (reference VectorIndex.cpp:192-208)."""
-        from sptag_tpu.utils import enable_compile_cache
-
-        enable_compile_cache()    # build kernels are the compile-heavy ones
         data = self._prepare_vectors(vectors)
         if data.size == 0:
             return ErrorCode.EmptyData

@@ -196,7 +196,9 @@ class RelativeNeighborhoodGraph:
         batch = max(1, _ALLPAIRS_BUDGET // (P * P))
         for off in range(0, len(leaves), batch):
             chunk = leaves[off:off + batch]
-            B = shape_bucket(len(chunk), lo=1)
+            # bucket for compile reuse but never past the budget-derived
+            # chunk cap (bucketing past it would overshoot _ALLPAIRS_BUDGET)
+            B = min(shape_bucket(len(chunk), lo=1), batch)
             ids_pad = np.full((B, P), -1, np.int64)
             vecs = np.zeros((B, P, data.shape[1]), np.float32)
             valid = np.zeros((B, P), bool)

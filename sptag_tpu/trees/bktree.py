@@ -147,7 +147,8 @@ class BKTree:
             for off in range(0, len(idxs), max_b):
                 chunk = idxs[off:off + max_b]
                 self._run_kmeans_chunk(
-                    data, km_items, chunk, p_full, p_sub, rng, key, results)
+                    data, km_items, chunk, p_full, p_sub, max_b, rng, key,
+                    results)
 
         # ---- materialize children from labels
         for idx, (ni, ids, has_center) in enumerate(km_items):
@@ -192,7 +193,7 @@ class BKTree:
         return next_level
 
     def _run_kmeans_chunk(self, data, km_items, chunk, p_full, p_sub,
-                          rng, key, results):
+                          max_b, rng, key, results):
         """Run one padded (B, P) batch through device kmeans; fill results
         as (labels over the item's ids, counts (K,), medoid sample ids)."""
         # a node smaller than K can't seed K distinct centers; clamp (the
@@ -201,7 +202,6 @@ class BKTree:
         K = min(self.kmeans_k, p_sub)
         # bucket the batch dim too — same recompile argument as the row
         # dim — but never past the device row budget the caller chunked by
-        max_b = max(1, _MAX_BATCH_ROWS // p_full)
         B = min(_shape_bucket(len(chunk), lo=1), max_b)
         D = data.shape[1]
         sub = np.zeros((B, p_sub, D), np.float32)

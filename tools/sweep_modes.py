@@ -30,8 +30,9 @@ def main():
     platform = os.environ.get("BENCH_PLATFORM")
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from sptag_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
 
     import sptag_tpu as sp
     from bench import make_dataset, _bkt_params, l2_truth, build_or_load
