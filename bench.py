@@ -310,10 +310,15 @@ def main():
                 "hbm_bytes_per_query": int(bytes_q),
                 "achieved_gflops": round(qps * flops_q / 1e9, 2),
                 "achieved_gbps": round(qps * bytes_q / 1e9, 2),
-                "mxu_util_pct_f32peak": round(
-                    100.0 * qps * flops_q / 49e12, 4),
-                "hbm_util_pct": round(100.0 * qps * bytes_q / 819e9, 2),
             }
+            if platform == "tpu":
+                # peak fractions only make sense against the chip that ran
+                result["roofline"].update({
+                    "mxu_util_pct_f32peak": round(
+                        100.0 * qps * flops_q / 49e12, 4),
+                    "hbm_util_pct": round(
+                        100.0 * qps * bytes_q / 819e9, 2),
+                })
         except Exception:                                # noqa: BLE001
             pass
 

@@ -1,0 +1,168 @@
+"""Multi-host (DCN) deployment of the sharded graph index.
+
+The reference scales across machines with one Server process per index
+shard and an Aggregator fanning queries out over TCP
+(/root/reference/AnnService/src/Aggregator/AggregatorService.cpp:206-366).
+The TPU-native equivalent is multi-controller JAX: every host runs the SAME
+program under `jax.distributed`, the mesh spans all hosts' devices, and the
+`shard_map` search program from parallel/sharded.py runs unchanged — XLA
+routes the all-gather fan-in over ICI within a slice and DCN across slices.
+
+What this module adds over ShardedBKTIndex.build (which materializes every
+shard on one host):
+
+* `initialize()` — `jax.distributed.initialize` wrapper with env fallbacks
+  (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+* `build_process_sharded()` — each process builds ONLY the sub-indexes for
+  its local devices' shards and contributes per-device buffers via
+  `jax.make_array_from_single_device_arrays`; no host ever holds the whole
+  corpus layout.  Shard geometry (rows per shard, graph width, pivot pad)
+  is derived from parameters, not data, so processes agree without
+  communicating.
+
+Validated end-to-end by tests/test_multihost.py: two real OS processes x 4
+virtual CPU devices each form an 8-device global mesh (gloo transport
+standing in for DCN) and must produce the same results as a single-process
+mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sptag_tpu.core.types import DistCalcMethod
+from sptag_tpu.parallel.sharded import SHARD_AXIS, ShardedBKTIndex, make_mesh
+
+MAX_DIST = np.float32(3.4e38)
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """`jax.distributed.initialize` with environment fallbacks; no-op for
+    single-process runs (num_processes == 1 and no coordinator given)."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if coordinator_address is None and num_processes == 1:
+        return
+    jax.distributed.initialize(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def build_process_sharded(data_for_shard, n: int, dim: int,
+                          metric: DistCalcMethod = DistCalcMethod.L2,
+                          mesh=None, value_type=None,
+                          params: Optional[dict] = None) -> ShardedBKTIndex:
+    """Build a ShardedBKTIndex across ALL processes of a multi-controller
+    run; this process builds only its local devices' shards.
+
+    `data_for_shard(s) -> (rows, D) np.ndarray` supplies shard `s`'s block
+    (shards are contiguous row ranges: shard s covers
+    [s*n_local, min((s+1)*n_local, n))) — a callable rather than an array
+    so each host loads only its own slice from disk/object store.
+    `n`/`dim` are the GLOBAL corpus row count and dimension.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sptag_tpu.algo.bkt import BKTIndex
+    from sptag_tpu.algo.engine import _num_words
+    from sptag_tpu.core.types import ErrorCode, value_type_of
+    from sptag_tpu.ops import distance as dist_ops
+    from sptag_tpu.parallel.sharded import pack_shard_block
+
+    mesh = mesh if mesh is not None else make_mesh()
+    n_dev = mesh.devices.size
+    if n < n_dev:
+        raise ValueError(f"corpus ({n}) smaller than mesh ({n_dev})")
+    n_local = -(-n // n_dev)
+
+    self = ShardedBKTIndex(mesh)
+    self.metric = DistCalcMethod(metric)
+    self.n = n
+    self.n_local = n_local
+
+    flat_devices = list(mesh.devices.flat)
+    proc = jax.process_index()
+    local_shards = [(s, d) for s, d in enumerate(flat_devices)
+                    if d.process_index == proc]
+
+    words = _num_words(n_local)
+    sample_params = None
+    per_device = {}          # shard -> dict of arrays
+    for s, dev in local_shards:
+        block_rows = np.asarray(data_for_shard(s))
+        sub = BKTIndex(value_type if value_type is not None
+                       else value_type_of(block_rows.dtype))
+        sub.set_parameter("DistCalcMethod",
+                          "Cosine" if self.metric == DistCalcMethod.Cosine
+                          else "L2")
+        for name, value in (params or {}).items():
+            sub.set_parameter(name, str(value))
+        rc = sub.build(block_rows)
+        if rc != ErrorCode.Success:
+            raise ValueError(
+                f"shard {s} build failed ({rc!r}); every shard needs at "
+                f"least one row — got {block_rows.shape[0]} (pick a mesh "
+                f"with <= {n} devices or rebalance the shard loader)")
+        sample_params = sub
+        # geometry must be data-independent so every process agrees:
+        # graph width == NeighborhoodSize (final refine width), pivot pad
+        # == the parameter-derived pivot budget
+        m_width = sub.params.neighborhood_size
+        max_p = max(64, sub.params.initial_dynamic_pivots * 32)
+        packed = pack_shard_block(sub, n_local, dim, m_width, max_p, words)
+        packed["sqnorm"] = np.asarray(
+            dist_ops.row_sqnorms(jnp.asarray(packed["data"])))
+        per_device[s] = packed
+
+    assert sample_params is not None, "process owns no mesh devices"
+    self.base = sample_params.base
+    self.params = sample_params.params
+    self.max_check = int(self.params.max_check)
+    self.nbp_limit = int(self.params.no_better_propagation_limit)
+
+    def assemble(name: str, extra_dims: Tuple[int, ...], dtype,
+                 stacked: bool):
+        """Global jax.Array from this process's per-device buffers.
+
+        stacked=False: global shape (n_dev*n_local, *extra), row-sharded.
+        stacked=True:  global shape (n_dev, *extra), one row per shard.
+        """
+        if stacked:
+            gshape = (n_dev,) + extra_dims
+        else:
+            gshape = (n_dev * n_local,) + extra_dims
+        spec = P(SHARD_AXIS, *([None] * len(extra_dims)))
+        sharding = NamedSharding(mesh, spec)
+        bufs = []
+        for s, dev in local_shards:
+            arr = per_device[s][name].astype(dtype, copy=False)
+            if stacked:
+                arr = arr[None]
+            bufs.append(jax.device_put(arr, dev))
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, bufs)
+
+    dt = per_device[next(iter(per_device))]["data"].dtype
+    m_width = sample_params.params.neighborhood_size
+    max_p = max(64, sample_params.params.initial_dynamic_pivots * 32)
+    self.data = assemble("data", (dim,), dt, False)
+    self.sqnorm = assemble("sqnorm", (), np.float32, False)
+    self.graph = assemble("graph", (m_width,), np.int32, False)
+    self.deleted = assemble("deleted", (), bool, False)
+    self.pivot_ids = assemble("pivot_ids", (max_p,), np.int32, True)
+    self.pivot_vecs = assemble("pivot_vecs", (max_p, dim), dt, True)
+    self.pivot_mask = assemble("pivot_mask", (words,), np.int32, True)
+    return self

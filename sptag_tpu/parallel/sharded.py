@@ -191,6 +191,37 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
       queries)
 
 
+def pack_shard_block(sub, n_local: int, dim: int, m_width: int, max_p: int,
+                     words: int) -> dict:
+    """Pad one built BKT sub-index into the fixed per-shard geometry.
+
+    Shared by the single-process build (ShardedBKTIndex.build) and the
+    multi-controller build (parallel/multihost.py) so the packing/padding
+    semantics cannot diverge: rows beyond the shard's count are zero
+    vectors marked deleted; graph rows are -1-padded to `m_width`; pivot
+    ids are -1-padded to `max_p`; the pivot bitset covers `words` int32s.
+    """
+    nb = sub._n
+    # rows are normalized at ingest for cosine — take the INDEX's copy,
+    # not the raw input block
+    block = np.zeros((n_local, dim), sub._host.dtype)
+    block[:nb] = sub._host[:nb]
+    g = np.full((n_local, m_width), -1, np.int32)
+    gw = min(m_width, sub._graph.graph.shape[1])
+    g[:nb, :gw] = sub._graph.graph[:, :gw]
+    dele = np.ones(n_local, bool)              # padding rows = deleted
+    dele[:nb] = sub._deleted[:nb]
+    pids = np.full(max_p, -1, np.int32)
+    got = np.asarray(sub._pivot_ids(), np.int32)[:max_p]
+    pids[:len(got)] = got
+    pvec = block[np.maximum(pids, 0)]
+    mask = np.zeros(words, np.uint32)
+    np.bitwise_or.at(mask, got >> 5,
+                     np.uint32(1) << (got.astype(np.uint32) & 31))
+    return dict(data=block, graph=g, deleted=dele, pivot_ids=pids,
+                pivot_vecs=pvec, pivot_mask=mask.view(np.int32))
+
+
 class ShardedBKTIndex:
     """The flagship graph index, corpus-sharded over a device mesh.
 
@@ -261,28 +292,14 @@ class ShardedBKTIndex:
         words = _num_words(n_local)
         max_p = max(len(sub._pivot_ids()) for sub in shard_indexes)
         for s, sub in enumerate(shard_indexes):
-            nb = sub._n
-            # rows are normalized at ingest for cosine — take the INDEX's
-            # copy, not the raw input block
-            block = np.zeros((n_local, data.shape[1]), sub._host.dtype)
-            block[:nb] = sub._host[:nb]
-            g = np.full((n_local, m_width), -1, np.int32)
-            g[:nb, :sub._graph.graph.shape[1]] = sub._graph.graph
-            dele = np.ones(n_local, bool)          # padding rows = deleted
-            dele[:nb] = sub._deleted[:nb]
-            pids = np.full(max_p, -1, np.int32)
-            got = np.asarray(sub._pivot_ids(), np.int32)
-            pids[:len(got)] = got
-            pvec = block[np.maximum(pids, 0)].astype(block.dtype)
-            mask = np.zeros(words, np.uint32)
-            np.bitwise_or.at(mask, got >> 5,
-                             np.uint32(1) << (got.astype(np.uint32) & 31))
-            blocks_data.append(block)
-            blocks_graph.append(g)
-            blocks_del.append(dele)
-            blocks_pid.append(pids)
-            blocks_pvec.append(pvec)
-            blocks_pmask.append(mask.view(np.int32))
+            packed = pack_shard_block(sub, n_local, data.shape[1], m_width,
+                                      max_p, words)
+            blocks_data.append(packed["data"])
+            blocks_graph.append(packed["graph"])
+            blocks_del.append(packed["deleted"])
+            blocks_pid.append(packed["pivot_ids"])
+            blocks_pvec.append(packed["pivot_vecs"])
+            blocks_pmask.append(packed["pivot_mask"])
         self.max_check = int(getattr(self.params, "max_check", 2048))
         self.nbp_limit = int(getattr(
             self.params, "no_better_propagation_limit", 3))

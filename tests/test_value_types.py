@@ -1,12 +1,12 @@
-"""Index-level value-type parity: Int8 / UInt8 / Int16 / Float.
+"""Index-level value-type parity: Int8 / UInt8 / Int16.
 
 The reference instantiates every index for all four value types via
 X-macros (/root/reference/AnnService/src/Core/BKT/BKTIndex.cpp:577-581);
-kernel-level conventions are pinned by tests/test_distance.py, but nothing
-exercised the non-float types through the full index lifecycle.  Recall is
-asserted against ground truth computed under the INDEX's own convention
-(exact integer dot; cosine is base^2 - dot on ingest-normalized rows,
-DistanceUtils.h:452,492,533).
+kernel-level conventions are pinned by tests/test_distance.py and the
+Float lifecycle by tests/test_bkt.py, but nothing exercised the integer
+types through the full index lifecycle.  Recall is asserted against ground
+truth computed under the INDEX's own convention (exact integer dot; cosine
+is base^2 - dot on ingest-normalized rows, DistanceUtils.h:452,492,533).
 """
 
 import numpy as np
@@ -23,8 +23,6 @@ def _corpus(value_type, n=1500, d=32, seed=11):
     centers = rng.standard_normal((16, d)).astype(np.float32) * 4
     x = centers[rng.integers(0, 16, n)] + \
         rng.standard_normal((n, d)).astype(np.float32)
-    if value_type == "Float":
-        return x
     if value_type == "UInt8":
         x = x - x.min()
         return np.clip(np.round(x / x.max() * 200), 0, 255).astype(np.uint8)
@@ -42,17 +40,10 @@ def _truth(data, queries, metric, value_type, k=10):
         d2 = ((df ** 2).sum(1)[None, :]
               - 2.0 * qf @ df.T + (qf ** 2).sum(1)[:, None])
         return np.argsort(d2, axis=1, kind="stable")[:, :k]
-    base = _BASE.get(value_type, 1)
-    if value_type == "Float":
-        stored = data / np.maximum(
-            np.linalg.norm(data, axis=1, keepdims=True), 1e-9)
-        q = queries / np.maximum(
-            np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
-        sim = q @ stored.T
-    else:
-        stored = normalize(data, base).astype(np.int64)
-        q = normalize(queries, base).astype(np.int64)
-        sim = q @ stored.T
+    base = _BASE[value_type]
+    stored = normalize(data, base).astype(np.int64)
+    q = normalize(queries, base).astype(np.int64)
+    sim = q @ stored.T
     return np.argsort(-sim, axis=1, kind="stable")[:, :k]
 
 
