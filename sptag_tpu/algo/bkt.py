@@ -46,6 +46,15 @@ from sptag_tpu.trees.bktree import BKTree
 log = logging.getLogger(__name__)
 
 
+def pivot_budget(params) -> int:
+    """Shared-pivot set size budget (before the corpus-size clamp).
+
+    THE single source of truth: the sharded/multihost builds pad their
+    per-shard pivot arrays to exactly this value and would silently
+    truncate pivots if a private copy of the formula diverged."""
+    return max(64, params.initial_dynamic_pivots * 32)
+
+
 @register_algo
 class BKTIndex(VectorIndex):
     algo = IndexAlgoType.BKT
@@ -132,8 +141,7 @@ class BKTIndex(VectorIndex):
             tpt_top_dims=p.tpt_top_dims, tpt_samples=p.samples)
 
     def _pivot_ids(self) -> np.ndarray:
-        p = self.params
-        max_pivots = min(self._n, max(64, p.initial_dynamic_pivots * 32))
+        max_pivots = min(self._n, pivot_budget(self.params))
         return self._tree.collect_pivots(max_pivots)
 
     def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:

@@ -249,6 +249,12 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
     N = data.shape[0]
     Ps = 0 if spare_ids is None else spare_ids.shape[1]
     use_spares = Ps > 0 and inject > 0
+    # only REAL spare entries count as remaining work — the spare queue is
+    # -1/MAX_DIST padded (fewer pivots than slots), and treating pads as
+    # pending injections would keep converged queries spinning through
+    # no-op inject/reset cycles until the full T budget
+    n_spare = (jnp.sum(spare_ids >= 0, axis=1).astype(jnp.int32)
+               if use_spares else None)
 
     # expanded has a dump slot at column L; visited a dump slot at row N
     expanded = jnp.concatenate(
@@ -257,15 +263,31 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
     ptr = jnp.zeros((Q,), jnp.int32)      # next un-injected spare pivot
     k_eff = min(k, L)
 
+    def _active(no_better, ptr):
+        # the reference only STOPS on continuous no-better-propagation when
+        # the budget is also spent — below budget it re-enters the trees
+        # for fresh pivots and keeps walking (BKTIndex.cpp:139-144, the
+        # `m_iNumberOfCheckedLeaves > m_iMaxCheck` guard before the break).
+        # Here: a query whose nbp counter trips stays active while real
+        # spare pivots remain (the injection below resets the counter).
+        act = no_better < nbp_limit
+        if use_spares:
+            act = act | (ptr < n_spare)
+        return act
+
     def cond(state):
         cand_ids, cand_d, expanded, visited, no_better, ptr, it = state
-        active = no_better < nbp_limit
+        active = _active(no_better, ptr)
         has_work = jnp.any((~expanded[:, :L]) & (cand_ids >= 0), axis=1)
+        if use_spares:
+            # a fully-expanded beam with pending spares still has work —
+            # the next injection may open an unreached graph component
+            has_work = has_work | (ptr < n_spare)
         return (it < T) & jnp.any(active & has_work)
 
     def body(state):
         cand_ids, cand_d, expanded, visited, no_better, ptr, it = state
-        active = no_better < nbp_limit                           # (Q,)
+        active = _active(no_better, ptr)                         # (Q,)
 
         # ---- pop best B unexpanded entries --------------------------------
         sel_score = jnp.where(expanded[:, :L], MAX_DIST, cand_d)
@@ -301,12 +323,14 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
         nd = jnp.where(fresh, nd, MAX_DIST)
 
         # ---- mid-walk re-seed: inject spare pivots when the frontier falls
-        # behind the next unvisited pivot (SearchTrees-on-demand,
-        # BKTIndex.cpp:153-155) ---------------------------------------------
+        # behind the next unvisited pivot OR the nbp counter trips with
+        # budget remaining (SearchTrees-on-demand, BKTIndex.cpp:139-155)
         if use_spares:
             next_d = jnp.take_along_axis(
                 spare_d, jnp.minimum(ptr, Ps - 1)[:, None], axis=1)[:, 0]
-            trigger = active & (ptr < Ps) & ((-sneg[:, 0]) > next_d)
+            stalled = no_better + 1 >= nbp_limit     # would trip this iter
+            trigger = active & (ptr < n_spare) & (
+                ((-sneg[:, 0]) > next_d) | stalled)
             idxs = ptr[:, None] + jnp.arange(inject, dtype=jnp.int32)
             ok = trigger[:, None] & (idxs < Ps)
             safe = jnp.minimum(idxs, Ps - 1)
@@ -319,6 +343,7 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
             nd = jnp.concatenate([nd, inj_d], axis=1)
             flat_m = jnp.concatenate([flat, inj_ids], axis=1)
         else:
+            trigger = None
             flat_m = flat
 
         # ---- merge beam + candidates, keep top-L --------------------------
@@ -338,6 +363,10 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
         no_better = jnp.where(frontier_worse,
                               jnp.where(active, no_better + 1, no_better),
                               0)
+        if use_spares:
+            # a fresh tree re-seed resets the stall counter (the reference
+            # continues its loop after SearchTrees rather than breaking)
+            no_better = jnp.where(trigger, 0, no_better)
         return cand_ids, cand_d, expanded, visited, no_better, ptr, it + 1
 
     state = (cand_ids, cand_d, expanded, visited, no_better, ptr,

@@ -76,9 +76,9 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from sptag_tpu.algo.bkt import BKTIndex
+    from sptag_tpu.algo.bkt import BKTIndex, pivot_budget
     from sptag_tpu.algo.engine import _num_words
-    from sptag_tpu.core.types import ErrorCode, value_type_of
+    from sptag_tpu.core.types import ErrorCode, dtype_of, value_type_of
     from sptag_tpu.ops import distance as dist_ops
     from sptag_tpu.parallel.sharded import pack_shard_block
 
@@ -103,6 +103,17 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
     per_device = {}          # shard -> dict of arrays
     for s, dev in local_shards:
         block_rows = np.asarray(data_for_shard(s))
+        empty_shard = block_rows.shape[0] == 0
+        if empty_shard:
+            # a ceil-division tail shard can be legitimately empty (e.g.
+            # n=49 over 8 devices -> n_local=7 covers rows 0..48 in 7
+            # shards); build a one-row placeholder and tombstone it below
+            # so the shard participates in the program but returns nothing
+            dt = (dtype_of(value_type) if value_type is not None
+                  else block_rows.dtype
+                  if block_rows.dtype != np.dtype(np.float64)
+                  else np.float32)
+            block_rows = np.zeros((1, dim), dt)
         sub = BKTIndex(value_type if value_type is not None
                        else value_type_of(block_rows.dtype))
         sub.set_parameter("DistCalcMethod",
@@ -112,17 +123,18 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
             sub.set_parameter(name, str(value))
         rc = sub.build(block_rows)
         if rc != ErrorCode.Success:
-            raise ValueError(
-                f"shard {s} build failed ({rc!r}); every shard needs at "
-                f"least one row — got {block_rows.shape[0]} (pick a mesh "
-                f"with <= {n} devices or rebalance the shard loader)")
+            raise ValueError(f"shard {s} build failed ({rc!r}) over "
+                             f"{block_rows.shape[0]} rows")
         sample_params = sub
         # geometry must be data-independent so every process agrees:
         # graph width == NeighborhoodSize (final refine width), pivot pad
-        # == the parameter-derived pivot budget
+        # == the parameter-derived pivot budget (pivot_budget — the same
+        # function BKTIndex._pivot_ids clamps by)
         m_width = sub.params.neighborhood_size
-        max_p = max(64, sub.params.initial_dynamic_pivots * 32)
+        max_p = pivot_budget(sub.params)
         packed = pack_shard_block(sub, n_local, dim, m_width, max_p, words)
+        if empty_shard:
+            packed["deleted"][:] = True    # placeholder row never returned
         packed["sqnorm"] = np.asarray(
             dist_ops.row_sqnorms(jnp.asarray(packed["data"])))
         per_device[s] = packed
@@ -157,7 +169,7 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
 
     dt = per_device[next(iter(per_device))]["data"].dtype
     m_width = sample_params.params.neighborhood_size
-    max_p = max(64, sample_params.params.initial_dynamic_pivots * 32)
+    max_p = pivot_budget(sample_params.params)
     self.data = assemble("data", (dim,), dt, False)
     self.sqnorm = assemble("sqnorm", (), np.float32, False)
     self.graph = assemble("graph", (m_width,), np.int32, False)

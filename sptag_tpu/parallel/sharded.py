@@ -274,8 +274,15 @@ class ShardedBKTIndex:
         blocks_pid, blocks_pvec, blocks_pmask = [], [], []
         m_width = 0
         shard_indexes = []
+        empty_shards = []
         for s in range(n_dev):
             block = np.asarray(data[s * n_local:(s + 1) * n_local])
+            if block.shape[0] == 0:
+                # ceil-division tail shard with no rows (e.g. n=49 over 8
+                # devices): one tombstoned placeholder row keeps the shard
+                # in the program without ever appearing in results
+                empty_shards.append(s)
+                block = np.zeros((1, data.shape[1]), data.dtype)
             sub = BKTIndex(value_type)
             sub.set_parameter("DistCalcMethod",
                               "Cosine" if self.metric ==
@@ -294,6 +301,8 @@ class ShardedBKTIndex:
         for s, sub in enumerate(shard_indexes):
             packed = pack_shard_block(sub, n_local, data.shape[1], m_width,
                                       max_p, words)
+            if s in empty_shards:
+                packed["deleted"][:] = True
             blocks_data.append(packed["data"])
             blocks_graph.append(packed["graph"])
             blocks_del.append(packed["deleted"])
