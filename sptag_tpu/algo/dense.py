@@ -182,10 +182,13 @@ def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
     if use_pallas:
         from sptag_tpu.ops import pallas_kernels
 
+        # int8 blocks contract int8 queries with exact int32 accumulation
+        # in-kernel; float blocks take float queries
+        q_in = queries if data_perm.dtype == jnp.dtype(jnp.int8) \
+            else queries.astype(jnp.float32)
         dot = pallas_kernels.probe_block_dots(
-            data_perm, queries.astype(jnp.float32),
-            topc.astype(jnp.int32),
-            interpret=interpret).reshape(Q, nprobe * P)
+            data_perm, q_in, topc.astype(jnp.int32),
+            interpret=interpret).reshape(Q, nprobe * P).astype(jnp.float32)
         if int(metric) == int(DistCalcMethod.Cosine):
             nd = float(base) * float(base) - dot
         else:
@@ -245,7 +248,10 @@ class DenseTreeSearcher:
         self.base = base
         self.n = data.shape[0]
         C = len(clusters)
-        P = round_up(max(len(c) for c in clusters), 8)
+        # int8 VMEM tiles are (32, 128): pad P so the Pallas probe kernel's
+        # block shape is legal for integer corpora too
+        p_align = 32 if np.dtype(data.dtype) == np.int8 else 8
+        P = round_up(max(len(c) for c in clusters), p_align)
         D = data.shape[1]
         perm = np.zeros((C, P, D), data.dtype)
         mids = np.full((C, P), -1, np.int32)
@@ -289,6 +295,21 @@ class DenseTreeSearcher:
 
         chunk = max(1, min(_GATHER_BUDGET // (nprobe * P * D * 4), 1024))
         use_pallas = pallas_kernels.supported(self.data_perm)
+        try:
+            return self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
+                                     D, use_pallas)
+        except Exception as e:                         # noqa: BLE001
+            # a pallas_call that fails to COMPILE on this backend (Mosaic
+            # lowering gap) must degrade to the XLA path, not take search
+            # availability down
+            if not use_pallas:
+                raise
+            pallas_kernels.disable(repr(e)[:200])
+            return self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
+                                     D, use_pallas=False)
+
+    def _search_impl(self, queries, nq, k, k_eff, nprobe, chunk, D,
+                     use_pallas):
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
         if nq <= chunk:

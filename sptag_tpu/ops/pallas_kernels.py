@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 _INTERPRET = False   # tests may flip this to run on CPU
+_DISABLED = False    # set when a kernel fails to compile on the backend
 
 
 def set_interpret(value: bool) -> None:
@@ -36,17 +37,34 @@ def set_interpret(value: bool) -> None:
     _INTERPRET = value
 
 
+def disable(reason: str = "") -> None:
+    """Disable the Pallas path for this process (callers fall back to the
+    XLA kernels).  Used when a pallas_call fails to compile on the live
+    backend — e.g. a Mosaic lowering gap for a dtype — so one bad kernel
+    degrades throughput instead of availability."""
+    global _DISABLED
+    _DISABLED = True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "pallas kernels disabled for this process: %s", reason)
+
+
 def interpret() -> bool:
     return _INTERPRET
 
 
 def supported(data_perm) -> bool:
-    """Pallas path gate: TPU (or interpret mode) + f32 data + MXU-friendly
-    block shape."""
-    if data_perm.dtype != jnp.float32:
+    """Pallas path gate: TPU (or interpret mode) + f32/int8 data +
+    MXU-friendly block shape."""
+    if _DISABLED:
+        return False
+    if data_perm.dtype not in (jnp.float32, jnp.dtype(jnp.int8)):
         return False
     C, P, D = data_perm.shape
-    if P % 8 != 0 or D % 128 != 0:
+    # int8 VMEM tiles are (32, 128); f32 tiles are (8, 128)
+    min_sub = 32 if data_perm.dtype == jnp.dtype(jnp.int8) else 8
+    if P % min_sub != 0 or D % 128 != 0:
         return False
     if _INTERPRET:
         return True
@@ -60,21 +78,25 @@ def supported(data_perm) -> bool:
 def probe_block_dots(data_perm: jax.Array, queries: jax.Array,
                      topc: jax.Array, interpret: bool = False) -> jax.Array:
     """(C, P, D) blocks, (Q, D) queries, (Q, nprobe) int32 block ids ->
-    (Q, nprobe, P) float32 dot products of each query with every row of its
-    probed blocks."""
+    (Q, nprobe, P) dot products of each query with every row of its probed
+    blocks.  Returns float32 for float blocks; int32 (exact) for int8
+    blocks — int8 expects int8 queries and contracts on the native
+    s8xs8->s32 MXU path, matching ops/distance's integer convention (the
+    reference's int cosine is an exact integer dot, DistanceUtils.h:452)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     C, P, D = data_perm.shape
     Q, nprobe = topc.shape
+    int_path = data_perm.dtype == jnp.dtype(jnp.int8)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(Q, nprobe),
         in_specs=[
-            # whole query matrix resident in VMEM (Q*D*4 bytes), sliced by
-            # program_id in-kernel: a (1, D) block would violate the (8,128)
-            # min-tile rule
+            # whole query matrix resident in VMEM, sliced by program_id
+            # in-kernel: a (1, D) block would violate the min-tile rule
+            # ((8,128) f32 / (32,128) int8)
             pl.BlockSpec((Q, D), lambda q, j, t: (0, 0)),
             pl.BlockSpec((1, P, D), lambda q, j, t: (t[q, j], 0, 0)),
         ],
@@ -88,18 +110,29 @@ def probe_block_dots(data_perm: jax.Array, queries: jax.Array,
         q = pl.program_id(0)
         j = pl.program_id(1)
         qv = q_ref[pl.ds(q, 1), :]                    # (1, D)
-        # (1, D) x (P, D)^T -> (1, P) on the MXU; HIGHEST = the f32-accurate
-        # multi-pass algorithm, matching ops/distance's default contraction
-        # precision (a plain bf16 pass showed ~1.5% dot error on d=128)
-        out_ref[0, pl.ds(j, 1), :] = jax.lax.dot_general(
-            qv, blk_ref[0],
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+        if int_path:
+            # native s8 x s8 -> s32 MXU contraction: pass the int8 refs
+            # directly (an explicit int32 upcast would 4x the VMEM copy and
+            # skip the int8 systolic path)
+            dot = jax.lax.dot_general(
+                qv, blk_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+        else:
+            # HIGHEST = the f32-accurate multi-pass algorithm, matching
+            # ops/distance's default contraction precision (a plain bf16
+            # pass showed ~1.5% dot error on d=128)
+            dot = jax.lax.dot_general(
+                qv, blk_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+        out_ref[0, pl.ds(j, 1), :] = dot
 
+    out_dt = jnp.int32 if int_path else jnp.float32
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((Q, nprobe, P), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((Q, nprobe, P), out_dt),
         grid_spec=grid_spec,
         interpret=interpret,
     )(topc, queries, data_perm)

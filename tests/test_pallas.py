@@ -37,10 +37,32 @@ def test_supported_gates():
     rng = np.random.default_rng(1)
     f32 = jnp.asarray(rng.standard_normal((4, 8, 128)).astype(np.float32))
     assert pallas_kernels.supported(f32)          # interpret mode is on
-    i8 = jnp.asarray(rng.integers(-5, 5, (4, 8, 128)).astype(np.int8))
-    assert not pallas_kernels.supported(i8)       # int8 -> XLA fallback
+    i8 = jnp.asarray(rng.integers(-5, 5, (4, 32, 128)).astype(np.int8))
+    assert pallas_kernels.supported(i8)           # int8: (32,128) tiles
+    i8_bad = jnp.asarray(rng.integers(-5, 5, (4, 8, 128)).astype(np.int8))
+    assert not pallas_kernels.supported(i8_bad)   # P not 32-multiple
+    i16 = jnp.asarray(rng.integers(-5, 5, (4, 32, 128)).astype(np.int16))
+    assert not pallas_kernels.supported(i16)      # int16 -> XLA fallback
     odd = jnp.asarray(rng.standard_normal((4, 8, 100)).astype(np.float32))
     assert not pallas_kernels.supported(odd)      # D not 128-multiple
+
+
+def test_probe_block_dots_int8_exact():
+    """int8 path must be the EXACT integer dot (int32 accumulation)."""
+    rng = np.random.default_rng(4)
+    C, P, D, Q, nprobe = 5, 32, 128, 3, 2
+    data_perm = jnp.asarray(
+        rng.integers(-127, 128, (C, P, D)).astype(np.int8))
+    queries = jnp.asarray(rng.integers(-127, 128, (Q, D)).astype(np.int8))
+    topc = jnp.asarray(rng.integers(0, C, (Q, nprobe)).astype(np.int32))
+
+    got = pallas_kernels.probe_block_dots(data_perm, queries, topc,
+                                          interpret=True)
+    assert got.dtype == jnp.int32
+    want = np.einsum("qd,qjpd->qjp",
+                     np.asarray(queries, np.int64),
+                     np.asarray(data_perm, np.int64)[np.asarray(topc)])
+    np.testing.assert_array_equal(np.asarray(got, np.int64), want)
 
 
 def test_dense_kernel_pallas_vs_xla_paths():
@@ -67,3 +89,31 @@ def test_dense_kernel_pallas_vs_xla_paths():
     np.testing.assert_array_equal(np.asarray(i_x), np.asarray(i_p))
     np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_p),
                                rtol=1e-5, atol=1e-3)
+
+
+@pytest.mark.parametrize("metric,base", [(0, 127), (1, 127)])
+def test_dense_kernel_int8_pallas_vs_xla(metric, base):
+    """int8 metric composition (L2 qn+sq-2dot / cosine base^2-dot) must be
+    identical through the Pallas int path and the XLA fallback."""
+    from sptag_tpu.algo.dense import _dense_search_kernel
+
+    rng = np.random.default_rng(5)
+    C, P, D, Q, nprobe = 4, 32, 128, 8, 2
+    n = C * P
+    data = rng.integers(-127, 128, (n, D)).astype(np.int8)
+    perm = data.reshape(C, P, D)
+    mids = jnp.asarray(np.arange(n, dtype=np.int32).reshape(C, P))
+    sq = jnp.asarray(
+        (data.astype(np.float32) ** 2).sum(1).reshape(C, P))
+    cents = jnp.asarray(perm.astype(np.float32).mean(axis=1))
+    cent_sq = jnp.asarray((np.asarray(cents) ** 2).sum(1))
+    deleted = jnp.zeros(n, bool)
+    queries = jnp.asarray(rng.integers(-127, 128, (Q, D)).astype(np.int8))
+
+    args = (jnp.asarray(perm), mids, sq, cents, cent_sq, deleted, queries,
+            5, nprobe, metric, base)
+    d_x, i_x = _dense_search_kernel(*args, use_pallas=False)
+    d_p, i_p = _dense_search_kernel(*args, use_pallas=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(i_x), np.asarray(i_p))
+    np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_p),
+                               rtol=0, atol=0)   # both exact integer dots
