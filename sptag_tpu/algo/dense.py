@@ -294,7 +294,11 @@ class DenseTreeSearcher:
         k_eff = min(k, nprobe * P, self.n)
 
         chunk = max(1, min(_GATHER_BUDGET // (nprobe * P * D * 4), 1024))
-        use_pallas = pallas_kernels.supported(self.data_perm)
+        # the int8 kernel needs int8 queries too (dot_general forbids mixed
+        # dtypes); float queries against an int8 corpus take the XLA path
+        use_pallas = pallas_kernels.supported(self.data_perm) and (
+            self.data_perm.dtype != np.dtype(np.int8)
+            or queries.dtype == np.dtype(np.int8))
         try:
             return self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
                                      D, use_pallas)
@@ -304,9 +308,13 @@ class DenseTreeSearcher:
             # availability down
             if not use_pallas:
                 raise
+            out = self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
+                                    D, use_pallas=False)
+            # the XLA retry SUCCEEDED, so the failure was pallas-specific:
+            # only now is process-wide disablement justified (a transient
+            # error would have failed the retry too and re-raised above)
             pallas_kernels.disable(repr(e)[:200])
-            return self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
-                                     D, use_pallas=False)
+            return out
 
     def _search_impl(self, queries, nq, k, k_eff, nprobe, chunk, D,
                      use_pallas):

@@ -191,6 +191,48 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
       queries)
 
 
+class ServingAdapter:
+    """Presents a sharded mesh index through the VectorIndex serving
+    surface (value_type / feature_dim / search / search_batch) so it can be
+    registered in a SearchServer's index map — external clients speak the
+    reference wire protocol while the search itself is the one-program
+    mesh scatter-gather.  This is the full reference deployment picture
+    (client -> server -> shards) with the Aggregator tier replaced by ICI
+    collectives.  Metadata is not sharded (serve corpus metadata from the
+    frontend's own store if needed)."""
+
+    def __init__(self, sharded, feature_dim: int, value_type=None):
+        from sptag_tpu.core.types import VectorValueType, value_type_of
+
+        self._impl = sharded
+        self.feature_dim = feature_dim
+        self.value_type = (VectorValueType(value_type)
+                           if value_type is not None
+                           else value_type_of(np.dtype(
+                               sharded.data.dtype)))
+        self.metadata = None
+
+    @property
+    def num_samples(self) -> int:
+        return self._impl.n
+
+    def search_batch(self, queries: np.ndarray, k: int = 10
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        return self._impl.search(np.asarray(queries), k=k)
+
+    def search(self, query, k: int = 10, with_metadata: bool = False):
+        from sptag_tpu.core.index import SearchResult
+
+        q = np.asarray(query)
+        if q.ndim == 1:
+            q = q[None, :]
+        d, ids = self._impl.search(q, k=k)
+        # metas stays None even for with_metadata: this adapter has no
+        # metadata store (self.metadata is None), and the batch path
+        # already returns none in that case — the two paths must agree
+        return SearchResult(ids=ids[0], dists=d[0], metas=None)
+
+
 def pack_shard_block(sub, n_local: int, dim: int, m_width: int, max_p: int,
                      words: int) -> dict:
     """Pad one built BKT sub-index into the fixed per-shard geometry.

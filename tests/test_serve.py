@@ -360,3 +360,42 @@ def test_aggregator_scatter_gather_and_partial_timeout():
     finally:
         tg.stop()
         tb.stop()
+
+
+def test_server_over_sharded_mesh_index():
+    """The full deployment picture: an external wire-protocol client hits a
+    SearchServer whose registered index is the mesh-sharded BKT (ICI
+    scatter-gather replacing the reference's Aggregator tier)."""
+    import base64
+
+    from sptag_tpu.core.types import DistCalcMethod
+    from sptag_tpu.parallel.sharded import (
+        ServingAdapter, ShardedBKTIndex, make_mesh)
+
+    rng = np.random.default_rng(8)
+    d = 16
+    data = rng.standard_normal((512, d)).astype(np.float32)
+    sharded = ShardedBKTIndex.build(
+        data, DistCalcMethod.L2, mesh=make_mesh(),
+        params={"BKTNumber": 1, "BKTKmeansK": 4, "TPTNumber": 2,
+                "TPTLeafSize": 32, "NeighborhoodSize": 8, "CEF": 16,
+                "MaxCheckForRefineGraph": 64, "RefineIterations": 1,
+                "MaxCheck": 128})
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.indexes["mesh"] = ServingAdapter(sharded, feature_dim=d)
+
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        client = AnnClient(host, port, timeout_s=10.0)
+        client.connect()
+        qb = base64.b64encode(data[7].tobytes()).decode()
+        res = client.search(f"$resultnum:3 #{qb}")
+        assert res.status == wire.ResultStatus.Success
+        assert res.results[0].ids[0] == 7          # global id across shards
+        assert res.results[0].dists[0] <= 1e-5
+        client.close()
+    finally:
+        t.stop()
