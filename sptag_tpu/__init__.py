@@ -21,7 +21,10 @@ from sptag_tpu.core.types import (
     VectorValueType,
 )
 from sptag_tpu.core.vectorset import VectorSet, MetadataSet, FileMetadataSet
-from sptag_tpu.core.index import (VectorIndex, create_instance, load_index,
+from sptag_tpu.core.index import (VectorIndex, create_instance,
+                                  estimated_hbm_usage,
+                                  estimated_memory_usage,
+                                  estimated_vector_count, load_index,
                                   load_index_blobs)
 
 # Importing algo modules registers them with the factory.
@@ -43,6 +46,9 @@ __all__ = [
     "FileMetadataSet",
     "VectorIndex",
     "create_instance",
+    "estimated_hbm_usage",
+    "estimated_memory_usage",
+    "estimated_vector_count",
     "load_index",
     "load_index_blobs",
 ]

@@ -501,3 +501,88 @@ def load_index_blobs(config: str, blobs: Sequence[bytes]) -> VectorIndex:
     index = create_instance(algo, value_type)
     index.load_index_blobs_data(config, blobs)
     return index
+
+
+# ---- capacity planning (parity: VectorIndex.cpp:403-437) -------------------
+
+def _tree_node_size(algo) -> int:
+    """Bytes per tree node: BKT stores {centerid, childStart, childEnd}
+    int32s; KDT stores {left, right} int32 + split_dim int32 + split_value
+    float (reference EstimatedVectorCount, VectorIndex.cpp:403-417)."""
+    if isinstance(algo, str):
+        algo = enum_from_string(IndexAlgoType, algo)
+    algo = IndexAlgoType(algo)
+    if algo == IndexAlgoType.BKT:
+        return 4 * 3
+    if algo == IndexAlgoType.KDT:
+        return 4 * 2 + 4 + 4
+    return 0
+
+
+def estimated_memory_usage(vector_count: int, dimension: int,
+                           algo, value_type,
+                           tree_number: int = 1,
+                           neighborhood_size: int = 32) -> int:
+    """Host bytes to hold an index of `vector_count` rows — the reference
+    capacity-planning formula (VectorIndex::EstimatedMemoryUsage,
+    VectorIndex.cpp:421-437): vectors + metadata offsets + graph rows +
+    tombstone byte + tree nodes.  Returns 0 for algorithms outside
+    BKT/KDT, exactly as the reference does (:430-432)."""
+    tree_node = _tree_node_size(algo)
+    if tree_node == 0:
+        return 0
+    if isinstance(value_type, str):
+        value_type = enum_from_string(VectorValueType, value_type)
+    unit = (np.dtype(dtype_of(VectorValueType(value_type))).itemsize
+            * dimension)
+    total = unit * vector_count                    # vectors
+    total += 8 * vector_count                      # metadata offset table
+    total += 4 * neighborhood_size * vector_count  # graph rows
+    total += vector_count                          # tombstone flags
+    total += tree_node * tree_number * vector_count
+    return total
+
+
+def estimated_vector_count(memory_bytes: int, dimension: int,
+                           algo, value_type,
+                           tree_number: int = 1,
+                           neighborhood_size: int = 32) -> int:
+    """Rows that fit in `memory_bytes` (inverse of estimated_memory_usage;
+    reference VectorIndex.cpp:403-419)."""
+    per_row = estimated_memory_usage(1, dimension, algo, value_type,
+                                     tree_number, neighborhood_size)
+    return 0 if per_row == 0 else memory_bytes // per_row
+
+
+def estimated_hbm_usage(vector_count: int, dimension: int, value_type,
+                        neighborhood_size: int = 32,
+                        dense_mode: bool = True,
+                        dense_cluster_size: int = 256) -> int:
+    """Device-HBM bytes for the search snapshots — the TPU-specific
+    counterpart the reference doesn't need.
+
+    Beam engine (algo/engine.py): vectors + float32 sqnorms + int32 graph
+    rows + a bool tombstone mask (1 byte/row — the packed bitset there is
+    the per-query visited table, not the tombstones).  Dense mode
+    (algo/dense.py) additionally holds the packed cluster-contiguous
+    vector copy (~1.15x at measured ~87% block fill), int32 member ids and
+    float32 member sqnorms for every padded slot, the float32 block-mean
+    centroids, and its own tombstone mask copy."""
+    if isinstance(value_type, str):
+        value_type = enum_from_string(VectorValueType, value_type)
+    unit = (np.dtype(dtype_of(VectorValueType(value_type))).itemsize
+            * dimension)
+    pad = 1.15                                     # measured block fill
+    total = unit * vector_count                    # engine vector snapshot
+    total += 4 * vector_count                      # sqnorms
+    total += 4 * neighborhood_size * vector_count  # graph
+    total += vector_count                          # bool tombstones
+    if dense_mode:
+        slots = int(vector_count * pad)
+        n_blocks = max(1, slots // max(dense_cluster_size, 1))
+        total += unit * slots                      # packed blocks
+        total += 4 * slots                         # member ids (int32)
+        total += 4 * slots                         # member sqnorms
+        total += 4 * dimension * n_blocks          # block-mean centroids
+        total += vector_count                      # tombstone mask copy
+    return total

@@ -58,3 +58,24 @@ def test_save_config_round_trip():
     q.load_config(section)
     assert q.max_check == 4096
     assert q.save_config() == text
+
+
+def test_memory_estimators_reference_formula():
+    """Parity with VectorIndex::EstimatedMemoryUsage/EstimatedVectorCount
+    (VectorIndex.cpp:403-437): per-row unit = value bytes * dim + 8 (meta
+    offset) + 4 * neighborhood (graph) + 1 (tombstone) + tree nodes."""
+    import sptag_tpu as sp
+
+    # BKT float, d=128, m=32, 1 tree: 512 + 8 + 128 + 1 + 12 = 661 B/row
+    assert sp.estimated_memory_usage(1, 128, "BKT", "Float") == 661
+    assert sp.estimated_memory_usage(1000, 128, "BKT", "Float") == 661_000
+    # KDT node = 16 B; int8 vector = 128 B
+    assert sp.estimated_memory_usage(1, 128, "KDT", "Int8") == \
+        128 + 8 + 128 + 1 + 16
+    # inverse relation
+    n = sp.estimated_vector_count(1 << 30, 128, "BKT", "Float")
+    assert n == (1 << 30) // 661
+    # hbm estimate is positive and grows with n
+    a = sp.estimated_hbm_usage(1000, 128, "Float")
+    b = sp.estimated_hbm_usage(2000, 128, "Float")
+    assert 0 < a < b
