@@ -197,7 +197,8 @@ class BKTIndex(VectorIndex):
                         [clusters[ci], extra])
         return DenseTreeSearcher(
             data, centers, clusters, self._deleted[:self._n],
-            self.dist_calc_method, self.base)
+            self.dist_calc_method, self.base,
+            replicas=getattr(self.params, "dense_replicas", 1))
 
     def _get_dense(self) -> DenseTreeSearcher:
         """Lazy dense snapshot for the dense search mode."""

@@ -156,11 +156,11 @@ def partition_from_tree(tree, n: int, target_size: int
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "metric", "base",
-                                    "use_pallas", "interpret"))
+                                    "use_pallas", "interpret", "dedup"))
 def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
                         cent_sq, deleted, queries, k: int, nprobe: int,
                         metric: int, base: int, use_pallas: bool = False,
-                        interpret: bool = False):
+                        interpret: bool = False, dedup: bool = False):
     """One program: (Q,C) center scores -> top-nprobe block gather ->
     (Q, nprobe*P) candidate scores -> masked top-k.
 
@@ -201,6 +201,13 @@ def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
             queries, vecs, DistCalcMethod(metric), base, sq)
     dead = deleted[jnp.maximum(ids, 0)] | (ids < 0)
     nd = jnp.where(dead, MAX_DIST, nd)
+    if dedup:
+        # closure-assigned replicas: the same row can appear in several
+        # probed blocks with identical distances — keep one occurrence
+        from sptag_tpu.algo.engine import _sorted_dup_mask
+
+        nd = jnp.where(_sorted_dup_mask(jnp.where(ids >= 0, ids, -1)) &
+                       (ids >= 0), MAX_DIST, nd)
     k_eff = min(k, nprobe * P)
     neg, pos = jax.lax.top_k(-nd, k_eff)
     out_d = -neg
@@ -211,11 +218,11 @@ def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "metric", "base",
-                                    "use_pallas", "interpret"))
+                                    "use_pallas", "interpret", "dedup"))
 def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
                           cent_sq, deleted, queries3, k: int, nprobe: int,
                           metric: int, base: int, use_pallas: bool = False,
-                          interpret: bool = False):
+                          interpret: bool = False, dedup: bool = False):
     """(M, chunk, D) query chunks -> ((M, chunk, k), (M, chunk, k)).
 
     `lax.map` over the chunk axis keeps the WHOLE multi-chunk search one
@@ -227,8 +234,79 @@ def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
     def body(q):
         return _dense_search_kernel(
             data_perm, member_ids, member_sq, centroids, cent_sq, deleted,
-            q, k, nprobe, metric, base, use_pallas, interpret)
+            q, k, nprobe, metric, base, use_pallas, interpret, dedup)
     return jax.lax.map(body, queries3)
+
+
+def replicate_clusters(data: np.ndarray, clusters: List[np.ndarray],
+                       replicas: int, metric: DistCalcMethod,
+                       chunk: int = 8192) -> List[np.ndarray]:
+    """Closure assignment: append every row to its `replicas - 1` nearest
+    OTHER blocks (by block-mean distance).
+
+    Boundary rows — whose true neighbors straddle a partition edge — are
+    the dense mode's main recall loss; duplicating them into the adjacent
+    blocks recovers those neighbors at the cost of ~replicas x block
+    memory (the SPANN closure-assignment idea applied to the tree
+    partition).  Results stay duplicate-free: the search kernel masks
+    repeated ids before its final top-k."""
+    if replicas <= 1:
+        return clusters
+
+    means = np.stack([data[c].astype(np.float32).mean(axis=0)
+                      for c in clusters])
+    # -1 = row not covered by any primary cluster (possible when callers
+    # pass a raw partition_from_tree cut); such rows are skipped — replica
+    # placement only duplicates rows the partition already holds
+    own = np.full(data.shape[0], -1, np.int64)
+    for ci, c in enumerate(clusters):
+        own[c] = ci
+    extra = min(replicas - 1, len(clusters) - 1)
+    # per-chunk numpy accumulation (a Python tuple per (row, replica) would
+    # dominate multi-million-row builds); capped below so a popular block
+    # can't balloon the padded block size P (P = max block size, so one
+    # hot block would multiply EVERY block's memory)
+    chunk_rows, chunk_blocks, chunk_dists = [], [], []
+    msq = (means ** 2).sum(1)
+    for off in range(0, data.shape[0], chunk):
+        rows = np.arange(off, min(off + chunk, data.shape[0]))
+        rows = rows[own[rows] >= 0]
+        if not len(rows):
+            continue
+        q = data[rows].astype(np.float32)
+        if metric == DistCalcMethod.Cosine:
+            d = -(q @ means.T)
+        else:
+            # full L2: the per-row |q|^2 term matters because the cap below
+            # compares distances ACROSS rows, not just within one row
+            d = ((q ** 2).sum(1)[:, None] + msq[None, :]
+                 - 2.0 * (q @ means.T))
+        # exclude the row's own block, then take the nearest `extra`
+        d[np.arange(len(rows)), own[rows]] = np.inf
+        top = np.argpartition(d, extra, axis=1)[:, :extra]     # (R, extra)
+        chunk_rows.append(np.repeat(rows, extra))
+        chunk_blocks.append(top.ravel())
+        chunk_dists.append(np.take_along_axis(d, top, axis=1).ravel())
+    if not chunk_rows:
+        return clusters
+    all_rows = np.concatenate(chunk_rows)
+    all_blocks = np.concatenate(chunk_blocks)
+    all_dists = np.concatenate(chunk_dists)
+    order = np.argsort(all_blocks, kind="stable")
+    all_rows, all_blocks, all_dists = (
+        all_rows[order], all_blocks[order], all_dists[order])
+    starts = np.searchsorted(all_blocks, np.arange(len(clusters) + 1))
+    out = []
+    for ci, c in enumerate(clusters):
+        lo, hi = starts[ci], starts[ci + 1]
+        cap = len(c) * (replicas - 1)      # proportional replica intake
+        rows_b, dists_b = all_rows[lo:hi], all_dists[lo:hi]
+        if len(rows_b) > cap:              # keep the closest boundary rows
+            keep = np.argpartition(dists_b, cap - 1)[:cap] if cap else []
+            rows_b = rows_b[keep]
+        out.append(np.concatenate([c, rows_b.astype(np.int64)])
+                   if len(rows_b) else c)
+    return out
 
 
 class DenseTreeSearcher:
@@ -238,15 +316,21 @@ class DenseTreeSearcher:
     `clusters`; the `centers` medoid-sample ids are NOT used for ranking —
     they only serve callers that need a representative sample per block
     (BKTIndex._build_dense_searcher assigns tree-uncovered rows to their
-    nearest center)."""
+    nearest center).  With `replicas > 1` the blocks already contain
+    closure-assigned duplicate rows; the kernel de-duplicates ids before
+    the final top-k."""
 
     def __init__(self, data: np.ndarray, centers: np.ndarray,
                  clusters: List[np.ndarray],
                  deleted: Optional[np.ndarray],
-                 metric: DistCalcMethod, base: int):
+                 metric: DistCalcMethod, base: int,
+                 replicas: int = 1):
         self.metric = DistCalcMethod(metric)
         self.base = base
         self.n = data.shape[0]
+        self.replicas = max(1, replicas)
+        clusters = replicate_clusters(data, clusters, self.replicas,
+                                      self.metric)
         C = len(clusters)
         # int8 VMEM tiles are (32, 128): pad P so the Pallas probe kernel's
         # block shape is legal for integer corpora too
@@ -331,7 +415,8 @@ class DenseTreeSearcher:
                 self.centroids, self.cent_sq, self.deleted, jnp.asarray(q),
                 k_eff, nprobe, int(self.metric), self.base,
                 use_pallas=use_pallas,
-                interpret=pallas_kernels.interpret())
+                interpret=pallas_kernels.interpret(),
+                dedup=self.replicas > 1)
             out_d[:, :d.shape[1]] = np.asarray(d)[:nq]
             out_i[:, :ids.shape[1]] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -349,7 +434,8 @@ class DenseTreeSearcher:
             jnp.asarray(q.reshape(m, chunk, D)),
             k_eff, nprobe, int(self.metric), self.base,
             use_pallas=use_pallas,
-            interpret=pallas_kernels.interpret())
+            interpret=pallas_kernels.interpret(),
+            dedup=self.replicas > 1)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :d.shape[1]] = d[:nq]

@@ -557,7 +557,8 @@ def estimated_vector_count(memory_bytes: int, dimension: int,
 def estimated_hbm_usage(vector_count: int, dimension: int, value_type,
                         neighborhood_size: int = 32,
                         dense_mode: bool = True,
-                        dense_cluster_size: int = 256) -> int:
+                        dense_cluster_size: int = 256,
+                        dense_replicas: int = 1) -> int:
     """Device-HBM bytes for the search snapshots — the TPU-specific
     counterpart the reference doesn't need.
 
@@ -572,7 +573,9 @@ def estimated_hbm_usage(vector_count: int, dimension: int, value_type,
         value_type = enum_from_string(VectorValueType, value_type)
     unit = (np.dtype(dtype_of(VectorValueType(value_type))).itemsize
             * dimension)
-    pad = 1.15                                     # measured block fill
+    # measured ~1.15x padding at 87% block fill; DenseReplicas multiplies
+    # the packed copy (closure assignment duplicates boundary rows)
+    pad = 1.15 * max(1, dense_replicas)
     total = unit * vector_count                    # engine vector snapshot
     total += 4 * vector_count                      # sqnorms
     total += 4 * neighborhood_size * vector_count  # graph
