@@ -351,6 +351,42 @@ def main():
             except Exception as e:                       # noqa: BLE001
                 result["int8_error"] = repr(e)[:300]
 
+        # third metric: KDT cosine (BASELINE.md config 2's GloVe-style
+        # shape) — kd-tree seeding + beam walk, float cosine convention
+        if _remaining(budget_s) > 300:
+            nk = min(n, 50_000)
+            datak, queriesk = make_dataset(n=nk, nq=200)
+            truthk = cosine_truth(datak, queriesk, k)
+
+            def buildk():
+                idxk = sp.create_instance("KDT", "Float")
+                idxk.set_parameter("DistCalcMethod", "Cosine")
+                for name, value in [("KDTNumber", "2"), ("TPTNumber", "8"),
+                                    ("TPTLeafSize", "1000"),
+                                    ("NeighborhoodSize", "32"),
+                                    ("CEF", "256"),
+                                    ("MaxCheckForRefineGraph", "512"),
+                                    ("RefineIterations", "2"),
+                                    ("MaxCheck", "2048")]:
+                    idxk.set_parameter(name, value)
+                idxk.build(datak)
+                return idxk
+
+            try:
+                idxk, buildk_s, cachedk = build_or_load(
+                    f"kdt_f32_cos_n{nk}", buildk, budget_s)
+                idsk, qpsk, _ = timed_sweep(idxk, queriesk, k, batch,
+                                            budget_s, repeats=1)
+                result.update({
+                    "kdt_cosine_qps": round(qpsk, 1),
+                    "kdt_cosine_recall_at_10": round(
+                        recall_at_k(idsk, truthk, k), 4),
+                    "kdt_n": nk,
+                    "kdt_build_s": round(buildk_s, 1),
+                })
+            except Exception as e:                       # noqa: BLE001
+                result["kdt_error"] = repr(e)[:300]
+
         # host-span tracing report (utils/trace.py) — where the wall time
         # went, for the judge and for regression diffing
         result["trace"] = {name: rec["total_s"]

@@ -167,13 +167,20 @@ class BKTIndex(VectorIndex):
                     self._tombstones_dirty = False
         return self._engine
 
-    def _build_dense_searcher(self) -> DenseTreeSearcher:
+    def _build_dense_searcher(self,
+                              replicas: Optional[int] = None
+                              ) -> DenseTreeSearcher:
         """Cluster-contiguous snapshot from the current tree.
 
         Rows appended after the last tree rebuild are not under any tree
         node yet; they are assigned to their nearest cut-center cluster so
-        the partition always covers the whole corpus.
+        the partition always covers the whole corpus.  `replicas` defaults
+        to the DenseReplicas search knob; build-time callers (the refine
+        searcher) pass 1 — replication is a SEARCH-time recall/memory
+        tradeoff and would halve the refine pass's distinct-row coverage.
         """
+        if replicas is None:
+            replicas = getattr(self.params, "dense_replicas", 1)
         data = self._host[:self._n]
         centers, clusters = partition_from_tree(
             self._tree, self._n, self.params.dense_cluster_size)
@@ -198,7 +205,7 @@ class BKTIndex(VectorIndex):
         return DenseTreeSearcher(
             data, centers, clusters, self._deleted[:self._n],
             self.dist_calc_method, self.base,
-            replicas=getattr(self.params, "dense_replicas", 1))
+            replicas=replicas)
 
     def _get_dense(self) -> DenseTreeSearcher:
         """Lazy dense snapshot for the dense search mode."""
@@ -256,7 +263,7 @@ class BKTIndex(VectorIndex):
             if cached is not None and cached[0] == key:
                 searcher = cached[1]
             else:
-                searcher = self._build_dense_searcher()
+                searcher = self._build_dense_searcher(replicas=1)
                 self._refine_dense_cache = (key, searcher)
 
             def search(queries: np.ndarray, k: int):

@@ -116,15 +116,15 @@ def test_dense_replicas_closure_assignment():
         return np.mean([len(set(ids[i]) & set(truth[i])) / 10
                         for i in range(64)])
 
-    r1 = recall(build(1))
-    r2 = recall(build(2))
+    i1, i2 = build(1), build(2)
+    r1, r2 = recall(i1), recall(i2)
     # the recall effect is corpus-dependent (P grows, nprobe shrinks, so
     # FEWER distinct blocks are probed at the same budget) — assert sane
     # floors and the mechanical invariants, not universal improvement
     assert r1 >= 0.9 and r2 >= 0.85, (r1, r2)
     # capped growth: padded block size at most ~2x the replica-free one
-    d1 = build(1)._get_dense()
-    d2 = build(2)._get_dense()
+    d1 = i1._get_dense()
+    d2 = i2._get_dense()
     assert d2.cluster_size <= 2 * d1.cluster_size + 32, (
         d1.cluster_size, d2.cluster_size)
     # replicas really are present: total occupied slots grow
