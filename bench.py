@@ -112,10 +112,29 @@ def cpu_brute_force_qps(data, queries, k=10, sample=50):
 
 
 def l2_truth(data, queries, k):
+    # disk-cached alongside the index caches: exact truth over 200k x 4096
+    # costs minutes of CPU per bench invocation otherwise.  The tag
+    # fingerprints corpus AND queries and carries CACHE_VERSION so dataset
+    # -generation changes invalidate it like the index caches
+    tag = (f"truth_l2_v{CACHE_VERSION}_n{len(data)}_q{len(queries)}_k{k}_"
+           f"{float(data[0, 0]):.6f}_{float(queries[0, 0]):.6f}")
+    path = os.path.join(CACHE_DIR, tag.replace("-", "m") + ".npy")
+    if os.path.exists(path):
+        try:
+            t = np.load(path)
+            if t.shape == (len(queries), k):
+                return t
+        except Exception:                              # noqa: BLE001
+            pass
     truth = np.zeros((len(queries), k), np.int64)
     dn = (data ** 2).sum(1)
     for i in range(0, len(queries), 200):
         truth[i:i + 200] = exact_topk(data, dn, queries[i:i + 200], k)
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        np.save(path, truth)
+    except Exception:                                  # noqa: BLE001
+        pass
     return truth
 
 
@@ -173,15 +192,18 @@ def build_or_load(tag, builder, budget_s):
     return index, build_s, False
 
 
+# graph/search knobs shared by every bench config, tuned for the synthetic
+# corpora (the reference's defaults target much larger corpora,
+# docs/Parameters.md); keeping one list makes the three metrics comparable
+_GRAPH_PARAMS = [("TPTNumber", "8"), ("TPTLeafSize", "1000"),
+                 ("NeighborhoodSize", "32"), ("CEF", "256"),
+                 ("MaxCheckForRefineGraph", "512"),
+                 ("RefineIterations", "2"), ("MaxCheck", "2048")]
+
+
 def _bkt_params(index, n):
-    # build/search knobs tuned for the synthetic corpus; the reference's
-    # defaults target much larger corpora (docs/Parameters.md)
-    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "32"),
-                        ("TPTNumber", "8"), ("TPTLeafSize", "1000"),
-                        ("NeighborhoodSize", "32"), ("CEF", "256"),
-                        ("MaxCheckForRefineGraph", "512"),
-                        ("RefineIterations", "2"),
-                        ("MaxCheck", "2048")]:
+    for name, value in ([("BKTNumber", "1"), ("BKTKmeansK", "32")]
+                        + _GRAPH_PARAMS):
         index.set_parameter(name, value)
 
 
@@ -351,30 +373,25 @@ def main():
             except Exception as e:                       # noqa: BLE001
                 result["int8_error"] = repr(e)[:300]
 
-        # third metric: KDT cosine (BASELINE.md config 2's GloVe-style
-        # shape) — kd-tree seeding + beam walk, float cosine convention
+        # third metric: KDT cosine at d=100 (BASELINE.md config 2's
+        # GloVe-100 shape) — kd-tree seeding + beam walk, float cosine
         if _remaining(budget_s) > 300:
             nk = min(n, 50_000)
-            datak, queriesk = make_dataset(n=nk, nq=200)
-            truthk = cosine_truth(datak, queriesk, k)
-
-            def buildk():
-                idxk = sp.create_instance("KDT", "Float")
-                idxk.set_parameter("DistCalcMethod", "Cosine")
-                for name, value in [("KDTNumber", "2"), ("TPTNumber", "8"),
-                                    ("TPTLeafSize", "1000"),
-                                    ("NeighborhoodSize", "32"),
-                                    ("CEF", "256"),
-                                    ("MaxCheckForRefineGraph", "512"),
-                                    ("RefineIterations", "2"),
-                                    ("MaxCheck", "2048")]:
-                    idxk.set_parameter(name, value)
-                idxk.build(datak)
-                return idxk
-
             try:
+                datak, queriesk = make_dataset(n=nk, d=100, nq=200)
+                truthk = cosine_truth(datak, queriesk, k)
+
+                def buildk():
+                    idxk = sp.create_instance("KDT", "Float")
+                    idxk.set_parameter("DistCalcMethod", "Cosine")
+                    for name, value in ([("KDTNumber", "2")]
+                                        + _GRAPH_PARAMS):
+                        idxk.set_parameter(name, value)
+                    idxk.build(datak)
+                    return idxk
+
                 idxk, buildk_s, cachedk = build_or_load(
-                    f"kdt_f32_cos_n{nk}", buildk, budget_s)
+                    f"kdt_f32_cos_d100_n{nk}", buildk, budget_s)
                 idsk, qpsk, _ = timed_sweep(idxk, queriesk, k, batch,
                                             budget_s, repeats=1)
                 result.update({
