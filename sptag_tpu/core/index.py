@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import abc
 import os
+import shutil
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple, Type, Union
@@ -378,19 +379,48 @@ class VectorIndex(abc.ABC):
 
     def save_index(self, folder: str) -> ErrorCode:
         """Parity: VectorIndex::SaveIndex(folder) (VectorIndex.cpp:162-190),
-        including the transparent compaction of a >40%-deleted index."""
+        including the transparent compaction of a >40%-deleted index.
+
+        Crash-safe improvement over the reference (which writes in place,
+        corrupting the previous checkpoint on a mid-save crash): when
+        `folder` already holds an index, the save lands in a sibling
+        temporary directory that atomically replaces the target only after
+        every file is written."""
         if self.num_samples - self.num_deleted == 0:
             return ErrorCode.EmptyIndex
-        os.makedirs(folder, exist_ok=True)
         with self._lock:
+            # the existing-check and staging setup sit INSIDE the lock so
+            # two threads saving to the same folder can't delete each
+            # other's staging directory mid-write
+            existing = os.path.exists(
+                os.path.join(folder, "indexloader.ini"))
+            target = folder
+            if existing:
+                # unique staging/backup names: a predictable ".saving"
+                # could collide with (and rmtree) unrelated user data
+                token = f"{os.getpid()}-{threading.get_ident()}"
+                target = folder.rstrip("/\\") + f".saving-{token}"
+            os.makedirs(target, exist_ok=True)
             if self.need_refine:
                 self._refine_impl()
-            with open(os.path.join(folder, "indexloader.ini"), "w") as f:
+            with open(os.path.join(target, "indexloader.ini"), "w") as f:
                 f.write(self.save_index_config())
             if self.metadata is not None:
-                self.metadata.save(os.path.join(folder, self._meta_file),
-                                   os.path.join(folder, self._meta_index_file))
-            self._save_index_data(folder)
+                self.metadata.save(os.path.join(target, self._meta_file),
+                                   os.path.join(target,
+                                                self._meta_index_file))
+            self._save_index_data(target)
+            if existing:
+                backup = folder.rstrip("/\\") + f".old-{token}"
+                os.rename(folder, backup)     # previous checkpoint intact
+                os.rename(target, folder)     # the swap
+                # best-effort: the save has SUCCEEDED once the swap lands;
+                # a cleanup failure (symlinked folder, open handles) must
+                # not turn success into an exception
+                try:
+                    shutil.rmtree(backup)
+                except OSError:
+                    pass
         return ErrorCode.Success
 
     # ---- in-memory blob persistence (embedding-host path) -----------------
@@ -475,10 +505,33 @@ class VectorIndex(abc.ABC):
                 self.build_meta_mapping()
 
 
+def _recover_interrupted_save(folder: str) -> None:
+    """Heal the non-atomic window of save_index's directory swap: a crash
+    between its two renames leaves `folder` absent with the complete new
+    index at `folder.saving-*` (preferred — it was fully written before
+    the swap began) or the previous one at `folder.old-*`."""
+    if os.path.exists(os.path.join(folder, "indexloader.ini")):
+        return
+    base = folder.rstrip("/\\")
+    parent = os.path.dirname(base) or "."
+    name = os.path.basename(base)
+    if not os.path.isdir(parent):
+        return
+    for prefix in (name + ".saving-", name + ".old-"):
+        candidates = sorted(
+            e for e in os.listdir(parent)
+            if e.startswith(prefix) and os.path.exists(
+                os.path.join(parent, e, "indexloader.ini")))
+        if candidates:
+            os.rename(os.path.join(parent, candidates[-1]), folder)
+            return
+
+
 def load_index(folder: str, lazy_metadata: bool = False) -> VectorIndex:
     """Parity: VectorIndex::LoadIndex(folder) (VectorIndex.cpp:324-360).
     `lazy_metadata=True` loads metadata as a FileMetadataSet (offsets only
     resident; payload read per lookup)."""
+    _recover_interrupted_save(folder)
     reader = IniReader.load(os.path.join(folder, "indexloader.ini"))
     algo = reader.get_parameter("Index", "IndexAlgoType")
     value_type = reader.get_parameter("Index", "ValueType")

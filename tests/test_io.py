@@ -96,3 +96,67 @@ MetaDataFilePath=metadata.bin
     assert r.section_items("Index")["IndexAlgoType"] == "BKT"
     r2 = IniReader.loads(r.dumps())
     assert r2.get_parameter("MetaData", "MetaDataFilePath") == "metadata.bin"
+
+
+def test_save_over_existing_is_crash_safe(tmp_path):
+    """Re-saving over an existing index folder must not corrupt it when the
+    save dies midway: the swap happens only after every file is written."""
+    import sptag_tpu as sp
+
+    rng = np.random.default_rng(4)
+    data = rng.standard_normal((300, 16)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+    folder = str(tmp_path / "idx")
+    assert idx.save_index(folder) == sp.ErrorCode.Success
+
+    # second save over the same folder succeeds and reloads
+    idx.add(rng.standard_normal((5, 16)).astype(np.float32))
+    assert idx.save_index(folder) == sp.ErrorCode.Success
+    assert sp.load_index(folder).num_samples == 305
+
+    # a save that dies midway leaves the previous checkpoint loadable
+    orig = idx._save_index_data
+    def boom(target):
+        orig(target)
+        raise RuntimeError("disk died")
+    idx._save_index_data = boom
+    try:
+        idx.save_index(folder)
+    except RuntimeError:
+        pass
+    loaded = sp.load_index(folder)
+    assert loaded.num_samples == 305          # previous checkpoint intact
+    _, ids = loaded.search_batch(data[:4], 1)
+    assert (ids[:, 0] >= 0).all()
+
+
+def test_load_recovers_interrupted_swap(tmp_path):
+    """A crash between save_index's two renames leaves no directory at the
+    target; load_index must recover from the staged/backup sibling."""
+    import os
+    import sptag_tpu as sp
+
+    rng = np.random.default_rng(9)
+    data = rng.standard_normal((200, 8)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+    folder = str(tmp_path / "idx")
+    assert idx.save_index(folder) == sp.ErrorCode.Success
+
+    # simulate the crash window: folder renamed away to .old-*, the fully
+    # written staging dir left at .saving-*
+    os.rename(folder, folder + ".old-123-456")
+    import shutil
+    shutil.copytree(folder + ".old-123-456", folder + ".saving-123-456")
+
+    loaded = sp.load_index(folder)                  # recovers .saving first
+    assert loaded.num_samples == 200
+    assert os.path.exists(os.path.join(folder, "indexloader.ini"))
+
+    # backup-only variant
+    shutil.rmtree(folder)
+    loaded = sp.load_index(folder)                  # falls back to .old-*
+    assert loaded.num_samples == 200
