@@ -268,9 +268,13 @@ class BKTIndex(VectorIndex):
 
             def search(queries: np.ndarray, k: int):
                 # a candidate pool at least as big as k keeps the RNG prune
-                # supplied even when the budget knob is set below CEF
-                return searcher.search(queries, k,
-                                       max_check=max(budget, 2 * k))
+                # supplied even when the budget knob is set below CEF;
+                # grouped probing helps refine especially — its queries ARE
+                # corpus rows, maximally probe-local after the sort
+                return searcher.search(
+                    queries, k, max_check=max(budget, 2 * k),
+                    group=getattr(p, "dense_query_group", 0),
+                    union_factor=getattr(p, "dense_union_factor", 2))
             return search
 
         engine = self._make_engine(graph)
@@ -291,7 +295,9 @@ class BKTIndex(VectorIndex):
         p = self.params
         if getattr(p, "search_mode", "beam") == "dense":
             d, ids = self._get_dense().search(
-                queries, min(k, self._n), max_check=p.max_check)
+                queries, min(k, self._n), max_check=p.max_check,
+                group=getattr(p, "dense_query_group", 0),
+                union_factor=getattr(p, "dense_union_factor", 2))
         else:
             d, ids = self._get_engine().search(
                 queries, min(k, self._n), max_check=p.max_check,

@@ -154,6 +154,28 @@ def partition_from_tree(tree, n: int, target_size: int
     return np.asarray(packed_id, np.int64), packed_c
 
 
+def _finalize_topk(nd, ids, deleted, dedup: bool, k: int, extra_dead=None):
+    """Shared epilogue of the dense kernels: tombstone/sentinel masking,
+    optional replica de-duplication, masked top-k, -1 id sentinel."""
+    dead = deleted[jnp.maximum(ids, 0)] | (ids < 0)
+    if extra_dead is not None:
+        dead = dead | extra_dead
+    nd = jnp.where(dead, MAX_DIST, nd)
+    if dedup:
+        # closure-assigned replicas: the same row can appear in several
+        # probed blocks with identical distances — keep one occurrence
+        from sptag_tpu.algo.engine import _sorted_dup_mask
+
+        nd = jnp.where(_sorted_dup_mask(jnp.where(ids >= 0, ids, -1)) &
+                       (ids >= 0), MAX_DIST, nd)
+    k_eff = min(k, nd.shape[1])
+    neg, pos = jax.lax.top_k(-nd, k_eff)
+    out_d = -neg
+    out_ids = jnp.take_along_axis(ids, pos, axis=1)
+    out_ids = jnp.where(out_d < MAX_DIST, out_ids, -1)
+    return out_d, out_ids.astype(jnp.int32)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "metric", "base",
                                     "use_pallas", "interpret", "dedup"))
@@ -199,21 +221,157 @@ def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
         vecs = data_perm[topc].reshape(Q, nprobe * P, D)
         nd = dist_ops.batched_gathered_distance(
             queries, vecs, DistCalcMethod(metric), base, sq)
-    dead = deleted[jnp.maximum(ids, 0)] | (ids < 0)
-    nd = jnp.where(dead, MAX_DIST, nd)
-    if dedup:
-        # closure-assigned replicas: the same row can appear in several
-        # probed blocks with identical distances — keep one occurrence
-        from sptag_tpu.algo.engine import _sorted_dup_mask
+    return _finalize_topk(nd, ids, deleted, dedup, k)
 
-        nd = jnp.where(_sorted_dup_mask(jnp.where(ids >= 0, ids, -1)) &
-                       (ids >= 0), MAX_DIST, nd)
-    k_eff = min(k, nprobe * P)
-    neg, pos = jax.lax.top_k(-nd, k_eff)
-    out_d = -neg
-    out_ids = jnp.take_along_axis(ids, pos, axis=1)
-    out_ids = jnp.where(out_d < MAX_DIST, out_ids, -1)
-    return out_d, out_ids.astype(jnp.int32)
+
+def _segmented_min(vals, first):
+    """Segmented inclusive min-scan along axis 1: `first` marks run starts;
+    each run's LAST element ends up holding the run minimum."""
+    def op(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, jnp.minimum(av, bv)), af | bf
+    mn, _ = jax.lax.associative_scan(op, (vals, first), axis=1)
+    return mn
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "U", "G", "metric",
+                                    "base", "use_pallas", "interpret",
+                                    "dedup"))
+def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
+                                 cent_sq, deleted, queries, nq_valid,
+                                 k: int, nprobe: int, U: int, G: int,
+                                 metric: int, base: int,
+                                 use_pallas: bool = False,
+                                 interpret: bool = False,
+                                 dedup: bool = False):
+    """Query-grouped probing: sort the batch by nearest centroid, split into
+    groups of G neighbors, probe each group's UNION of blocks (top-U by best
+    center distance), and score group x block as real (G, D) x (D, P)
+    contractions.
+
+    vs the per-query kernel: (Q/G)*U grid steps instead of Q*nprobe (fewer
+    per-step fixed costs, G-fold DMA reuse on shared blocks, G MXU rows busy
+    per pass), and every query is scored against U >= nprobe blocks, so at
+    U = 2*nprobe each query sees ~2x MaxCheck candidates for a fraction of
+    the per-query kernel's time.  Queries are un-sorted before returning —
+    the output contract is identical to `_dense_search_kernel`.
+
+    Callers must enforce G <= U: the union ranking admits at most G distinct
+    rank-0 entries per group, so G <= U GUARANTEES every query's top-1 block
+    survives the top-U cut (within-rank overflow would otherwise score a
+    query against none of its own probed blocks).  `nq_valid` (traced
+    scalar) marks queries [nq_valid:] as padding: they sort to the back and
+    never claim union slots."""
+    Q = queries.shape[0]
+    C, P, D = data_perm.shape
+    NG = Q // G
+    qf = queries.astype(jnp.float32)
+    d0 = dist_ops.pairwise_distance(qf, centroids, DistCalcMethod(metric),
+                                    x_sqnorm=cent_sq)            # (Q, C)
+    nd0, topc = jax.lax.top_k(-d0, nprobe)                   # (Q, nprobe)
+    valid = jnp.arange(Q, dtype=jnp.int32) < nq_valid        # (Q,)
+
+    # sort queries by their best block id so groups share probed blocks;
+    # padding sorts to the back (key C) so it doesn't split real groups
+    order = jnp.argsort(jnp.where(valid, topc[:, 0], C))
+    inv = jnp.argsort(order)
+    qs = queries[order]
+    qsf = qf[order]
+    topc_s = topc[order].reshape(NG, G * nprobe)
+    # union-ranking score: probe RANK first, center distance as tie-break.
+    # Ranking by raw distance lets a tight query's far probes crowd out a
+    # loose query's top-1 block — every query's rank-r block must outrank
+    # ALL rank-r+1 blocks or per-query recall collapses for batch outliers.
+    # The tie-break is the distance's position within the query's own probe
+    # SPREAD (shift- and scale-invariant, in [0, 0.999]): raw distances can
+    # be uniformly huge (int cosine ~ base^2 - dot) or uniformly tiny, and
+    # any absolute squash would collapse to a constant and leave block-id
+    # ordering as the de-facto tie-break
+    dc = -nd0                                 # ascending per query (top_k)
+    rel = dc - dc[:, :1]
+    tie = rel / (rel[:, -1:] + 1e-20) * 0.999
+    comp = (jnp.arange(nprobe, dtype=jnp.float32)[None, :]
+            + tie)                                           # (Q, nprobe)
+    # padding queries' probes never evict a real query's blocks
+    comp = jnp.where(valid[:, None], comp, MAX_DIST)
+    topd_s = comp[order].reshape(NG, G * nprobe)
+
+    # distinct union blocks per group, ranked by best (min) score:
+    # sort by block id, segmented-min over runs, keep each run's last
+    o2 = jnp.argsort(topc_s, axis=1)
+    bid = jnp.take_along_axis(topc_s, o2, axis=1)
+    bd = jnp.take_along_axis(topd_s, o2, axis=1)
+    first = jnp.concatenate(
+        [jnp.ones((NG, 1), bool), bid[:, 1:] != bid[:, :-1]], axis=1)
+    mn = _segmented_min(bd, first)
+    last = jnp.concatenate(
+        [bid[:, 1:] != bid[:, :-1], jnp.ones((NG, 1), bool)], axis=1)
+    rank_d = jnp.where(last, mn, MAX_DIST)
+    negu, upos = jax.lax.top_k(-rank_d, U)                   # (NG, U)
+    union = jnp.where(-negu < MAX_DIST,
+                      jnp.take_along_axis(bid, upos, axis=1), -1)
+    union_safe = jnp.maximum(union, 0).astype(jnp.int32)
+
+    ids_u = member_ids[union_safe]                           # (NG, U, P)
+    sq_u = member_sq[union_safe]                             # (NG, U, P)
+    if use_pallas:
+        q_in = qs if data_perm.dtype == jnp.dtype(jnp.int8) else qsf
+        dot = pallas_kernels.group_block_dots(
+            data_perm, q_in, union_safe,
+            interpret=interpret).astype(jnp.float32)         # (NG, U, G, P)
+        dot = dot.transpose(0, 2, 1, 3)                      # (NG, G, U, P)
+    else:
+        vecs = data_perm[union_safe]                         # (NG, U, P, D)
+        if jnp.issubdtype(queries.dtype, jnp.integer):
+            # exact integer dot (reference int convention, DistanceUtils.h:
+            # 452): int32 accumulation, then float for the metric algebra
+            dot = jnp.einsum(
+                "gqd,gupd->gqup", qs.reshape(NG, G, D).astype(jnp.int32),
+                vecs.astype(jnp.int32),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
+        else:
+            dot = jnp.einsum(
+                "gqd,gupd->gqup", qsf.reshape(NG, G, D),
+                vecs.astype(jnp.float32),
+                precision=dist_ops.float_precision(),
+                preferred_element_type=jnp.float32)
+    if int(metric) == int(DistCalcMethod.Cosine):
+        nd = float(base) * float(base) - dot
+    else:
+        qn = jnp.sum(qsf * qsf, axis=-1).reshape(NG, G, 1, 1)
+        nd = jnp.maximum(qn + sq_u[:, None, :, :] - 2.0 * dot, 0.0)
+
+    ids = jnp.broadcast_to(ids_u[:, None, :, :],
+                           (NG, G, U, P)).reshape(Q, U * P)
+    nd = nd.reshape(Q, U * P)
+    pad_blocks = jnp.broadcast_to((union < 0)[:, None, :, None],
+                                  (NG, G, U, P)).reshape(Q, U * P)
+    out_d, out_ids = _finalize_topk(nd, ids, deleted, dedup, k,
+                                    extra_dead=pad_blocks)
+    # un-sort back to the caller's query order
+    return out_d[inv], out_ids[inv]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "U", "G", "metric",
+                                    "base", "use_pallas", "interpret",
+                                    "dedup"))
+def _dense_search_grouped_chunked(data_perm, member_ids, member_sq,
+                                  centroids, cent_sq, deleted, queries3,
+                                  valid3, k: int, nprobe: int, U: int,
+                                  G: int, metric: int, base: int,
+                                  use_pallas: bool = False,
+                                  interpret: bool = False,
+                                  dedup: bool = False):
+    def body(args):
+        q, nv = args
+        return _dense_search_grouped_kernel(
+            data_perm, member_ids, member_sq, centroids, cent_sq, deleted,
+            q, nv, k, nprobe, U, G, metric, base, use_pallas, interpret,
+            dedup)
+    return jax.lax.map(body, (queries3, valid3))
 
 
 @functools.partial(jax.jit,
@@ -362,22 +520,91 @@ class DenseTreeSearcher:
         if deleted is None:
             deleted = np.zeros(self.n, bool)
         self.deleted = jnp.asarray(deleted[:self.n])
+        self.last_effective_group = 0     # set by search(); diagnostic only
+        self._demotions = set()
 
     def set_deleted(self, deleted: np.ndarray) -> None:
         """Swap only the tombstone mask (delete-only mutation path)."""
         self.deleted = jnp.asarray(deleted[:self.n])
 
-    def search(self, queries: np.ndarray, k: int, max_check: int = 2048
+    def _group_floor(self) -> int:
+        """Smallest legal query-group size: the Pallas (G, D) query block's
+        sublane minimum for this dtype ((8,128) f32, (32,128) int8)."""
+        return 32 if self.data_perm.dtype == jnp.dtype(jnp.int8) else 8
+
+    def search(self, queries: np.ndarray, k: int, max_check: int = 2048,
+               group: int = 0, union_factor: int = 2
                ) -> Tuple[np.ndarray, np.ndarray]:
+        """`group` > 1 enables query-grouped probing (DenseQueryGroup):
+        the batch is sorted by nearest centroid, split into groups of
+        `group` queries, and each group probes the top
+        ``union_factor * nprobe`` blocks of its probe UNION — fewer, fatter
+        MXU contractions and more candidates per query than the per-query
+        kernel.  `group` must be a power of two (padding buckets are)."""
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
         nq, D = queries.shape
         P = self.cluster_size
         nprobe = int(np.clip(-(-max_check // P), 1, self.num_clusters))
-        k_eff = min(k, nprobe * P, self.n)
+        G = int(group) if group and group > 1 else 0
+        if G and (G & (G - 1)):
+            raise ValueError(f"DenseQueryGroup must be a power of two: {G}")
+        if G:
+            # adaptive cap: groups only share probes when several batch
+            # queries land on each partition block.  A sparse batch
+            # (queries/block < ~G/4) makes unions wide and the top-U cut
+            # starves individual queries, so shrink the group to ~4 blocks'
+            # worth of queries (power of two to keep padding buckets tiling)
+            per_block = max(1, nq // max(self.num_clusters, 1))
+            cap = 1 << max(1, (4 * per_block).bit_length() - 1)
+            G = min(G, max(cap, 2))
+        U = (min(max(int(union_factor), 1) * nprobe, self.num_clusters)
+             if G else 0)
+        if G:
+            # a group admits at most G distinct rank-0 union entries, so
+            # G <= U guarantees every query's top-1 block survives the
+            # top-U cut (see _dense_search_grouped_kernel)
+            G = min(G, 1 << (U.bit_length() - 1))
+            # dtype tile floor: the Pallas (G, D) query block needs the
+            # sublane minimum ((8,128) f32 / (32,128) int8); below it, fall
+            # back to the UNGROUPED kernel rather than compile an illegal
+            # block (which would trip the except-handler and disable the
+            # working per-query Pallas kernel process-wide).  Applied on
+            # every platform so CPU and TPU return the same results
+            if G < self._group_floor():
+                G = 0
+            # only G*nprobe distinct blocks can exist in a group's union —
+            # a wider top-k over the (NG, G*nprobe) rank buffer would be
+            # out of bounds
+            U = min(U, G * nprobe) if G else U
+        # grouping degenerates to a full scan when the union would cover
+        # every block anyway — the per-query kernel is cheaper there
+        if G and U >= self.num_clusters and nprobe >= self.num_clusters:
+            G = 0
+        # observability: callers asked for grouping but the adaptive cap /
+        # tile floor / U clamp demoted it — record the effective value and
+        # log each distinct demotion once (silent demotion has already
+        # misled bench configs)
+        self.last_effective_group = G
+        if group and int(group) > 1 and G != int(group):
+            key = (int(group), G, nq)
+            if key not in self._demotions:
+                self._demotions.add(key)
+                import logging
 
-        chunk = max(1, min(_GATHER_BUDGET // (nprobe * P * D * 4), 1024))
+                logging.getLogger(__name__).info(
+                    "dense grouped probing: requested group=%s -> "
+                    "effective %s (nq=%d, clusters=%d, nprobe=%d, U=%s)",
+                    group, G or "off", nq, self.num_clusters, nprobe,
+                    U or "-")
+        k_eff = min(k, (U if G else nprobe) * P, self.n)
+
+        bytes_q = ((U * P * D * 4 + G - 1) // G if G
+                   else nprobe * P * D * 4)
+        chunk = max(1, min(_GATHER_BUDGET // bytes_q, 1024))
+        if G:
+            chunk = max(G, (chunk // G) * G)    # groups must tile the chunk
         # the int8 kernel needs int8 queries too (dot_general forbids mixed
         # dtypes); float queries against an int8 corpus take the XLA path
         use_pallas = pallas_kernels.supported(self.data_perm) and (
@@ -385,15 +612,32 @@ class DenseTreeSearcher:
             or queries.dtype == np.dtype(np.int8))
         try:
             return self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
-                                     D, use_pallas)
+                                     D, use_pallas, G, U)
         except Exception as e:                         # noqa: BLE001
             # a pallas_call that fails to COMPILE on this backend (Mosaic
-            # lowering gap) must degrade to the XLA path, not take search
-            # availability down
+            # lowering gap) must degrade gracefully, not take search
+            # availability down.  Graduated ladder, semantics first: a
+            # failure with grouping active retries the SAME grouped search
+            # through XLA (only the new grouped Pallas kernel may be at
+            # fault — the caller's requested union semantics are kept) and
+            # pins grouped searches to XLA for the process; only a
+            # per-query Pallas failure with a successful XLA retry
+            # justifies process-wide Pallas disablement
             if not use_pallas:
                 raise
-            out = self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
-                                    D, use_pallas=False)
+            if G and not pallas_kernels.grouped_disabled():
+                try:
+                    out = self._search_impl(queries, nq, k, k_eff, nprobe,
+                                            chunk, D, use_pallas=False,
+                                            G=G, U=U)
+                    pallas_kernels.disable_grouped(repr(e)[:200])
+                    return out
+                except Exception:                      # noqa: BLE001
+                    pass                # grouped itself at fault: ungroup
+            self.last_effective_group = 0
+            out = self._search_impl(queries, nq, k,
+                                    min(k_eff, nprobe * P), nprobe, chunk,
+                                    D, use_pallas=False, G=0, U=0)
             # the XLA retry SUCCEEDED, so the failure was pallas-specific:
             # only now is process-wide disablement justified (a transient
             # error would have failed the retry too and re-raised above)
@@ -401,22 +645,40 @@ class DenseTreeSearcher:
             return out
 
     def _search_impl(self, queries, nq, k, k_eff, nprobe, chunk, D,
-                     use_pallas):
+                     use_pallas, G=0, U=0):
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
+        interp = pallas_kernels.interpret()
+        dedup = self.replicas > 1
         if nq <= chunk:
             q_pad = query_bucket(nq, chunk)
+            g_eff = min(G, q_pad) if G else 0     # buckets are powers of 2
+            if g_eff < self._group_floor():
+                g_eff = 0                         # tile floor (see search)
+            if g_eff != G:
+                self.last_effective_group = g_eff
             q = queries
             if q_pad != nq:
                 q = np.concatenate(
                     [q, np.zeros((q_pad - nq, D), q.dtype)])
-            d, ids = _dense_search_kernel(
-                self.data_perm, self.member_ids, self.member_sq,
-                self.centroids, self.cent_sq, self.deleted, jnp.asarray(q),
-                k_eff, nprobe, int(self.metric), self.base,
-                use_pallas=use_pallas,
-                interpret=pallas_kernels.interpret(),
-                dedup=self.replicas > 1)
+            if g_eff > 1:
+                d, ids = _dense_search_grouped_kernel(
+                    self.data_perm, self.member_ids, self.member_sq,
+                    self.centroids, self.cent_sq, self.deleted,
+                    jnp.asarray(q), jnp.int32(nq), k_eff, nprobe, U, g_eff,
+                    int(self.metric), self.base,
+                    # a grouped-Pallas compile failure pins grouped
+                    # searches to XLA; the per-query kernel keeps Pallas
+                    use_pallas=use_pallas
+                    and not pallas_kernels.grouped_disabled(),
+                    interpret=interp, dedup=dedup)
+            else:
+                d, ids = _dense_search_kernel(
+                    self.data_perm, self.member_ids, self.member_sq,
+                    self.centroids, self.cent_sq, self.deleted,
+                    jnp.asarray(q), k_eff, nprobe, int(self.metric),
+                    self.base, use_pallas=use_pallas, interpret=interp,
+                    dedup=dedup)
             out_d[:, :d.shape[1]] = np.asarray(d)[:nq]
             out_i[:, :ids.shape[1]] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -428,14 +690,27 @@ class DenseTreeSearcher:
         if m * chunk != nq:
             q = np.concatenate(
                 [q, np.zeros((m * chunk - nq, D), q.dtype)])
-        d, ids = _dense_search_chunked(
-            self.data_perm, self.member_ids, self.member_sq,
-            self.centroids, self.cent_sq, self.deleted,
-            jnp.asarray(q.reshape(m, chunk, D)),
-            k_eff, nprobe, int(self.metric), self.base,
-            use_pallas=use_pallas,
-            interpret=pallas_kernels.interpret(),
-            dedup=self.replicas > 1)
+        if G > 1:
+            # per-chunk valid counts mask the tail chunk's zero padding out
+            # of the union ranking
+            valid3 = np.clip(nq - chunk * np.arange(m), 0, chunk)
+            d, ids = _dense_search_grouped_chunked(
+                self.data_perm, self.member_ids, self.member_sq,
+                self.centroids, self.cent_sq, self.deleted,
+                jnp.asarray(q.reshape(m, chunk, D)),
+                jnp.asarray(valid3, np.int32),
+                k_eff, nprobe, U, min(G, chunk), int(self.metric),
+                self.base,
+                use_pallas=use_pallas
+                and not pallas_kernels.grouped_disabled(),
+                interpret=interp, dedup=dedup)
+        else:
+            d, ids = _dense_search_chunked(
+                self.data_perm, self.member_ids, self.member_sq,
+                self.centroids, self.cent_sq, self.deleted,
+                jnp.asarray(q.reshape(m, chunk, D)),
+                k_eff, nprobe, int(self.metric), self.base,
+                use_pallas=use_pallas, interpret=interp, dedup=dedup)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :d.shape[1]] = d[:nq]

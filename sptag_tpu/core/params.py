@@ -140,6 +140,24 @@ class BKTParams(ParamSet):
             # clustered corpus), hurts when they spread across many blocks
             # (fewer DISTINCT blocks probed) — hence opt-in; 1 disables
             _spec("dense_replicas", int, 1, "DenseReplicas"),
+            # query-grouped probing: sort the batch by nearest centroid,
+            # split into groups of this many queries (power of two; 0
+            # disables), and probe each group's top-U block UNION
+            # (U = DenseUnionFactor * nprobe) with real (G, D) x (D, P) MXU
+            # contractions — (Q/G)*U grid steps instead of Q*nprobe
+            # matvecs.  Each query keeps its top-1 block (G is clamped to
+            # <= U) and is scored against the whole union; with tight
+            # groups that covers MORE of its own probes than nprobe, with
+            # loose groups fewer — the engine auto-shrinks G on sparse
+            # batches and disables grouping below the dtype tile floor
+            # (8 queries f32, 32 int8), so small/sparse batches silently
+            # run the per-query kernel.  Opt-in (0 disables, like
+            # DenseReplicas): grouping scores each query against the union
+            # rather than exactly its own nprobe probes, so the strict
+            # "MaxCheck = candidates scored per query" reference semantics
+            # only hold with it off
+            _spec("dense_query_group", int, 0, "DenseQueryGroup"),
+            _spec("dense_union_factor", int, 2, "DenseUnionFactor"),
             # which engine runs the per-node refine searches during graph
             # build: "dense" (MXU cluster scan — build time is matmuls) or
             # "beam" (reference RefineGraph semantics, NeighborhoodGraph.h:

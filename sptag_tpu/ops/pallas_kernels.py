@@ -27,8 +27,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_INTERPRET = False   # tests may flip this to run on CPU
-_DISABLED = False    # set when a kernel fails to compile on the backend
+_INTERPRET = False        # tests may flip this to run on CPU
+_DISABLED = False         # set when a kernel fails to compile on the backend
+_GROUP_DISABLED = False   # grouped kernel only (per-query kernel stays live)
 
 
 def set_interpret(value: bool) -> None:
@@ -48,6 +49,21 @@ def disable(reason: str = "") -> None:
 
     logging.getLogger(__name__).warning(
         "pallas kernels disabled for this process: %s", reason)
+
+
+def disable_grouped(reason: str = "") -> None:
+    """Disable only the grouped probe kernel (callers fall back to the
+    per-query Pallas kernel, which stays live)."""
+    global _GROUP_DISABLED
+    _GROUP_DISABLED = True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "grouped pallas kernel disabled for this process: %s", reason)
+
+
+def grouped_disabled() -> bool:
+    return _GROUP_DISABLED
 
 
 def interpret() -> bool:
@@ -136,3 +152,63 @@ def probe_block_dots(data_perm: jax.Array, queries: jax.Array,
         grid_spec=grid_spec,
         interpret=interpret,
     )(topc, queries, data_perm)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def group_block_dots(data_perm: jax.Array, queries: jax.Array,
+                     union_c: jax.Array, interpret: bool = False
+                     ) -> jax.Array:
+    """(C, P, D) blocks, (Q, D) queries sorted into Q/G groups of G, and
+    (Q/G, U) int32 per-GROUP block ids -> (Q/G, U, G, P) dot products of
+    every query in a group with every row of the group's union blocks.
+
+    The probe-major `probe_block_dots` issues one grid step per
+    (query, probe) — Q*nprobe steps whose (1, D) x (D, P) matvecs leave the
+    MXU rows idle and whose per-step fixed cost dominates at small P.  Here
+    queries are pre-sorted by nearest centroid (algo/dense.py) so a GROUP of
+    G neighbors shares most of its probed blocks; one step scores the whole
+    group against one union block as a real (G, D) x (D, P) contraction:
+    (Q/G)*U steps, G-fold fewer DMAs for the shared blocks, and G MXU rows
+    busy instead of one.  `union_c` entries must be valid block ids
+    (callers clamp padding to 0 and mask downstream)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, P, D = data_perm.shape
+    Q, _ = queries.shape
+    NG, U = union_c.shape
+    G = Q // NG
+    int_path = data_perm.dtype == jnp.dtype(jnp.int8)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(NG, U),
+        in_specs=[
+            # one (G, D) query block per group, constant across the U steps
+            pl.BlockSpec((G, D), lambda g, j, t: (g, 0)),
+            pl.BlockSpec((1, P, D), lambda g, j, t: (t[g, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, P), lambda g, j, t: (g, j, 0, 0)),
+    )
+
+    def kernel(t_ref, q_ref, blk_ref, out_ref):
+        if int_path:
+            dot = jax.lax.dot_general(
+                q_ref[...], blk_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+        else:
+            dot = jax.lax.dot_general(
+                q_ref[...], blk_ref[0],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+        out_ref[0, 0] = dot
+
+    out_dt = jnp.int32 if int_path else jnp.float32
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((NG, U, G, P), out_dt),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(union_c, queries, data_perm)

@@ -86,6 +86,82 @@ def test_bkt_dense_after_add_covers_new_rows():
     assert hit >= 0.9, (hit, ids)
 
 
+def test_dense_grouped_probing():
+    """Query-grouped probing (DenseQueryGroup) must match or beat the
+    per-query kernel's recall at the same MaxCheck (each query is scored
+    against the group union's U >= nprobe blocks) and handle non-multiple
+    batch sizes via padding."""
+    data = _corpus(n=2000, d=16, seed=9)
+    tree = BKTree(tree_number=1, kmeans_k=8, leaf_size=8, samples=100)
+    tree.build(data)
+    centers, clusters = partition_from_tree(tree, len(data), 64)
+    searcher = DenseTreeSearcher(data, centers, clusters, None,
+                                 DistCalcMethod.L2, 1)
+    rng = np.random.default_rng(1)
+    # dense enough over the ~31 blocks that the adaptive cap keeps G >= the
+    # f32 tile floor (8); deliberately not a padding bucket so the padding
+    # mask (nq_valid) is exercised too
+    nq = 131
+    queries = data[rng.integers(0, len(data), nq)] \
+        + rng.standard_normal((nq, 16)).astype(np.float32) * 0.05
+
+    exact = ((queries ** 2).sum(1)[:, None] + (data ** 2).sum(1)[None, :]
+             - 2.0 * (queries @ data.T))
+    truth = np.argsort(exact, axis=1)[:, :10]
+
+    def recall(ids):
+        return np.mean([len(set(ids[q].tolist()) & set(truth[q].tolist()))
+                        / 10 for q in range(nq)])
+
+    d0, i0 = searcher.search(queries, k=10, max_check=256)
+    # union_factor=4 makes U >= G*nprobe after the adaptive group cap, so
+    # every query's own probes are a SUBSET of its group union: recall can
+    # only match or improve
+    d1, i1 = searcher.search(queries, k=10, max_check=256,
+                             group=8, union_factor=4)
+    assert np.all(np.diff(d1, axis=1) >= -1e-4)
+    r0, r1 = recall(i0), recall(i1)
+    assert r1 >= r0 - 1e-9, (r0, r1)
+    # the tighter default union (factor 2) trades a little per-query probe
+    # coverage for speed — recall must stay in the same band
+    _, i3 = searcher.search(queries, k=10, max_check=256,
+                            group=8, union_factor=2)
+    assert recall(i3) >= r0 - 0.05, (r0, recall(i3))
+    # self-queries through the GROUPED path (batch dense enough that the
+    # adaptive cap keeps G=8).  Only a query's rank-0 block is guaranteed
+    # to survive the union cut, and a row's own block is not always its
+    # nearest-centroid block — assert a high hit RATE, not exactness
+    d_self, i_self = searcher.search(data[:128], k=1, group=8,
+                                     max_check=256, union_factor=4)
+    assert searcher.last_effective_group == 8
+    hit = np.mean(i_self[:, 0] == np.arange(128))
+    assert hit >= 0.95, (hit, i_self[:, 0])
+    # a sparse 3-query batch demotes grouping (adaptive cap below the tile
+    # floor) and still returns correct shapes through the per-query kernel
+    d2, i2 = searcher.search(queries[:3], k=5, group=64, union_factor=2)
+    assert searcher.last_effective_group == 0
+    assert i2.shape == (3, 5) and (i2[:, 0] >= 0).all()
+    # oversized union factor WITH grouping active: U is clamped to the
+    # rank buffer's width (G*nprobe) and the cluster count — no top_k crash
+    d4, i4 = searcher.search(queries, k=5, max_check=256,
+                             group=16, union_factor=50)
+    assert searcher.last_effective_group > 1
+    assert i4.shape == (nq, 5) and (i4[:, 0] >= 0).all()
+
+
+def test_dense_grouped_power_of_two_validation():
+    data = _corpus(n=300)
+    tree = BKTree(tree_number=1, kmeans_k=8, leaf_size=8, samples=100)
+    tree.build(data)
+    centers, clusters = partition_from_tree(tree, len(data), 64)
+    searcher = DenseTreeSearcher(data, centers, clusters, None,
+                                 DistCalcMethod.L2, 1)
+    import pytest
+
+    with pytest.raises(ValueError):
+        searcher.search(data[:4], k=2, group=12)
+
+
 def test_dense_replicas_closure_assignment():
     """DenseReplicas=2 packs boundary rows into their nearest other block
     (capped), improving recall at fixed MaxCheck without duplicate ids in

@@ -91,6 +91,68 @@ def test_dense_kernel_pallas_vs_xla_paths():
                                rtol=1e-5, atol=1e-3)
 
 
+def test_group_block_dots_matches_einsum():
+    rng = np.random.default_rng(6)
+    C, P, D, Q, G, U = 9, 8, 128, 16, 4, 5
+    NG = Q // G
+    data_perm = jnp.asarray(rng.standard_normal((C, P, D)).astype(np.float32))
+    queries = jnp.asarray(rng.standard_normal((Q, D)).astype(np.float32))
+    union = jnp.asarray(rng.integers(0, C, (NG, U)).astype(np.int32))
+
+    got = pallas_kernels.group_block_dots(data_perm, queries, union,
+                                          interpret=True)
+    assert got.shape == (NG, U, G, P)
+    want = jnp.einsum("gqd,gupd->guqp",
+                      queries.reshape(NG, G, D), data_perm[union])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_group_block_dots_int8_exact():
+    rng = np.random.default_rng(7)
+    C, P, D, Q, G, U = 5, 32, 128, 64, 32, 3
+    NG = Q // G
+    data_perm = jnp.asarray(
+        rng.integers(-127, 128, (C, P, D)).astype(np.int8))
+    queries = jnp.asarray(rng.integers(-127, 128, (Q, D)).astype(np.int8))
+    union = jnp.asarray(rng.integers(0, C, (NG, U)).astype(np.int32))
+
+    got = pallas_kernels.group_block_dots(data_perm, queries, union,
+                                          interpret=True)
+    assert got.dtype == jnp.int32
+    want = np.einsum("gqd,gupd->guqp",
+                     np.asarray(queries, np.int64).reshape(NG, G, D),
+                     np.asarray(data_perm, np.int64)[np.asarray(union)])
+    np.testing.assert_array_equal(np.asarray(got, np.int64), want)
+
+
+def test_dense_grouped_kernel_pallas_vs_xla():
+    """The grouped dense kernel must produce identical ids through both
+    scoring paths."""
+    from sptag_tpu.algo.dense import _dense_search_grouped_kernel
+
+    rng = np.random.default_rng(8)
+    C, P, D, Q, nprobe, G = 6, 16, 128, 16, 2, 4
+    n = C * P
+    data = rng.standard_normal((n, D)).astype(np.float32)
+    perm = data.reshape(C, P, D)
+    mids = jnp.asarray(np.arange(n, dtype=np.int32).reshape(C, P))
+    sq = jnp.asarray((data ** 2).sum(1).astype(np.float32).reshape(C, P))
+    cents = jnp.asarray(perm.mean(axis=1))
+    cent_sq = jnp.asarray((np.asarray(cents) ** 2).sum(1))
+    deleted = jnp.zeros(n, bool)
+    queries = jnp.asarray(rng.standard_normal((Q, D)).astype(np.float32))
+
+    args = (jnp.asarray(perm), mids, sq, cents, cent_sq, deleted, queries,
+            jnp.int32(Q), 5, nprobe, 4, G, 0, 1)
+    d_x, i_x = _dense_search_grouped_kernel(*args, use_pallas=False)
+    d_p, i_p = _dense_search_grouped_kernel(*args, use_pallas=True,
+                                            interpret=True)
+    np.testing.assert_array_equal(np.asarray(i_x), np.asarray(i_p))
+    np.testing.assert_allclose(np.asarray(d_x), np.asarray(d_p),
+                               rtol=1e-5, atol=1e-3)
+
+
 @pytest.mark.parametrize("metric,base", [(0, 127), (1, 127)])
 def test_dense_kernel_int8_pallas_vs_xla(metric, base):
     """int8 metric composition (L2 qn+sq-2dot / cosine base^2-dot) must be
