@@ -198,10 +198,7 @@ def build_or_load(tag, builder, budget_s):
 _GRAPH_PARAMS = [("TPTNumber", "8"), ("TPTLeafSize", "1000"),
                  ("NeighborhoodSize", "32"), ("CEF", "256"),
                  ("MaxCheckForRefineGraph", "512"),
-                 ("RefineIterations", "2"), ("MaxCheck", "2048"),
-                 # throughput serving: query-grouped probing (fewer, fatter
-                 # MXU contractions; int8 needs 32 to clear its tile floor)
-                 ("DenseQueryGroup", "32")]
+                 ("RefineIterations", "2"), ("MaxCheck", "2048")]
 
 
 def _bkt_params(index, n):
@@ -257,7 +254,7 @@ def recall_at_k(ids_all, truth, k):
         for i in range(len(truth))]))
 
 
-def main():
+def run_bench():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
     k, batch = 10, 1024
@@ -321,9 +318,10 @@ def main():
         with trace.span("bench.build_or_load"):
             index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build,
                                                    budget_s)
-        # search-time knobs are NOT in a cached index's saved ini — apply
-        # them to loaded indexes too or cached runs silently drop them
-        index.set_parameter("DenseQueryGroup", "32")
+        # f32 headline runs UNGROUPED: on this corpus (256 loose centers)
+        # grouped probing at union_factor 2 measured recall 0.824 vs 0.967
+        # ungrouped — probe sharing is too weak.  int8 below opts in (its
+        # tighter clusters measured recall UP at union_factor 4).
         with trace.span("bench.sweep"):
             ids_all, qps, batch_times = timed_sweep(index, queries, k, batch,
                                                     budget_s)
@@ -454,6 +452,80 @@ def main():
         result["traceback"] = traceback.format_exc()[-1000:]
     result["total_s"] = round(time.time() - _t_start, 1)
     print(json.dumps(result))
+
+
+def _last_json_line(text):
+    for line in reversed(text.strip().splitlines()):
+        if line.startswith("{"):
+            return line
+    return None
+
+
+def _fallback_result(err):
+    result = {"metric": "qps_per_chip_bkt_n200000_d128_l2_recall@10",
+              "value": 0.0, "unit": "qps", "vs_baseline": 0.0,
+              "error": err}
+    try:
+        with open(os.path.join(REPO, "reports", "tpu_last.json")) as f:
+            result["last_measured_tpu"] = json.load(f)
+    except Exception:                                    # noqa: BLE001
+        pass
+    return result
+
+
+def main():
+    """Watchdog parent: the measurement runs in a CHILD process under a
+    hard deadline.  The tunneled backend's remote-compile service has been
+    observed to HANG indefinitely on new compiles (not just error), which
+    no in-process budget check can escape; a hung child is killed and the
+    bench retries once on the CPU backend (compiles are local) so the
+    round always gets a measured JSON line."""
+    if os.environ.get("BENCH_CHILD") == "1":
+        run_bench()
+        return
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    t_parent = time.time()
+    script = os.path.abspath(__file__)
+    env = dict(os.environ, BENCH_CHILD="1")
+    cpu_reserve = 700.0            # parent keeps room for the CPU retry
+    # small budgets: the TPU child gets most of the budget and the CPU
+    # retry squeezes into what remains (+120 s grace) rather than adding a
+    # fixed 600 s on top of an already-spent budget
+    tpu_timeout = max(min(600.0, budget_s), budget_s - cpu_reserve)
+    env["BENCH_BUDGET_S"] = str(max(tpu_timeout - 60.0, 60.0))
+    err = ""
+    try:
+        p = subprocess.run([sys.executable, script] + sys.argv[1:],
+                           env=env, capture_output=True, text=True,
+                           timeout=tpu_timeout)
+        line = _last_json_line(p.stdout)
+        if line is not None:
+            print(line)
+            return
+        err = f"child rc={p.returncode} stderr={p.stderr.strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        err = (f"bench child exceeded {tpu_timeout:.0f}s — hung backend/"
+               "remote compile; killed")
+    except Exception as e:                               # noqa: BLE001
+        err = repr(e)[:300]
+    env["BENCH_PLATFORM"] = "cpu"
+    cpu_timeout = max(120.0, min(600.0,
+                                 budget_s - (time.time() - t_parent) + 120))
+    env["BENCH_BUDGET_S"] = str(max(cpu_timeout - 100.0, 60.0))
+    try:
+        p = subprocess.run([sys.executable, script] + sys.argv[1:],
+                           env=env, capture_output=True, text=True,
+                           timeout=cpu_timeout)
+        line = _last_json_line(p.stdout)
+        if line is not None:
+            obj = json.loads(line)
+            obj["tpu_child_error"] = err
+            print(json.dumps(obj))
+            return
+        err += f" | cpu retry rc={p.returncode}"
+    except Exception as e:                               # noqa: BLE001
+        err += f" | cpu retry {repr(e)[:200]}"
+    print(json.dumps(_fallback_result(err)))
 
 
 if __name__ == "__main__":

@@ -638,10 +638,15 @@ class DenseTreeSearcher:
             out = self._search_impl(queries, nq, k,
                                     min(k_eff, nprobe * P), nprobe, chunk,
                                     D, use_pallas=False, G=0, U=0)
-            # the XLA retry SUCCEEDED, so the failure was pallas-specific:
-            # only now is process-wide disablement justified (a transient
-            # error would have failed the retry too and re-raised above)
-            pallas_kernels.disable(repr(e)[:200])
+            # the ungrouped XLA retry SUCCEEDED, so the failure was not
+            # transient.  Scope the disablement to what actually failed:
+            # with grouping active, BOTH grouped paths failed but the
+            # per-query Pallas kernel never ran — disabling it would
+            # punish an innocent fast path
+            if G:
+                pallas_kernels.disable_grouped(repr(e)[:200])
+            else:
+                pallas_kernels.disable(repr(e)[:200])
             return out
 
     def _search_impl(self, queries, nq, k, k_eff, nprobe, chunk, D,

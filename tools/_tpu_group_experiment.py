@@ -1,0 +1,95 @@
+"""One-off live-TPU experiment: grouped-probing compile health + sweep.
+
+Run from /root/repo: `python tools/_tpu_group_experiment.py`
+Prints one JSON line per probe; safe to re-run (cached index).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    import sptag_tpu as sp
+    from sptag_tpu.ops import pallas_kernels
+    from sptag_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    out = {"platform": jax.devices()[0].platform}
+
+    # A) compile-service health: a never-before-seen tiny XLA program
+    t0 = time.time()
+    try:
+        x = jnp.arange(1237, dtype=jnp.float32)
+        y = jax.jit(lambda v: (v * 3.13).sum())(x)
+        float(y)
+        out["xla_new_compile"] = f"ok {time.time()-t0:.1f}s"
+    except Exception as e:                              # noqa: BLE001
+        out["xla_new_compile"] = repr(e)[:200]
+        print(json.dumps(out))
+        return
+
+    # B) grouped Pallas kernel compile, tiny shape
+    t0 = time.time()
+    try:
+        rng = np.random.default_rng(0)
+        perm = jnp.asarray(rng.standard_normal((8, 64, 128), np.float32))
+        qs = jnp.asarray(rng.standard_normal((32, 128), np.float32))
+        un = jnp.asarray(rng.integers(0, 8, (2, 4)).astype(np.int32))
+        d = pallas_kernels.group_block_dots(perm, qs, un)
+        np.asarray(d)
+        out["grouped_pallas_compile"] = f"ok {time.time()-t0:.1f}s"
+    except Exception as e:                              # noqa: BLE001
+        out["grouped_pallas_compile"] = repr(e)[:300]
+
+    # C) per-query Pallas kernel compile (fresh tiny shape)
+    t0 = time.time()
+    try:
+        topc = jnp.asarray(rng.integers(0, 8, (32, 3)).astype(np.int32))
+        d = pallas_kernels.probe_block_dots(
+            jnp.asarray(rng.standard_normal((8, 64, 128), np.float32)),
+            qs, topc)
+        np.asarray(d)
+        out["perquery_pallas_compile"] = f"ok {time.time()-t0:.1f}s"
+    except Exception as e:                              # noqa: BLE001
+        out["perquery_pallas_compile"] = repr(e)[:300]
+    print(json.dumps(out))
+
+    # D) sweep on the cached 200k index
+    data, queries = bench.make_dataset(n=200_000, nq=4096)
+    truth = bench.l2_truth(data, queries, 10)
+    index = sp.load_index(".bench_cache/bkt_f32_n200000_v3")
+
+    def run(tag, group, uf):
+        index.set_parameter("DenseQueryGroup", str(group))
+        index.set_parameter("DenseUnionFactor", str(uf))
+        index.search_batch(queries, 10)           # warm/compile
+        t0 = time.perf_counter()
+        _, ids = index.search_batch(queries, 10)
+        dt = time.perf_counter() - t0
+        rec = bench.recall_at_k(np.asarray(ids[:, :10], np.int64), truth, 10)
+        row = {"cfg": tag, "qps": round(4096 / dt, 1),
+               "recall": round(rec, 4),
+               "geff": index._get_dense().last_effective_group,
+               "pallas_disabled": pallas_kernels._DISABLED,
+               "grouped_disabled": pallas_kernels._GROUP_DISABLED}
+        print(json.dumps(row))
+        sys.stdout.flush()
+
+    run("ungrouped", 0, 2)
+    run("G16_U2", 16, 2)
+    run("G16_U3", 16, 3)
+    run("G16_U4", 16, 4)
+    run("G8_U4", 8, 4)
+
+
+if __name__ == "__main__":
+    main()
