@@ -40,6 +40,7 @@ from sptag_tpu.core.index import MAX_DIST, VectorIndex, register_algo
 from sptag_tpu.core.params import BKTParams
 from sptag_tpu.core.types import IndexAlgoType, VectorValueType, dtype_of
 from sptag_tpu.graph.rng import RelativeNeighborhoodGraph
+from sptag_tpu.utils import trace
 from sptag_tpu.io import format as fmt
 from sptag_tpu.trees.bktree import BKTree
 
@@ -227,14 +228,16 @@ class BKTIndex(VectorIndex):
         self._structure_gen += 1
 
         self._tree = self._new_tree()
-        self._tree.build(self._host[:self._n])
+        with trace.span("build.bkt_tree"):
+            self._tree.build(self._host[:self._n])
         log.info("BKT forest built: %d nodes", self._tree.num_nodes)
 
         self._graph = self._new_graph()
         try:
-            self._graph.build(self._host[:self._n],
-                              int(self.dist_calc_method), self.base,
-                              self._refine_search_factory)
+            with trace.span("build.rng_graph"):
+                self._graph.build(self._host[:self._n],
+                                  int(self.dist_calc_method), self.base,
+                                  self._refine_search_factory)
         finally:
             # free the mid-build device snapshot even when the build dies
             self._refine_dense_cache = None

@@ -32,7 +32,7 @@ import numpy as np
 from sptag_tpu.io import format as fmt
 from sptag_tpu.graph.tptree import tpt_partition
 from sptag_tpu.ops import graph as graph_ops
-from sptag_tpu.utils import shape_bucket
+from sptag_tpu.utils import shape_bucket, trace
 
 log = logging.getLogger(__name__)
 
@@ -85,7 +85,9 @@ class RelativeNeighborhoodGraph:
         graph (the index wires the beam engine in); when None, refine falls
         back to candidate-only pruning (no re-search).
         """
-        cand_ids, cand_d = self.build_candidates(data, metric, base, seed)
+        with trace.span("build.tpt_candidates"):
+            cand_ids, cand_d = self.build_candidates(data, metric, base,
+                                                     seed)
         m = self.neighborhood_size
         passes = max(self.refine_iterations, 1)
         for it in range(passes):
@@ -94,11 +96,13 @@ class RelativeNeighborhoodGraph:
                                        m * self.neighborhood_scale)
             if it == 0 or search_fn_factory is None:
                 # first pass prunes the TPT candidates directly
-                self.graph = self.prune_candidates(
-                    data, cand_ids, cand_d, width, metric, base)
+                with trace.span("build.rng_prune"):
+                    self.graph = self.prune_candidates(
+                        data, cand_ids, cand_d, width, metric, base)
             else:
-                self.refine_once(data, search_fn_factory(self.graph),
-                                 width, metric, base)
+                with trace.span("build.refine_pass"):
+                    self.refine_once(data, search_fn_factory(self.graph),
+                                     width, metric, base)
             log.info("RNG refine pass %d/%d width=%d", it + 1, passes, width)
         self.repair_connectivity()
 

@@ -14,10 +14,12 @@ RelativeNeighborhoodGraph.h:18-71):
 * ``rng_select`` — the RNG pruning rule (RelativeNeighborhoodGraph.h:18-35):
   scanning candidates in ascending distance order, a candidate is kept only if
   no already-kept neighbor is closer to it than the candidate is to the node.
-  The scan is inherently sequential in the kept-set, but only C (≈64) steps
-  long; it runs as a `lax.fori_loop` over candidate rank, vectorized over a
-  large batch of nodes at once — the (C, C) candidate-pair distances it
-  consults are one batched matmul.
+  Runs SLOT-major: a `lax.fori_loop` over the <= m kept slots (not the C
+  candidates) — each step takes every row's first unblocked candidate and
+  vector-marks everything it occludes, so the pair distances consulted are
+  exactly the kept x all ones the reference evaluates lazily
+  (B*m*C*D matmul FLOPs and min(m, C) sequential steps instead of
+  B*C*C*D and C).
 """
 
 from __future__ import annotations
@@ -146,28 +148,50 @@ def rng_select(node_vecs: jax.Array, cand_vecs: jax.Array,
     component).
     """
     del node_vecs  # distances to node come pre-computed in cand_dists
-    B, C, _ = cand_vecs.shape
-    pair = _batch_pairwise(cand_vecs, cand_vecs, metric, base)   # (B, C, C)
+    B, C, D = cand_vecs.shape
 
-    def body(j, carry):
-        keep_mask, count = carry
-        # occluded: some kept g with pair[g, j] <= cand_dists[:, j]
-        col = jax.lax.dynamic_slice_in_dim(pair, j, 1, axis=2)[..., 0]  # (B,C)
-        dj = jax.lax.dynamic_slice_in_dim(cand_dists, j, 1, axis=1)     # (B,1)
-        occluded = jnp.any(keep_mask & (col <= dj), axis=1)             # (B,)
-        vj = jax.lax.dynamic_slice_in_dim(cand_valid, j, 1, axis=1)[:, 0]
-        ok = (~occluded) & vj & (count < m)
-        keep_mask = jax.lax.dynamic_update_slice_in_dim(
-            keep_mask, ok[:, None], j, axis=1)
-        return keep_mask, count + ok.astype(jnp.int32)
+    # Slot-major reformulation of the sequential scan: instead of walking
+    # all C candidates (C loop steps, an upfront (B, C, C) pair tensor),
+    # iterate over the <= m KEPT slots — each step takes every row's FIRST
+    # not-yet-occluded candidate, then vector-marks everything that new
+    # neighbor occludes (pair(g, j) <= d_j) across the whole row at once.
+    # This is exactly the candidate-order greedy (the next kept candidate
+    # is always the first unoccluded one), i.e. the reference's lazy
+    # per-pair evaluation (RelativeNeighborhoodGraph.h:18-35) batched:
+    # min(m, C) sequential steps and B*m*C*D matmul FLOPs instead of C
+    # steps and B*C*C*D.
+    cf = cand_vecs.astype(jnp.float32)
+    if metric != 1:
+        cnorm = jnp.sum(cf * cf, axis=-1)                      # (B, C)
+    pos = jnp.arange(C, dtype=jnp.int32)[None, :]              # (1, C)
+
+    def slot(_, carry):
+        keep_mask, blocked = carry
+        # first candidate neither kept nor occluded nor invalid
+        avail = ~blocked
+        j = jnp.argmax(avail, axis=1)                          # (B,)
+        exists = jnp.take_along_axis(avail, j[:, None], axis=1)[:, 0]
+        keep_mask = keep_mask | (exists[:, None] & (pos == j[:, None]))
+        # distances from the chosen neighbor to every candidate of its row
+        gvec = jnp.take_along_axis(cf, j[:, None, None], axis=1)  # (B,1,D)
+        dot = jnp.einsum("bd,bcd->bc", gvec[:, 0], cf,
+                         preferred_element_type=jnp.float32)
+        if metric == 1:
+            gd = float(base) * float(base) - dot
+        else:
+            gn = jnp.take_along_axis(cnorm, j[:, None], axis=1)
+            gd = jnp.maximum(gn + cnorm - 2.0 * dot, 0.0)
+        occ = exists[:, None] & (gd <= cand_dists)
+        return keep_mask, blocked | occ | keep_mask
 
     keep_mask = jnp.zeros((B, C), bool)
-    count = jnp.zeros((B,), jnp.int32)
-    keep_mask, count = jax.lax.fori_loop(0, C, body, (keep_mask, count))
+    blocked = ~cand_valid
+    keep_mask, _ = jax.lax.fori_loop(0, min(m, C), slot,
+                                     (keep_mask, blocked))
 
     # order: RNG-kept candidates first (ascending), then fill with the
     # nearest non-kept valid candidates; invalid slots last
-    n_kept = count[:, None]                                       # (B, 1)
+    n_kept = jnp.sum(keep_mask, axis=1, dtype=jnp.int32)[:, None]  # (B, 1)
     rank_kept = jnp.cumsum(keep_mask.astype(jnp.int32), axis=1) - 1
     fill_mask = cand_valid & ~keep_mask
     rank_fill = jnp.cumsum(fill_mask.astype(jnp.int32), axis=1) - 1

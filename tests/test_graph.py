@@ -68,6 +68,40 @@ def test_rng_select_prunes_occluded():
     assert keep2[0].tolist() == [0, 2]
 
 
+def test_rng_select_matches_scalar_reference():
+    """The slot-major kernel must match a straightforward scalar
+    implementation of the RNG rule (RelativeNeighborhoodGraph.h:18-35 plus
+    this framework's fill-occluded-slots departure) on random inputs,
+    including invalid candidates and rows that exhaust before m keeps."""
+    rng = np.random.default_rng(11)
+    B, C, D, m = 17, 90, 8, 12
+    nodes = rng.standard_normal((B, D)).astype(np.float32)
+    cand = rng.standard_normal((B, C, D)).astype(np.float32)
+    d = ((cand - nodes[:, None, :]) ** 2).sum(-1).astype(np.float32)
+    order = np.argsort(d, axis=1)
+    cand = np.take_along_axis(cand, order[:, :, None], axis=1)
+    d = np.take_along_axis(d, order, axis=1)
+    valid = rng.random((B, C)) > 0.1
+
+    keep = np.asarray(graph_ops.rng_select(
+        jnp.asarray(nodes), jnp.asarray(cand), jnp.asarray(d),
+        jnp.asarray(valid), m, 0, 1))
+
+    for b in range(B):
+        kept = []
+        for j in range(C):
+            if not valid[b, j] or len(kept) >= m:
+                continue
+            occ = any(((cand[b, g] - cand[b, j]) ** 2).sum() <= d[b, j]
+                      for g in kept)
+            if not occ:
+                kept.append(j)
+        fill = [j for j in range(C)
+                if valid[b, j] and j not in kept][:m - len(kept)]
+        want = kept + fill + [-1] * (m - len(kept) - len(fill))
+        assert keep[b].tolist() == want, (b, keep[b].tolist(), want)
+
+
 def test_candidates_find_true_neighbors():
     data = _corpus(n=400)
     g = RelativeNeighborhoodGraph(neighborhood_size=8, tpt_number=6,
