@@ -267,6 +267,20 @@ def run_bench():
         platform, probe_err = probe_accelerator()
     result = {"metric": f"qps_per_chip_bkt_n{n}_d128_l2_recall@10",
               "value": 0.0, "unit": "qps", "vs_baseline": 0.0}
+
+    def checkpoint():
+        """Stage results survive a watchdog kill: each completed stage
+        atomically rewrites the partial file the parent falls back to (a
+        hung compile in a LATER stage must not erase earlier numbers)."""
+        try:
+            os.makedirs(CACHE_DIR, exist_ok=True)
+            tmp = os.path.join(CACHE_DIR, f".partial.{os.getpid()}")
+            with open(tmp, "w") as f:
+                json.dump(dict(result, partial=True,
+                               total_s=round(time.time() - _t_start, 1)), f)
+            os.replace(tmp, os.path.join(CACHE_DIR, "partial_result.json"))
+        except Exception:                                # noqa: BLE001
+            pass
     try:
         import jax
 
@@ -346,6 +360,8 @@ def run_bench():
                 index, "last_group_effective", None),
         })
 
+        checkpoint()
+
         # roofline accounting (SURVEY §7 hard part #2): per-query work of
         # the dense path = center scoring (2*C*D flops) + candidate scoring
         # (2*MaxCheck*D flops, MaxCheck*D*4 bytes of block reads).  Utils
@@ -410,6 +426,7 @@ def run_bench():
                 })
             except Exception as e:                       # noqa: BLE001
                 result["int8_error"] = repr(e)[:300]
+            checkpoint()
 
         # third metric: KDT cosine at d=100 (BASELINE.md config 2's
         # GloVe-100 shape) — kd-tree seeding + beam walk, float cosine
@@ -451,6 +468,10 @@ def run_bench():
         result["error"] = repr(e)[:300]
         result["traceback"] = traceback.format_exc()[-1000:]
     result["total_s"] = round(time.time() - _t_start, 1)
+    try:      # a finished run leaves no stale partial behind
+        os.remove(os.path.join(CACHE_DIR, "partial_result.json"))
+    except OSError:
+        pass
     print(json.dumps(result))
 
 
@@ -488,6 +509,10 @@ def main():
     script = os.path.abspath(__file__)
     env = dict(os.environ, BENCH_CHILD="1")
     cpu_reserve = 700.0            # parent keeps room for the CPU retry
+    try:      # a stale partial from an older crashed run must not win
+        os.remove(os.path.join(CACHE_DIR, "partial_result.json"))
+    except OSError:
+        pass
     # small budgets: the TPU child gets most of the budget and the CPU
     # retry squeezes into what remains (+120 s grace) rather than adding a
     # fixed 600 s on top of an already-spent budget
@@ -508,6 +533,17 @@ def main():
                "remote compile; killed")
     except Exception as e:                               # noqa: BLE001
         err = repr(e)[:300]
+    # a killed child may have checkpointed real accelerator numbers from
+    # its completed stages — prefer those over a CPU re-measurement
+    try:
+        with open(os.path.join(CACHE_DIR, "partial_result.json")) as f:
+            partial = json.load(f)
+        if partial.get("value", 0) > 0:
+            partial["child_error"] = err
+            print(json.dumps(partial))
+            return
+    except Exception:                                    # noqa: BLE001
+        pass
     env["BENCH_PLATFORM"] = "cpu"
     cpu_timeout = max(120.0, min(600.0,
                                  budget_s - (time.time() - t_parent) + 120))
@@ -525,6 +561,17 @@ def main():
         err += f" | cpu retry rc={p.returncode}"
     except Exception as e:                               # noqa: BLE001
         err += f" | cpu retry {repr(e)[:200]}"
+    # the CPU retry may itself have checkpointed a measured headline
+    # before being killed — recover it rather than printing zeros
+    try:
+        with open(os.path.join(CACHE_DIR, "partial_result.json")) as f:
+            partial = json.load(f)
+        if partial.get("value", 0) > 0:
+            partial["child_error"] = err
+            print(json.dumps(partial))
+            return
+    except Exception:                                    # noqa: BLE001
+        pass
     print(json.dumps(_fallback_result(err)))
 
 
