@@ -324,9 +324,12 @@ def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
         dot = dot.transpose(0, 2, 1, 3)                      # (NG, G, U, P)
     else:
         vecs = data_perm[union_safe]                         # (NG, U, P, D)
-        if jnp.issubdtype(queries.dtype, jnp.integer):
+        if (jnp.issubdtype(queries.dtype, jnp.integer)
+                and jnp.dtype(queries.dtype).itemsize < 2):
             # exact integer dot (reference int convention, DistanceUtils.h:
-            # 452): int32 accumulation, then float for the metric algebra
+            # 452): int32 accumulation, then float for the metric algebra.
+            # int16 falls through to the float32 branch — int32 overflows
+            # on raw int16 data (ops/distance.py pairwise_dot)
             dot = jnp.einsum(
                 "gqd,gupd->gqup", qs.reshape(NG, G, D).astype(jnp.int32),
                 vecs.astype(jnp.int32),

@@ -18,8 +18,11 @@ the reference exactly:
 * All accumulation is float32, as in the reference's SIMD paths (the `_mm_*`
   kernels convert lanes to float before the horizontal add).
 
-Integer inputs use an int32-accumulating MXU dot (`preferred_element_type`)
-for the dot-product term, which is exact; float inputs accumulate in float32.
+int8/uint8 inputs use an int32-accumulating MXU dot
+(`preferred_element_type`), which is exact; int16 and float inputs
+accumulate in float32 (int32 would overflow on raw int16 data — a single
+product reaches 2^30 — and float32 is the reference's own int16 SIMD
+convention).
 """
 
 from __future__ import annotations
@@ -59,11 +62,15 @@ def _is_int(dtype) -> bool:
 def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
     """(Q, D) x (N, D) -> (Q, N) dot products, float32.
 
-    Integer inputs contract with int32 accumulation (exact for all supported
-    value types), then cast; floats contract in float32 on the MXU.
+    int8/uint8 contract with int32 accumulation (exact, and the bound
+    D * 127^2 can never overflow).  int16 accumulates in float32 like the
+    reference's SIMD path (DistanceUtils.h int16 kernels convert lanes to
+    float before the horizontal add): an int32 accumulator overflows on
+    raw int16 L2 data (a single product reaches 2^30).  Floats contract
+    in float32 on the MXU.
     """
     dn = (((1,), (1,)), ((), ()))
-    if _is_int(q.dtype):
+    if _is_int(q.dtype) and jnp.dtype(q.dtype).itemsize < 2:
         out = jax.lax.dot_general(
             q.astype(jnp.int32), x.astype(jnp.int32), dn,
             preferred_element_type=jnp.int32)
@@ -137,9 +144,17 @@ def batched_gathered_distance(q: jax.Array, cand: jax.Array,
     whose norms are cached on the index."""
     metric = int(metric)
     if _is_int(q.dtype):
-        dot = jnp.einsum("qd,qcd->qc", q.astype(jnp.int32),
-                         cand.astype(jnp.int32),
-                         preferred_element_type=jnp.int32).astype(jnp.float32)
+        if jnp.dtype(q.dtype).itemsize >= 2:
+            # int16: float32 accumulation (see pairwise_dot — int32
+            # overflows on raw int16 data; f32 is the reference convention)
+            dot = jnp.einsum("qd,qcd->qc", q.astype(jnp.float32),
+                             cand.astype(jnp.float32),
+                             precision=_FLOAT_PRECISION,
+                             preferred_element_type=jnp.float32)
+        else:
+            dot = jnp.einsum(
+                "qd,qcd->qc", q.astype(jnp.int32), cand.astype(jnp.int32),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
         if metric == int(DistCalcMethod.Cosine):
             return float(base) * float(base) - dot
         qf = q.astype(jnp.float32)

@@ -110,3 +110,30 @@ def test_batch_topk_sorted_ascending():
         np.testing.assert_allclose(dists[r], order, rtol=1e-6)
         assert np.all(np.diff(dists[r]) >= 0)
         np.testing.assert_allclose(dmat[r][idx[r]], dists[r], rtol=1e-6)
+
+
+def test_int16_full_range_no_overflow():
+    """Raw full-range int16 L2 must not overflow: a single int16 product
+    reaches 2^30, so int32 accumulation wraps (observed: -6.9e8 instead of
+    3.6e9); the kernel accumulates int16 in float32 like the reference's
+    SIMD path (lanes converted to float before the horizontal add)."""
+    rng = np.random.default_rng(6)
+    q = rng.integers(-32000, 32001, (4, 32)).astype(np.int16)
+    x = rng.integers(-32000, 32001, (8, 32)).astype(np.int16)
+    got = np.asarray(D.pairwise_dot(jnp.asarray(q), jnp.asarray(x)))
+    want = q.astype(np.float64) @ x.T.astype(np.float64)
+    # float32 accumulation of ~1e9-magnitude terms: wrapping would be off
+    # by ~4e9, rounding by ~1e3 — the tolerance separates the two cleanly
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e4)
+
+    dl2 = np.asarray(D.pairwise_l2(jnp.asarray(q), jnp.asarray(x)))
+    wl2 = ((q.astype(np.float64)[:, None, :]
+            - x.astype(np.float64)[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(dl2, wl2, rtol=1e-5)
+
+    gb = np.asarray(D.batched_gathered_distance(
+        jnp.asarray(q), jnp.asarray(np.broadcast_to(x[None, :4], (4, 4, 32))),
+        0, 1))
+    wb = ((q.astype(np.float64)[:, None, :]
+           - x.astype(np.float64)[None, :4, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(gb, wb, rtol=1e-5)
