@@ -285,6 +285,7 @@ class BKTIndex(VectorIndex):
         def search(queries: np.ndarray, k: int):
             return engine.search(
                 queries, k, max_check=budget,
+                beam_width=getattr(p, "beam_width", 16),
                 pool_size=max(2 * k, 64),
                 nbp_limit=p.no_better_propagation_limit)
         return search
@@ -304,6 +305,7 @@ class BKTIndex(VectorIndex):
         else:
             d, ids = self._get_engine().search(
                 queries, min(k, self._n), max_check=p.max_check,
+                beam_width=getattr(p, "beam_width", 16),
                 nbp_limit=p.no_better_propagation_limit,
                 dynamic_pivots=p.other_dynamic_pivots)
         if ids.shape[1] < k:

@@ -324,8 +324,7 @@ def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
         dot = dot.transpose(0, 2, 1, 3)                      # (NG, G, U, P)
     else:
         vecs = data_perm[union_safe]                         # (NG, U, P, D)
-        if (jnp.issubdtype(queries.dtype, jnp.integer)
-                and jnp.dtype(queries.dtype).itemsize < 2):
+        if dist_ops.exact_int_dot(queries.dtype):
             # exact integer dot (reference int convention, DistanceUtils.h:
             # 452): int32 accumulation, then float for the metric algebra.
             # int16 falls through to the float32 branch — int32 overflows

@@ -85,6 +85,7 @@ class KDTIndex(BKTIndex):
         seeds = self._seeds_for(queries)
         d, ids = self._get_engine().search(
             queries, min(k, self._n), max_check=p.max_check,
+            beam_width=getattr(p, "beam_width", 16),
             nbp_limit=p.no_better_propagation_limit, seeds=seeds)
         if ids.shape[1] < k:
             q = ids.shape[0]

@@ -106,6 +106,11 @@ _COMMON_TAIL_SPECS = [
           "ThresholdOfNumberOfContinuousNoBetterPropagation"),
     _spec("initial_dynamic_pivots", int, 50, "NumberOfInitialDynamicPivots"),
     _spec("other_dynamic_pivots", int, 4, "NumberOfOtherDynamicPivots"),
+    # TPU-only: frontier entries expanded per beam-walk iteration (the
+    # reference pops one node per loop step; the batched walk pops B at
+    # once and runs ceil(MaxCheck/B) iterations).  Larger B = fewer,
+    # fatter device steps (throughput) but coarser budget granularity
+    _spec("beam_width", int, 16, "BeamWidth"),
 ]
 
 _FILE_SPECS = [

@@ -59,6 +59,14 @@ def _is_int(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.integer)
 
 
+def exact_int_dot(dtype) -> bool:
+    """True for integer dtypes whose dot products accumulate exactly in
+    int32 (int8/uint8: the bound D*255^2 cannot overflow).  int16 products
+    reach 2^30 and must accumulate in float32 instead — the reference's
+    own int16 SIMD convention."""
+    return _is_int(dtype) and jnp.dtype(dtype).itemsize < 2
+
+
 def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
     """(Q, D) x (N, D) -> (Q, N) dot products, float32.
 
@@ -70,7 +78,7 @@ def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
     in float32 on the MXU.
     """
     dn = (((1,), (1,)), ((), ()))
-    if _is_int(q.dtype) and jnp.dtype(q.dtype).itemsize < 2:
+    if exact_int_dot(q.dtype):
         out = jax.lax.dot_general(
             q.astype(jnp.int32), x.astype(jnp.int32), dn,
             preferred_element_type=jnp.int32)
@@ -144,7 +152,7 @@ def batched_gathered_distance(q: jax.Array, cand: jax.Array,
     whose norms are cached on the index."""
     metric = int(metric)
     if _is_int(q.dtype):
-        if jnp.dtype(q.dtype).itemsize >= 2:
+        if not exact_int_dot(q.dtype):
             # int16: float32 accumulation (see pairwise_dot — int32
             # overflows on raw int16 data; f32 is the reference convention)
             dot = jnp.einsum("qd,qcd->qc", q.astype(jnp.float32),

@@ -286,6 +286,7 @@ class ShardedBKTIndex:
         self.n_local = 0
         self.max_check = 2048
         self.nbp_limit = 3
+        self.beam_width = 16
 
     @classmethod
     def build(cls, data: np.ndarray,
@@ -354,6 +355,7 @@ class ShardedBKTIndex:
         self.max_check = int(getattr(self.params, "max_check", 2048))
         self.nbp_limit = int(getattr(
             self.params, "no_better_propagation_limit", 3))
+        self.beam_width = int(getattr(self.params, "beam_width", 16))
         self._place(np.concatenate(blocks_data),
                     np.concatenate(blocks_graph),
                     np.concatenate(blocks_del),
@@ -379,17 +381,20 @@ class ShardedBKTIndex:
 
     def search(self, queries: np.ndarray, k: int = 10,
                max_check: Optional[int] = None,
-               beam_width: int = 16,
+               beam_width: Optional[int] = None,
                pool_size: Optional[int] = None,
                normalized: bool = False) -> Tuple[np.ndarray, np.ndarray]:
         """Batched mesh search; same knob semantics as
-        GraphSearchEngine.search, applied per shard."""
+        GraphSearchEngine.search, applied per shard.  `max_check` and
+        `beam_width` default to the build params (MaxCheck / BeamWidth)."""
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
         if self.metric == DistCalcMethod.Cosine and not normalized:
             queries = dist_ops.normalize(queries, self.base)
         max_check = max_check if max_check is not None else self.max_check
+        beam_width = (beam_width if beam_width is not None
+                      else self.beam_width)
         n_dev = self.mesh.devices.size
         k_local = min(k, self.n_local)     # per-shard beam cap
         k_final = min(k, self.n, k_local * n_dev)   # global merge cap

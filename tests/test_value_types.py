@@ -5,8 +5,9 @@ X-macros (/root/reference/AnnService/src/Core/BKT/BKTIndex.cpp:577-581);
 kernel-level conventions are pinned by tests/test_distance.py and the
 Float lifecycle by tests/test_bkt.py, but nothing exercised the integer
 types through the full index lifecycle.  Recall is asserted against ground
-truth computed under the INDEX's own convention (exact integer dot; cosine
-is base^2 - dot on ingest-normalized rows, DistanceUtils.h:452,492,533).
+truth computed under the INDEX's own convention (exact int32 dot for
+int8/uint8, float32 accumulation for int16; cosine is base^2 - dot on
+ingest-normalized rows, DistanceUtils.h:452,492,533).
 """
 
 import numpy as np
