@@ -362,6 +362,57 @@ def test_aggregator_scatter_gather_and_partial_timeout():
         tb.stop()
 
 
+def test_aggregator_pipelines_concurrent_clients():
+    """Concurrent clients through the aggregator must each get THEIR OWN
+    result (resource-id matched per backend connection, reference
+    ResourceManager semantics) — a regression test for the per-server
+    round-trip lock that serialized requests and for response mismatch
+    under interleaving."""
+    ctx, data = _make_context(n=200)
+    srv = SearchServer(ctx, batch_window_ms=1.0)
+    ts = _ServerThread(srv)
+    ts.start()
+    hs, ps = ts.wait_ready()
+
+    agg_ctx = AggregatorContext(search_timeout_s=10.0)
+    agg_ctx.servers = [RemoteServer(hs, ps)]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+
+    errors = []
+
+    def worker(qid: int):
+        try:
+            c = AnnClient(hg, pg, timeout_s=10.0)
+            c.connect()
+            qtext = "|".join(str(x) for x in data[qid])
+            for _ in range(5):
+                res = c.search(qtext)
+                assert res.status == wire.ResultStatus.Success, res.status
+                assert res.results[0].ids[0] == qid, (
+                    qid, res.results[0].ids)
+            c.close()
+        except Exception as e:                       # noqa: BLE001
+            errors.append((qid, repr(e)))
+
+    try:
+        threads = [threading.Thread(target=worker, args=(q,))
+                   for q in (3, 17, 42, 99, 123, 150)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        # a deadlocked round trip leaves a worker alive with no error
+        # recorded — the silent variant of the regression this test guards
+        assert not any(t.is_alive() for t in threads), "worker hang"
+        assert not errors, errors
+    finally:
+        tg.stop()
+        ts.stop()
+
+
 def test_server_over_sharded_mesh_index():
     """The full deployment picture: an external wire-protocol client hits a
     SearchServer whose registered index is the mesh-sharded BKT (ICI
