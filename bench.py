@@ -294,15 +294,9 @@ def run_bench():
             platform = "cpu"
             result["tpu_init_error"] = probe_err
             # last LIVE-TPU measurement, maintained alongside
-            # reports/TPU_PERF.md (reading the snapshot file instead of a
-            # source literal keeps the fallback from drifting stale)
-            try:
-                with open(os.path.join(REPO, "reports",
-                                       "tpu_last.json")) as f:
-                    result["last_measured_tpu"] = json.load(f)
-            except Exception:                            # noqa: BLE001
-                result["last_measured_tpu"] = {
-                    "source": "reports/TPU_PERF.md (snapshot missing)"}
+            # reports/TPU_PERF.md (a snapshot file rather than a source
+            # literal keeps the fallback from drifting stale)
+            _attach_last_tpu(result)
         result["platform"] = platform
 
         # persistent XLA compile cache: repeat bench invocations skip the
@@ -482,15 +476,21 @@ def _last_json_line(text):
     return None
 
 
+def _attach_last_tpu(obj):
+    """Attach the last live-TPU snapshot (reports/tpu_last.json) to a
+    result that is NOT itself a fresh chip measurement."""
+    try:
+        with open(os.path.join(REPO, "reports", "tpu_last.json")) as f:
+            obj.setdefault("last_measured_tpu", json.load(f))
+    except Exception:                                    # noqa: BLE001
+        pass
+
+
 def _fallback_result(err):
     result = {"metric": "qps_per_chip_bkt_n200000_d128_l2_recall@10",
               "value": 0.0, "unit": "qps", "vs_baseline": 0.0,
               "error": err}
-    try:
-        with open(os.path.join(REPO, "reports", "tpu_last.json")) as f:
-            result["last_measured_tpu"] = json.load(f)
-    except Exception:                                    # noqa: BLE001
-        pass
+    _attach_last_tpu(result)
     return result
 
 
@@ -535,15 +535,8 @@ def main():
         err = repr(e)[:300]
     # a killed child may have checkpointed real accelerator numbers from
     # its completed stages — prefer those over a CPU re-measurement
-    try:
-        with open(os.path.join(CACHE_DIR, "partial_result.json")) as f:
-            partial = json.load(f)
-        if partial.get("value", 0) > 0:
-            partial["child_error"] = err
-            print(json.dumps(partial))
-            return
-    except Exception:                                    # noqa: BLE001
-        pass
+    if _emit_partial(err):
+        return
     env["BENCH_PLATFORM"] = "cpu"
     cpu_timeout = max(120.0, min(600.0,
                                  budget_s - (time.time() - t_parent) + 120))
@@ -563,16 +556,29 @@ def main():
         err += f" | cpu retry {repr(e)[:200]}"
     # the CPU retry may itself have checkpointed a measured headline
     # before being killed — recover it rather than printing zeros
+    if _emit_partial(err):
+        return
+    print(json.dumps(_fallback_result(err)))
+
+
+def _emit_partial(err):
+    """Print the checkpointed partial result (with the last-TPU snapshot
+    attached for the stages it is missing) if one with a real headline
+    exists; returns True when emitted."""
     try:
         with open(os.path.join(CACHE_DIR, "partial_result.json")) as f:
             partial = json.load(f)
         if partial.get("value", 0) > 0:
             partial["child_error"] = err
+            # a fresh chip partial IS the chip evidence — the prior-run
+            # snapshot is only context for non-TPU partials
+            if partial.get("platform") != "tpu":
+                _attach_last_tpu(partial)
             print(json.dumps(partial))
-            return
+            return True
     except Exception:                                    # noqa: BLE001
         pass
-    print(json.dumps(_fallback_result(err)))
+    return False
 
 
 if __name__ == "__main__":
