@@ -478,12 +478,14 @@ def _last_json_line(text):
 
 def _attach_last_tpu(obj):
     """Attach the last live-TPU snapshot (reports/tpu_last.json) to a
-    result that is NOT itself a fresh chip measurement."""
+    result that is NOT itself a fresh chip measurement; a missing/corrupt
+    snapshot still leaves a pointer to the prior chip evidence."""
     try:
         with open(os.path.join(REPO, "reports", "tpu_last.json")) as f:
             obj.setdefault("last_measured_tpu", json.load(f))
     except Exception:                                    # noqa: BLE001
-        pass
+        obj.setdefault("last_measured_tpu", {
+            "source": "reports/TPU_PERF.md (snapshot missing)"})
 
 
 def _fallback_result(err):

@@ -1,0 +1,106 @@
+"""Runnable quickstart — the notebook-free equivalent of the reference's
+docs/examples/QuickstartGuide.ipynb flow: build an index with metadata,
+search it, mutate it online, persist it, and query it over the wire.
+
+    python docs/examples/quickstart.py          # from the repo root
+
+Uses a small synthetic corpus so it finishes in ~a minute on any backend.
+"""
+import os
+import sys
+import threading
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import sptag_tpu as sp  # noqa: E402
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, d = 20_000, 64
+    centers = rng.standard_normal((32, d)).astype(np.float32) * 4
+    data = (centers[rng.integers(0, 32, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+
+    # ---- build with metadata -------------------------------------------
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    for name, value in [("TPTNumber", "4"), ("CEF", "64"),
+                        ("MaxCheckForRefineGraph", "256"),
+                        ("RefineIterations", "1"), ("MaxCheck", "1024")]:
+        index.set_parameter(name, value)
+    metas = sp.MetadataSet(f"doc{i}".encode() for i in range(n))
+    index.build(data, metas, with_meta_index=True)
+    print(f"built BKT index over {n} vectors")
+
+    # ---- search ---------------------------------------------------------
+    res = index.search(data[42], k=5, with_metadata=True)
+    print("top-5 for row 42:", res.ids[:5], "metas:", res.metas[:2])
+    assert res.ids[0] == 42
+
+    dists, ids = index.search_batch(data[:256], k=10)
+    self_hits = float(np.mean(ids[:, 0] == np.arange(256)))
+    print(f"batch of 256 queries: self-hit rate {self_hits:.3f}")
+
+    # ---- online mutation ------------------------------------------------
+    new_rows = data[:4] + 0.01
+    index.add(new_rows, sp.MetadataSet(
+        f"new{i}".encode() for i in range(4)))
+    index.delete_by_metadata(b"doc7")
+    res = index.search(data[7], k=3)
+    assert 7 not in list(res.ids), "tombstoned row must not come back"
+    print("online add + delete-by-metadata OK")
+
+    # ---- persistence ----------------------------------------------------
+    folder = "/tmp/quickstart_index"
+    index.save_index(folder)
+    index2 = sp.load_index(folder)
+    res2 = index2.search(data[42], k=1)
+    assert res2.ids[0] == 42
+    print(f"saved to {folder} and reloaded; results match")
+
+    # ---- serve over the wire -------------------------------------------
+    import asyncio
+
+    from sptag_tpu.serve.client import AnnClient
+    from sptag_tpu.serve.server import SearchServer
+    from sptag_tpu.serve.service import ServiceContext, ServiceSettings
+
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("quickstart", index2)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    addr = {}
+    ready = threading.Event()
+    loop = asyncio.new_event_loop()
+
+    def serve():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            addr["hp"] = await server.start("127.0.0.1", 0)
+            ready.set()
+        loop.create_task(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    ready.wait(10)
+    host, port = addr["hp"]
+
+    client = AnnClient(host, port)
+    client.connect()
+    qtext = "$extractmetadata:true " + "|".join(str(x) for x in data[42])
+    reply = client.search(qtext)
+    print("wire search:", reply.results[0].ids[:3],
+          reply.results[0].metas[0])
+    client.close()
+    asyncio.run_coroutine_threadsafe(server.stop(), loop).result(5)
+    loop.call_soon_threadsafe(loop.stop)
+    print("quickstart complete")
+
+
+if __name__ == "__main__":
+    main()
