@@ -23,7 +23,7 @@ def main():
     from sptag_tpu.utils import enable_compile_cache, trace
 
     enable_compile_cache()
-    n, d, nq = 500_000, 128, 1024
+    n, d, nq = int(os.environ.get("SCALE_N", "500000")), 128, 1024
     rng = np.random.default_rng(17)
     centers = rng.standard_normal((512, d)).astype(np.float32) * 4.0
     data = (centers[rng.integers(0, 512, n)]
