@@ -183,8 +183,7 @@ class BKTIndex(VectorIndex):
         if replicas is None:
             replicas = getattr(self.params, "dense_replicas", 1)
         data = self._host[:self._n]
-        centers, clusters = partition_from_tree(
-            self._tree, self._n, self.params.dense_cluster_size)
+        centers, clusters = self._partition_tree()
         covered = np.zeros(self._n, bool)
         for c in clusters:
             covered[c] = True
@@ -207,6 +206,12 @@ class BKTIndex(VectorIndex):
             data, centers, clusters, self._deleted[:self._n],
             self.dist_calc_method, self.base,
             replicas=replicas)
+
+    def _partition_tree(self):
+        """Cut the current tree into a corpus partition for the dense
+        layout; subclasses override per tree type (KDT cuts kd cells)."""
+        return partition_from_tree(self._tree, self._n,
+                                   self.params.dense_cluster_size)
 
     def _get_dense(self) -> DenseTreeSearcher:
         """Lazy dense snapshot for the dense search mode."""
@@ -254,10 +259,10 @@ class BKTIndex(VectorIndex):
         ~20x the rest of the build combined off-TPU)."""
         p = self.params
         budget = p.max_check_for_refine_graph
-        # dense refine needs the BKT tree partition + its params; KDT (which
-        # shares this class) keeps the beam refine
+        # dense refine cuts the current tree into a partition via
+        # _partition_tree — KDT shares this path through its kd-cell cut
         if getattr(p, "refine_search_mode", "beam") == "dense" and \
-                isinstance(self._tree, BKTree):
+                self._tree is not None:
             # the dense searcher depends on the TREE, not the graph snapshot
             # this factory receives — cache it across the refine passes of
             # one build (each pass re-invokes the factory)
@@ -303,11 +308,24 @@ class BKTIndex(VectorIndex):
                 group=getattr(p, "dense_query_group", 0),
                 union_factor=getattr(p, "dense_union_factor", 2))
         else:
-            d, ids = self._get_engine().search(
-                queries, min(k, self._n), max_check=p.max_check,
-                beam_width=getattr(p, "beam_width", 16),
-                nbp_limit=p.no_better_propagation_limit,
-                dynamic_pivots=p.other_dynamic_pivots)
+            d, ids = self._engine_search(queries, min(k, self._n))
+        return self._pad_results(d, ids, k)
+
+    def _engine_search(self, queries: np.ndarray, k: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Beam-walk branch of _search_batch; KDT overrides to seed from
+        its kd-tree descent instead of the shared pivots."""
+        p = self.params
+        return self._get_engine().search(
+            queries, k, max_check=p.max_check,
+            beam_width=getattr(p, "beam_width", 16),
+            nbp_limit=p.no_better_propagation_limit,
+            dynamic_pivots=p.other_dynamic_pivots)
+
+    @staticmethod
+    def _pad_results(d: np.ndarray, ids: np.ndarray, k: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad result columns out to k with MAX_DIST / -1 sentinels."""
         if ids.shape[1] < k:
             q = ids.shape[0]
             d = np.concatenate(

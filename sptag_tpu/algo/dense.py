@@ -124,16 +124,23 @@ def partition_from_tree(tree, n: int, target_size: int
         smallest = min(range(len(clusters)), key=lambda i: len(clusters[i]))
         clusters[smallest] = np.append(clusters[smallest], s)
 
-    # ---- pack small subtrees into near-full blocks ------------------------
-    # A k-means tree cut yields MANY subtrees far below target_size (k=32
-    # fan-out: one level is ~N/32, the next ~N/1024), and the searcher pads
-    # every cluster to the max size: measured on a 200k corpus, 8371 raw
-    # clusters averaged 24 rows padded to 256 — 90% of every probe's score
-    # budget was padding, which both wastes HBM and guts recall at a given
-    # MaxCheck.  Greedily merging BFS-adjacent clusters (tree siblings ==
-    # spatially close by construction) into blocks of <= target_size makes
-    # blocks ~full, so a probe scores ~target_size REAL candidates.  The
-    # merged block keeps the center of its largest constituent.
+    return _pack_clusters(clusters, centers, target_size)
+
+
+def _pack_clusters(clusters: List[np.ndarray], centers: List[int],
+                   target_size: int
+                   ) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Greedily merge adjacent small clusters into near-full blocks.
+
+    A tree cut yields MANY subtrees far below target_size (k=32 fan-out:
+    one level is ~N/32, the next ~N/1024), and the searcher pads every
+    cluster to the max size: measured on a 200k corpus, 8371 raw clusters
+    averaged 24 rows padded to 256 — 90% of every probe's score budget was
+    padding, which both wastes HBM and guts recall at a given MaxCheck.
+    Merging BFS-adjacent clusters (tree siblings == spatially close by
+    construction) makes blocks ~full, so a probe scores ~target_size REAL
+    candidates.  The merged block keeps the center of its largest
+    constituent."""
     packed_c: List[np.ndarray] = []
     packed_id: List[int] = []
     cur: List[np.ndarray] = []
@@ -152,6 +159,87 @@ def partition_from_tree(tree, n: int, target_size: int
         packed_c.append(np.concatenate(cur))
         packed_id.append(cur_center)
     return np.asarray(packed_id, np.int64), packed_c
+
+
+def partition_from_kdtree(tree, n: int, target_size: int
+                          ) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Cut the first kd-tree into subtrees of <= target_size samples.
+
+    The kd-tree analog of `partition_from_tree`: kd nodes
+    (`trees/kdtree.py`) store left/right child node indices with negative
+    ``-id-1`` encodings for single-sample leaves, and children are always
+    appended after their parent, so a reverse scan yields subtree sizes
+    and a BFS emits the cut.  A kd cell is an axis-aligned box — spatially
+    coherent, so block means rank blocks well (same principle as the
+    reference's own kd-cells-bound search, KDTree.h:178-215).  Returns
+    (center sample ids (C,), list of C member arrays covering [0, n)
+    exactly once).
+    """
+    nodes = tree.nodes
+    left = nodes["left"].astype(np.int64)
+    right = nodes["right"].astype(np.int64)
+    start = int(tree.tree_starts[0])
+    end = int(tree.tree_starts[1]) if len(tree.tree_starts) > 1 \
+        else len(nodes)
+
+    def kids(ni: int):
+        return (int(left[ni]), int(right[ni]))
+
+    # bottom-up subtree sample counts (children appended after parents)
+    counts = np.zeros(end - start, np.int64)
+    for ni in range(end - 1, start - 1, -1):
+        c = 0
+        for ch in kids(ni):
+            c += 1 if ch < 0 else int(counts[ch - start])
+        counts[ni - start] = c
+
+    def collect(ni: int) -> List[int]:
+        out: List[int] = []
+        stack = [ni]
+        while stack:
+            cur = stack.pop()
+            for ch in kids(cur):
+                if ch < 0:
+                    sid = -ch - 1
+                    if 0 <= sid < n:
+                        out.append(sid)
+                else:
+                    stack.append(ch)
+        return out
+
+    clusters: List[np.ndarray] = []
+    centers: List[int] = []
+    loose: List[int] = []
+    frontier = [start]
+    while frontier:
+        nxt: List[int] = []
+        for ni in frontier:
+            if counts[ni - start] == 0:
+                continue
+            if counts[ni - start] <= target_size:
+                members = collect(ni)
+                if members:
+                    # degenerate duplicate leaves (one-row corpus) collapse
+                    members = sorted(set(members))
+                    clusters.append(np.asarray(members, np.int64))
+                    centers.append(members[0])
+            else:
+                for ch in kids(ni):
+                    if ch < 0:
+                        sid = -ch - 1
+                        if 0 <= sid < n:
+                            loose.append(sid)
+                    else:
+                        nxt.append(ch)
+        frontier = nxt
+    if loose and not clusters:
+        clusters.append(np.asarray(sorted(set(loose)), np.int64))
+        centers.append(clusters[0][0])
+        loose = []
+    for s in loose:
+        smallest = min(range(len(clusters)), key=lambda i: len(clusters[i]))
+        clusters[smallest] = np.append(clusters[smallest], s)
+    return _pack_clusters(clusters, centers, target_size)
 
 
 def _finalize_topk(nd, ids, deleted, dedup: bool, k: int, extra_dead=None):

@@ -26,7 +26,7 @@ from typing import Tuple
 import numpy as np
 
 from sptag_tpu.algo.bkt import BKTIndex
-from sptag_tpu.core.index import MAX_DIST, register_algo
+from sptag_tpu.core.index import register_algo
 from sptag_tpu.core.params import KDTParams
 from sptag_tpu.core.types import IndexAlgoType
 from sptag_tpu.trees.kdtree import KDTree
@@ -77,23 +77,23 @@ class KDTIndex(BKTIndex):
         backtrack = self._backtrack_for(self.params.max_check)
         return self._tree.collect_seeds(queries, backtrack=backtrack)
 
-    def _search_batch(self, queries: np.ndarray,
-                      k: int) -> Tuple[np.ndarray, np.ndarray]:
-        if self._n == 0:
-            raise RuntimeError("index is empty")
+    def _partition_tree(self):
+        # SearchMode=dense runs the shared MXU block scan over a kd-cell
+        # partition (the default stays the reference-semantics kd-seeded
+        # walk via _engine_search below)
+        from sptag_tpu.algo.dense import partition_from_kdtree
+
+        return partition_from_kdtree(self._tree, self._n,
+                                     self.params.dense_cluster_size)
+
+    def _engine_search(self, queries: np.ndarray, k: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
         p = self.params
         seeds = self._seeds_for(queries)
-        d, ids = self._get_engine().search(
-            queries, min(k, self._n), max_check=p.max_check,
+        return self._get_engine().search(
+            queries, k, max_check=p.max_check,
             beam_width=getattr(p, "beam_width", 16),
             nbp_limit=p.no_better_propagation_limit, seeds=seeds)
-        if ids.shape[1] < k:
-            q = ids.shape[0]
-            d = np.concatenate(
-                [d, np.full((q, k - d.shape[1]), MAX_DIST, np.float32)], 1)
-            ids = np.concatenate(
-                [ids, np.full((q, k - ids.shape[1]), -1, np.int32)], 1)
-        return d, ids
 
     def _load_tree(self, path: str) -> KDTree:
         p = self.params

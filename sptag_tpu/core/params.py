@@ -185,6 +185,20 @@ class KDTParams(ParamSet):
             _spec("tree_number", int, 1, "KDTNumber"),
             _spec("kdt_top_dims", int, 5, "NumTopDimensionKDTSplit"),
             _spec("samples", int, 100, "Samples"),
+            # TPU-only dense-mode knobs (same semantics as the BKT specs
+            # above; the partition comes from a kd-tree cut —
+            # algo/dense.py::partition_from_kdtree).  SearchMode defaults
+            # to "beam" for KDT: the kd-seeded walk IS the reference's
+            # KDT search; the MXU dense scan is the opt-in fast path
+            _spec("search_mode", str, "beam", "SearchMode"),
+            _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
+            _spec("dense_replicas", int, 1, "DenseReplicas"),
+            _spec("dense_query_group", int, 0, "DenseQueryGroup"),
+            _spec("dense_union_factor", int, 2, "DenseUnionFactor"),
+            # builds refine ~15x faster through the dense engine at equal
+            # quality (reports/MAXCHECK_SWEEP.md); "beam" restores the
+            # reference's RefineGraph-by-walk semantics
+            _spec("refine_search_mode", str, "dense", "RefineSearchMode"),
         ]
         + _GRAPH_SPECS[:2]
         + [_spec("tpt_top_dims", int, 5, "NumTopDimensionTPTSplit")]

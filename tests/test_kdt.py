@@ -116,3 +116,51 @@ def test_kdt_lifecycle_save_load_add_delete(tmp_path):
 
     assert loaded.delete(data[:3]) == sp.ErrorCode.Success
     assert loaded.num_deleted >= 2
+
+
+def test_kdt_partition_covers_every_id_once():
+    from sptag_tpu.algo.dense import partition_from_kdtree
+
+    data, _ = _corpus(n=900)
+    tree = KDTree(tree_number=2, top_dims=5, samples=100)
+    tree.build(data)
+    centers, clusters = partition_from_kdtree(tree, len(data), 64)
+    all_ids = np.concatenate(clusters)
+    assert sorted(all_ids.tolist()) == list(range(len(data)))
+    assert len(centers) == len(clusters)
+    assert max(len(c) for c in clusters) <= 64
+    for ci, c in enumerate(clusters):
+        assert centers[ci] in c
+
+
+def test_kdt_dense_mode_recall():
+    """Opt-in SearchMode=dense runs the MXU block scan over the kd-cell
+    partition; recall must track the beam mode's on a clustered corpus."""
+    index, data, queries = _make_index()
+    k = 10
+    oracle = sp.create_instance("FLAT", "Float")
+    oracle.set_parameter("DistCalcMethod", "L2")
+    oracle.build(data)
+    _, i_true = oracle.search_batch(queries, k)
+
+    index.set_parameter("SearchMode", "dense")
+    index.set_parameter("MaxCheck", "512")
+    # small blocks so the union is wide enough that the adaptive clamps
+    # keep the GROUPED kernel active below (G >= the f32 tile floor)
+    index.set_parameter("DenseClusterSize", "64")
+    _, i_dense = index.search_batch(queries, k)
+    recall = np.mean([len(set(i_dense[q].tolist()) & set(i_true[q].tolist()))
+                      / k for q in range(len(queries))])
+    assert recall >= 0.9, recall
+    # grouped probing composes with the kd partition too
+    index.set_parameter("DenseQueryGroup", "8")
+    index.set_parameter("DenseUnionFactor", "4")
+    _, i_g = index.search_batch(queries, k)
+    assert index._get_dense().last_effective_group > 1   # really grouped
+    recall_g = np.mean([len(set(i_g[q].tolist()) & set(i_true[q].tolist()))
+                        / k for q in range(len(queries))])
+    assert recall_g >= 0.9, recall_g
+    # back to the default reference-semantics walk
+    index.set_parameter("SearchMode", "beam")
+    _, i_beam = index.search_batch(queries[:8], k)
+    assert i_beam.shape == (8, k)
