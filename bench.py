@@ -450,6 +450,23 @@ def run_bench():
                     "kdt_n": nk,
                     "kdt_build_s": round(buildk_s, 1),
                 })
+                checkpoint()
+                # the opt-in KDT dense mode (MXU scan over the kd-cell
+                # partition) on the same loaded index — kept LAST: its
+                # kernel shapes are the likeliest cold compiles.  Its own
+                # error key keeps a dense-only failure from reading as a
+                # failure of the beam metrics already recorded above
+                try:
+                    idxk.set_parameter("SearchMode", "dense")
+                    idskd, qpskd, _ = timed_sweep(idxk, queriesk, k, batch,
+                                                  budget_s, repeats=1)
+                    result.update({
+                        "kdt_dense_qps": round(qpskd, 1),
+                        "kdt_dense_recall_at_10": round(
+                            recall_at_k(idskd, truthk, k), 4),
+                    })
+                except Exception as e:                   # noqa: BLE001
+                    result["kdt_dense_error"] = repr(e)[:300]
             except Exception as e:                       # noqa: BLE001
                 result["kdt_error"] = repr(e)[:300]
 
