@@ -40,6 +40,28 @@ def make_mesh(devices=None, axis_name: str = SHARD_AXIS) -> Mesh:
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def _pad_to_k(d: np.ndarray, ids: np.ndarray, k: int, k_final: int):
+    """Host-side sentinel padding of merged results out to k columns."""
+    if k_final < k:
+        q = d.shape[0]
+        d = np.concatenate(
+            [d, np.full((q, k - k_final), MAX_DIST, np.float32)], 1)
+        ids = np.concatenate(
+            [ids, np.full((q, k - k_final), -1, np.int32)], 1)
+    return d, ids
+
+
+def _gather_merge(d, gids, k_final: int):
+    """In-kernel global merge: ICI all-gather of every shard's (dist,
+    global-id) top-k, then one re-ranking top_k; sentinel rows -> -1."""
+    all_d = jax.lax.all_gather(d, SHARD_AXIS, axis=1, tiled=True)
+    all_i = jax.lax.all_gather(gids, SHARD_AXIS, axis=1, tiled=True)
+    gneg, gpos = jax.lax.top_k(-all_d, k_final)
+    gd = -gneg
+    gi = jnp.take_along_axis(all_i, gpos, axis=1)
+    return gd, jnp.where(gd >= jnp.float32(MAX_DIST), -1, gi)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("k_local", "k_final", "metric", "base",
                                     "mesh"))
@@ -132,14 +154,7 @@ class ShardedFlatIndex:
         dists, ids = _sharded_search_kernel(
             self.data, self.sqnorm, self.invalid, jnp.asarray(queries),
             k_local, k_final, int(self.metric), self.base, self.mesh)
-        dists, ids = np.asarray(dists), np.asarray(ids)
-        if k_final < k:
-            q = dists.shape[0]
-            dists = np.concatenate(
-                [dists, np.full((q, k - k_final), MAX_DIST, np.float32)], 1)
-            ids = np.concatenate(
-                [ids, np.full((q, k - k_final), -1, np.int32)], 1)
-        return dists, ids
+        return _pad_to_k(np.asarray(dists), np.asarray(ids), k, k_final)
 
 
 # --------------------------------------------------------------------------
@@ -170,13 +185,7 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
             data_s, sqnorm_s, graph_s, deleted_s, pids_s[0], pvecs_s[0],
             pmask_s[0], q_s, k_local, L, B, T, metric, base, nbp_limit)
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
-        all_d = jax.lax.all_gather(d, SHARD_AXIS, axis=1, tiled=True)
-        all_i = jax.lax.all_gather(gids, SHARD_AXIS, axis=1, tiled=True)
-        gneg, gpos = jax.lax.top_k(-all_d, k_final)
-        gd = -gneg
-        gi = jnp.take_along_axis(all_i, gpos, axis=1)
-        gi = jnp.where(gd >= jnp.float32(MAX_DIST), -1, gi)
-        return gd, gi
+        return _gather_merge(d, gids, k_final)
 
     return jax.shard_map(
         local_search,
@@ -189,6 +198,54 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
         check_vma=False,
     )(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs, pivot_mask,
       queries)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k_local", "k_final", "nprobe", "metric", "base",
+                     "dedup", "mesh"))
+def _sharded_dense_kernel(data_perm, member_ids, member_sq, centroids,
+                          cent_sq, cent_valid, deleted, queries,
+                          k_local: int, k_final: int, nprobe: int,
+                          metric: int, base: int, dedup: bool, mesh: Mesh):
+    """One program: per-shard dense block scan (each shard probes the top
+    `nprobe` of its OWN kd/k-means partition blocks — padded blocks are
+    masked out of the centroid ranking), then ICI all-gather + global
+    top-k, exactly like `_sharded_beam_kernel`.  The multi-chip face of
+    the throughput-serving dense mode."""
+    from sptag_tpu.algo.dense import MAX_DIST as _MD, _finalize_topk
+
+    def local(dp_s, mi_s, ms_s, ce_s, cs_s, cv_s, del_s, q_s):
+        shard = jax.lax.axis_index(SHARD_AXIS)
+        n_local = del_s.shape[0]
+        qf = q_s.astype(jnp.float32)
+        d0 = dist_ops.pairwise_distance(qf, ce_s[0],
+                                        DistCalcMethod(metric),
+                                        x_sqnorm=cs_s[0])
+        d0 = jnp.where(cv_s[0][None, :], d0, _MD)
+        _, topc = jax.lax.top_k(-d0, nprobe)                # (Q, nprobe)
+        Q = q_s.shape[0]
+        Pb = dp_s.shape[2]                                  # block size
+        ids = mi_s[0][topc].reshape(Q, nprobe * Pb)         # local ids
+        sq = ms_s[0][topc].reshape(Q, nprobe * Pb)
+        vecs = dp_s[0][topc].reshape(Q, nprobe * Pb, dp_s.shape[3])
+        nd = dist_ops.batched_gathered_distance(
+            q_s, vecs, DistCalcMethod(metric), base, sq)
+        d, out_ids = _finalize_topk(nd, ids, del_s, dedup, k_local)
+        gids = jnp.where(out_ids >= 0, out_ids + shard * n_local, -1)
+        return _gather_merge(d, gids, k_final)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None, None, None),
+                  P(SHARD_AXIS, None, None), P(SHARD_AXIS, None, None),
+                  P(SHARD_AXIS, None, None), P(SHARD_AXIS, None),
+                  P(SHARD_AXIS, None), P(SHARD_AXIS), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_vma=False,
+    )(data_perm, member_ids, member_sq, centroids, cent_sq, cent_valid,
+      deleted, queries)
 
 
 class ServingAdapter:
@@ -293,10 +350,16 @@ class ShardedBKTIndex:
               metric: DistCalcMethod = DistCalcMethod.L2,
               mesh: Optional[Mesh] = None,
               value_type=None,
-              params: Optional[dict] = None) -> "ShardedBKTIndex":
+              params: Optional[dict] = None,
+              dense: bool = False) -> "ShardedBKTIndex":
         """Partition `data` into contiguous equal blocks, build one BKT
         sub-index per shard (host-side, device-batched k-means/graph build),
-        and lay the per-shard arrays out over the mesh."""
+        and lay the per-shard arrays out over the mesh.
+
+        `dense=True` additionally packs each shard's dense tree-partition
+        layout so `search_dense` (the multi-chip throughput mode) is
+        available — at the cost of a second device-resident copy of the
+        corpus in cluster-contiguous order."""
         from sptag_tpu.algo.bkt import BKTIndex
         from sptag_tpu.core.types import value_type_of
 
@@ -361,7 +424,88 @@ class ShardedBKTIndex:
                     np.concatenate(blocks_del),
                     np.stack(blocks_pid), np.stack(blocks_pvec),
                     np.stack(blocks_pmask))
+        if dense:
+            self._place_dense(shard_indexes)
         return self
+
+    def _place_dense(self, shard_indexes) -> None:
+        """Pad every shard's dense layout to one (C, P) geometry and lay
+        the stacked arrays out over the mesh (leading shard axis).
+
+        Each shard's DenseTreeSearcher is staged to HOST numpy and freed
+        before the next one builds — holding all shards' device-side
+        layouts simultaneously would concentrate a full second corpus
+        copy on the default device, an OOM at exactly the multi-chip
+        scale this mode targets."""
+        host = []
+        for sub in shard_indexes:
+            se = sub._build_dense_searcher(replicas=1)
+            host.append(dict(perm=np.asarray(se.data_perm),
+                             ids=np.asarray(se.member_ids),
+                             sq=np.asarray(se.member_sq),
+                             cent=np.asarray(se.centroids),
+                             cent_sq=np.asarray(se.cent_sq)))
+            del se                      # free device buffers eagerly
+        n_dev = self.mesh.devices.size
+        C = max(h["perm"].shape[0] for h in host)
+        Pb = max(h["perm"].shape[1] for h in host)
+        D = host[0]["perm"].shape[2]
+        dp = np.zeros((n_dev, C, Pb, D), host[0]["perm"].dtype)
+        mi = np.full((n_dev, C, Pb), -1, np.int32)
+        ms = np.zeros((n_dev, C, Pb), np.float32)
+        ce = np.zeros((n_dev, C, D), np.float32)
+        cs = np.zeros((n_dev, C), np.float32)
+        cv = np.zeros((n_dev, C), bool)
+        for s, h in enumerate(host):
+            c, p = h["perm"].shape[:2]
+            dp[s, :c, :p] = h["perm"]
+            mi[s, :c, :p] = h["ids"]
+            ms[s, :c, :p] = h["sq"]
+            ce[s, :c] = h["cent"]
+            cs[s, :c] = h["cent_sq"]
+            cv[s, :c] = True
+        mesh = self.mesh
+        r2 = NamedSharding(mesh, P(SHARD_AXIS, None))
+        r3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
+        r4 = NamedSharding(mesh, P(SHARD_AXIS, None, None, None))
+        self.dense_perm = jax.device_put(dp, r4)
+        self.dense_ids = jax.device_put(mi, r3)
+        self.dense_sq = jax.device_put(ms, r3)
+        self.dense_cent = jax.device_put(ce, r3)
+        self.dense_cent_sq = jax.device_put(cs, r2)
+        self.dense_cent_valid = jax.device_put(cv, r2)
+        self.dense_cluster_size = Pb
+        self.dense_num_clusters = C
+
+    def search_dense(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None,
+                     normalized: bool = False
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Multi-chip dense mode: every shard probes the top blocks of its
+        own partition in one shard_map program with an all-gather top-k
+        merge.  Requires `build(..., dense=True)`."""
+        if not hasattr(self, "dense_perm"):
+            raise RuntimeError(
+                "dense layout not packed — build with dense=True")
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if self.metric == DistCalcMethod.Cosine and not normalized:
+            queries = dist_ops.normalize(queries, self.base)
+        max_check = max_check if max_check is not None else self.max_check
+        nprobe = int(np.clip(-(-max_check // self.dense_cluster_size), 1,
+                             self.dense_num_clusters))
+        n_dev = self.mesh.devices.size
+        k_local = min(k, self.n_local, nprobe * self.dense_cluster_size)
+        k_final = min(k, self.n, k_local * n_dev)
+        # dedup=False: shards are packed replica-free (_place_dense forces
+        # replicas=1), so no id can appear in two probed blocks
+        d, ids = _sharded_dense_kernel(
+            self.dense_perm, self.dense_ids, self.dense_sq,
+            self.dense_cent, self.dense_cent_sq, self.dense_cent_valid,
+            self.deleted, jnp.asarray(queries), k_local, k_final, nprobe,
+            int(self.metric), self.base, False, self.mesh)
+        return _pad_to_k(np.asarray(d), np.asarray(ids), k, k_final)
 
     def _place(self, data, graph, deleted, pivot_ids, pivot_vecs,
                pivot_mask) -> None:
@@ -408,11 +552,4 @@ class ShardedBKTIndex:
             self.pivot_ids, self.pivot_vecs, self.pivot_mask,
             jnp.asarray(queries), k_local, k_final, L, B, T,
             int(self.metric), self.base, limit, self.mesh)
-        d, ids = np.asarray(d), np.asarray(ids)
-        if k_final < k:
-            q = d.shape[0]
-            d = np.concatenate(
-                [d, np.full((q, k - k_final), MAX_DIST, np.float32)], 1)
-            ids = np.concatenate(
-                [ids, np.full((q, k - k_final), -1, np.int32)], 1)
-        return d, ids
+        return _pad_to_k(np.asarray(d), np.asarray(ids), k, k_final)

@@ -46,7 +46,7 @@ def built():
     mesh = make_mesh()
     assert mesh.devices.size == 8
     index = ShardedBKTIndex.build(data, DistCalcMethod.L2, mesh=mesh,
-                                  params=PARAMS)
+                                  params=PARAMS, dense=True)
     return data, queries, index
 
 
@@ -103,3 +103,32 @@ def test_sharded_cosine():
     _, ids = index.search(queries, 10)
     r = _recall(ids, truth)
     assert r >= 0.85, f"sharded cosine recall@10 {r:.3f}"
+
+
+def test_sharded_dense_mode(built):
+    """search_dense runs every shard's MXU block scan in one shard_map
+    program with a global top-k merge; recall must track the sharded beam
+    walk's and ids must be valid global ids."""
+    data, queries, index = built
+    k = 10
+    truth = _true_topk(data, queries, k)
+    d, ids = index.search_dense(queries, k, max_check=1024)
+    assert d.shape == (len(queries), k) and ids.shape == (len(queries), k)
+    assert (ids >= -1).all() and (ids < len(data)).all()
+    r = _recall(ids, truth)
+    assert r >= 0.85, r
+    # self-queries resolve to their own global row
+    d2, i2 = index.search_dense(data[:8], k=1, max_check=2048)
+    assert (i2[:, 0] == np.arange(8)).mean() >= 0.8, i2[:, 0]
+    # ascending distances among real results
+    assert np.all(np.diff(d, axis=1)[(d[:, :-1] < 3.4e38)
+                                     & (d[:, 1:] < 3.4e38)] >= -1e-4)
+
+
+def test_sharded_dense_requires_flag():
+    data, queries = _corpus(n=800)
+    mesh = make_mesh()
+    index = ShardedBKTIndex.build(data, DistCalcMethod.L2, mesh=mesh,
+                                  params=PARAMS)
+    with pytest.raises(RuntimeError):
+        index.search_dense(queries, 5)
