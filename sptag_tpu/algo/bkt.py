@@ -38,7 +38,8 @@ from sptag_tpu.algo.dense import DenseTreeSearcher, partition_from_tree
 from sptag_tpu.algo.engine import GraphSearchEngine
 from sptag_tpu.core.index import MAX_DIST, VectorIndex, register_algo
 from sptag_tpu.core.params import BKTParams
-from sptag_tpu.core.types import IndexAlgoType, VectorValueType, dtype_of
+from sptag_tpu.core.types import (DistCalcMethod, IndexAlgoType,
+                                  VectorValueType, dtype_of)
 from sptag_tpu.graph.rng import RelativeNeighborhoodGraph
 from sptag_tpu.utils import trace
 from sptag_tpu.io import format as fmt
@@ -183,29 +184,36 @@ class BKTIndex(VectorIndex):
         if replicas is None:
             replicas = getattr(self.params, "dense_replicas", 1)
         data = self._host[:self._n]
+        centers, clusters = self._dense_clusters()
+        return DenseTreeSearcher(
+            data, centers, clusters, self._deleted[:self._n],
+            self.dist_calc_method, self.base,
+            replicas=replicas)
+
+    def _dense_clusters(self):
+        """Tree partition plus nearest-center assignment of rows appended
+        after the last rebuild (host numpy throughout — the mesh packer
+        calls this without touching the device)."""
+        data = self._host[:self._n]
         centers, clusters = self._partition_tree()
         covered = np.zeros(self._n, bool)
         for c in clusters:
             covered[c] = True
         missing = np.flatnonzero(~covered)
         if len(missing):
-            import jax.numpy as jnp
-
-            from sptag_tpu.ops import distance as dist_ops
-            d = np.asarray(dist_ops.pairwise_distance(
-                jnp.asarray(data[missing]),
-                jnp.asarray(data[centers]),
-                self.dist_calc_method))
-            owner = d.argmin(axis=1)
+            q = data[missing].astype(np.float32)
+            c = data[centers].astype(np.float32)
+            dot = q @ c.T
+            if self.dist_calc_method == DistCalcMethod.Cosine:
+                owner = dot.argmax(axis=1)          # max dot = min distance
+            else:
+                owner = ((c ** 2).sum(1)[None, :] - 2.0 * dot).argmin(axis=1)
             for ci in range(len(clusters)):
                 extra = missing[owner == ci]
                 if len(extra):
                     clusters[ci] = np.concatenate(
                         [clusters[ci], extra])
-        return DenseTreeSearcher(
-            data, centers, clusters, self._deleted[:self._n],
-            self.dist_calc_method, self.base,
-            replicas=replicas)
+        return centers, clusters
 
     def _partition_tree(self):
         """Cut the current tree into a corpus partition for the dense

@@ -568,17 +568,17 @@ class DenseTreeSearcher:
     closure-assigned duplicate rows; the kernel de-duplicates ids before
     the final top-k."""
 
-    def __init__(self, data: np.ndarray, centers: np.ndarray,
-                 clusters: List[np.ndarray],
-                 deleted: Optional[np.ndarray],
-                 metric: DistCalcMethod, base: int,
-                 replicas: int = 1):
-        self.metric = DistCalcMethod(metric)
-        self.base = base
-        self.n = data.shape[0]
-        self.replicas = max(1, replicas)
-        clusters = replicate_clusters(data, clusters, self.replicas,
-                                      self.metric)
+    @staticmethod
+    def build_layout(data: np.ndarray, clusters: List[np.ndarray],
+                     metric: DistCalcMethod, replicas: int = 1) -> dict:
+        """HOST-side cluster-contiguous layout: packed blocks, member ids,
+        squared norms, block-mean centroids — all numpy.  Shared by
+        __init__ (which device_puts the result) and the mesh packer
+        (parallel/sharded._place_dense), which pads layouts across shards
+        and must not round-trip every shard's corpus through the default
+        device just to read the arrays back."""
+        clusters = replicate_clusters(data, clusters, max(1, replicas),
+                                      DistCalcMethod(metric))
         C = len(clusters)
         # int8 VMEM tiles are (32, 128): pad P so the Pallas probe kernel's
         # block shape is legal for integer corpora too
@@ -590,23 +590,42 @@ class DenseTreeSearcher:
         for i, members in enumerate(clusters):
             perm[i, :len(members)] = data[members]
             mids[i, :len(members)] = members
-        self.cluster_size = P
-        self.num_clusters = C
-        self.data_perm = jnp.asarray(perm)
-        self.member_ids = jnp.asarray(mids)
-        sq = np.asarray(jax.jit(dist_ops.row_sqnorms)(
-            self.data_perm.reshape(C * P, D))).reshape(C, P)
-        # padding rows have sqnorm 0 == a real-looking vector; the id mask
-        # already excludes them from the top-k
-        self.member_sq = jnp.asarray(sq)
+        # numpy mirror of ops/distance.row_sqnorms (f32 accumulation;
+        # int8/uint8 exact via int64 host sums).  Padding rows get sqnorm
+        # 0 == a real-looking vector; the id mask excludes them anyway
+        flat = perm.reshape(C * P, D)
+        if np.issubdtype(perm.dtype, np.integer):
+            sq = (flat.astype(np.int64) ** 2).sum(1).astype(np.float32)
+        else:
+            sq = (flat.astype(np.float32) ** 2).sum(
+                1, dtype=np.float32)
         # probe ranking uses the block MEAN (an IVF-style centroid): packed
         # blocks hold several tree subtrees, and a single medoid sample of
         # one constituent ranks the block far worse than its mean does
         means = np.stack([
             data[members].astype(np.float32).mean(axis=0)
             for members in clusters])
-        self.centroids = jnp.asarray(means)
-        self.cent_sq = jax.jit(dist_ops.row_sqnorms)(self.centroids)
+        cent_sq = (means ** 2).sum(1, dtype=np.float32)
+        return dict(perm=perm, ids=mids, sq=sq.reshape(C, P), cent=means,
+                    cent_sq=cent_sq, cluster_size=P, num_clusters=C)
+
+    def __init__(self, data: np.ndarray, centers: np.ndarray,
+                 clusters: List[np.ndarray],
+                 deleted: Optional[np.ndarray],
+                 metric: DistCalcMethod, base: int,
+                 replicas: int = 1):
+        self.metric = DistCalcMethod(metric)
+        self.base = base
+        self.n = data.shape[0]
+        self.replicas = max(1, replicas)
+        lay = self.build_layout(data, clusters, self.metric, self.replicas)
+        self.cluster_size = lay["cluster_size"]
+        self.num_clusters = lay["num_clusters"]
+        self.data_perm = jnp.asarray(lay["perm"])
+        self.member_ids = jnp.asarray(lay["ids"])
+        self.member_sq = jnp.asarray(lay["sq"])
+        self.centroids = jnp.asarray(lay["cent"])
+        self.cent_sq = jnp.asarray(lay["cent_sq"])
         if deleted is None:
             deleted = np.zeros(self.n, bool)
         self.deleted = jnp.asarray(deleted[:self.n])

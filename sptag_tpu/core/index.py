@@ -41,7 +41,12 @@ from sptag_tpu.core.vectorset import MetadataSet, VectorSet
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.utils.ini import IniReader
 
-MAX_DIST = np.float32(np.finfo(np.float32).max)
+# THE sentinel distance for empty/filtered result slots, shared with every
+# kernel module (ops/*, algo/*, graph/rng, parallel/*).  Must stay 3.4e38,
+# not finfo-max: kernels pad with exactly np.float32(3.4e38), and a larger
+# core constant would let kernel sentinels pass `dist < MAX_DIST` client
+# filters as "real" results.
+MAX_DIST = np.float32(3.4e38)
 
 # Distance at-or-below which a searched vector counts as "the same vector"
 # for DeleteIndex(vector) (reference BKTIndex.cpp:439-453 uses 1e-6).

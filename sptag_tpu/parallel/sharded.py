@@ -258,7 +258,8 @@ class ServingAdapter:
     collectives.  Metadata is not sharded (serve corpus metadata from the
     frontend's own store if needed)."""
 
-    def __init__(self, sharded, feature_dim: int, value_type=None):
+    def __init__(self, sharded, feature_dim: int, value_type=None,
+                 mode: str = "beam"):
         from sptag_tpu.core.types import VectorValueType, value_type_of
 
         self._impl = sharded
@@ -268,6 +269,17 @@ class ServingAdapter:
                            else value_type_of(np.dtype(
                                sharded.data.dtype)))
         self.metadata = None
+        # "dense" serves the multi-chip block scan (requires the index
+        # built with dense=True); "beam" the per-shard walk
+        if mode not in ("beam", "dense"):
+            raise ValueError(f"unknown serving mode: {mode!r}")
+        if mode == "dense":
+            if not hasattr(sharded, "search_dense"):
+                raise ValueError("index type has no dense mode")
+            if not hasattr(sharded, "dense_perm"):
+                raise ValueError(
+                    "dense layout not packed — build with dense=True")
+        self.mode = mode
 
     @property
     def num_samples(self) -> int:
@@ -275,6 +287,8 @@ class ServingAdapter:
 
     def search_batch(self, queries: np.ndarray, k: int = 10
                      ) -> Tuple[np.ndarray, np.ndarray]:
+        if self.mode == "dense":
+            return self._impl.search_dense(np.asarray(queries), k=k)
         return self._impl.search(np.asarray(queries), k=k)
 
     def search(self, query, k: int = 10, with_metadata: bool = False):
@@ -283,7 +297,7 @@ class ServingAdapter:
         q = np.asarray(query)
         if q.ndim == 1:
             q = q[None, :]
-        d, ids = self._impl.search(q, k=k)
+        d, ids = self.search_batch(q, k=k)
         # metas stays None even for with_metadata: this adapter has no
         # metadata store (self.metadata is None), and the batch path
         # already returns none in that case — the two paths must agree
@@ -432,20 +446,18 @@ class ShardedBKTIndex:
         """Pad every shard's dense layout to one (C, P) geometry and lay
         the stacked arrays out over the mesh (leading shard axis).
 
-        Each shard's DenseTreeSearcher is staged to HOST numpy and freed
-        before the next one builds — holding all shards' device-side
-        layouts simultaneously would concentrate a full second corpus
-        copy on the default device, an OOM at exactly the multi-chip
+        Layouts are computed entirely HOST-side (DenseTreeSearcher.
+        build_layout) — device-building each shard's searcher would
+        concentrate a full second corpus copy on the default device and
+        round-trip it back to host, an OOM at exactly the multi-chip
         scale this mode targets."""
+        from sptag_tpu.algo.dense import DenseTreeSearcher
+
         host = []
         for sub in shard_indexes:
-            se = sub._build_dense_searcher(replicas=1)
-            host.append(dict(perm=np.asarray(se.data_perm),
-                             ids=np.asarray(se.member_ids),
-                             sq=np.asarray(se.member_sq),
-                             cent=np.asarray(se.centroids),
-                             cent_sq=np.asarray(se.cent_sq)))
-            del se                      # free device buffers eagerly
+            _, clusters = sub._dense_clusters()
+            host.append(DenseTreeSearcher.build_layout(
+                sub._host[:sub._n], clusters, self.metric, replicas=1))
         n_dev = self.mesh.devices.size
         C = max(h["perm"].shape[0] for h in host)
         Pb = max(h["perm"].shape[1] for h in host)

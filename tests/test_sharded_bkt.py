@@ -132,3 +132,19 @@ def test_sharded_dense_requires_flag():
                                   params=PARAMS)
     with pytest.raises(RuntimeError):
         index.search_dense(queries, 5)
+
+
+def test_serving_adapter_dense_mode(built):
+    from sptag_tpu.parallel.sharded import ServingAdapter
+
+    data, queries, index = built
+    ad = ServingAdapter(index, feature_dim=data.shape[1], mode="dense")
+    d, ids = ad.search_batch(queries[:8], 5)
+    assert ids.shape == (8, 5)
+    res = ad.search(data[3], k=3)
+    assert res.ids[0] == 3
+
+    beam_only = ShardedBKTIndex.build(data[:800], DistCalcMethod.L2,
+                                      mesh=make_mesh(), params=PARAMS)
+    with pytest.raises(ValueError):
+        ServingAdapter(beam_only, feature_dim=data.shape[1], mode="dense")
