@@ -609,6 +609,30 @@ class DenseTreeSearcher:
         return dict(perm=perm, ids=mids, sq=sq.reshape(C, P), cent=means,
                     cent_sq=cent_sq, cluster_size=P, num_clusters=C)
 
+    @staticmethod
+    def pad_layout(lay: dict, C: int, Pb: int, dim: int) -> dict:
+        """Pad one `build_layout` result to an agreed (C, Pb) geometry
+        (shared by the single-host mesh packer and the multi-controller
+        build so the padding semantics cannot diverge): -1 ids, zero
+        vectors/norms, and a centroid-validity mask over the real blocks.
+        """
+        c, p = lay["perm"].shape[:2]
+        out = dict(
+            dense_perm=np.zeros((C, Pb, dim), lay["perm"].dtype),
+            dense_ids=np.full((C, Pb), -1, np.int32),
+            dense_sq=np.zeros((C, Pb), np.float32),
+            dense_cent=np.zeros((C, dim), np.float32),
+            dense_cent_sq=np.zeros((C,), np.float32),
+            dense_cent_valid=np.zeros((C,), bool),
+        )
+        out["dense_perm"][:c, :p] = lay["perm"]
+        out["dense_ids"][:c, :p] = lay["ids"]
+        out["dense_sq"][:c, :p] = lay["sq"]
+        out["dense_cent"][:c] = lay["cent"]
+        out["dense_cent_sq"][:c] = lay["cent_sq"]
+        out["dense_cent_valid"][:c] = True
+        return out
+
     def __init__(self, data: np.ndarray, centers: np.ndarray,
                  clusters: List[np.ndarray],
                  deleted: Optional[np.ndarray],

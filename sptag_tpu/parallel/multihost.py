@@ -18,7 +18,8 @@ shard on one host):
   `jax.make_array_from_single_device_arrays`; no host ever holds the whole
   corpus layout.  Shard geometry (rows per shard, graph width, pivot pad)
   is derived from parameters, not data, so processes agree without
-  communicating.
+  communicating; the opt-in dense layout's data-dependent (C, P) geometry
+  is agreed with one `process_allgather` host collective.
 
 Validated end-to-end by tests/test_multihost.py: two real OS processes x 4
 virtual CPU devices each form an 8-device global mesh (gloo transport
@@ -62,7 +63,8 @@ def initialize(coordinator_address: Optional[str] = None,
 def build_process_sharded(data_for_shard, n: int, dim: int,
                           metric: DistCalcMethod = DistCalcMethod.L2,
                           mesh=None, value_type=None,
-                          params: Optional[dict] = None) -> ShardedBKTIndex:
+                          params: Optional[dict] = None,
+                          dense: bool = False) -> ShardedBKTIndex:
     """Build a ShardedBKTIndex across ALL processes of a multi-controller
     run; this process builds only its local devices' shards.
 
@@ -71,6 +73,12 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
     [s*n_local, min((s+1)*n_local, n))) — a callable rather than an array
     so each host loads only its own slice from disk/object store.
     `n`/`dim` are the GLOBAL corpus row count and dimension.
+
+    `dense=True` also packs each local shard's dense layout for
+    `search_dense`.  Unlike the graph geometry, the dense (C, P) geometry
+    is data-dependent (partition sizes vary per shard), so the global
+    padding shape is agreed with one small host collective
+    (`multihost_utils.process_allgather` of each process's local maxima).
     """
     import jax
     import jax.numpy as jnp
@@ -137,6 +145,12 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
             packed["deleted"][:] = True    # placeholder row never returned
         packed["sqnorm"] = np.asarray(
             dist_ops.row_sqnorms(jnp.asarray(packed["data"])))
+        if dense:
+            from sptag_tpu.algo.dense import DenseTreeSearcher
+
+            _, clusters = sub._dense_clusters()
+            packed["_dense_lay"] = DenseTreeSearcher.build_layout(
+                sub._host[:sub._n], clusters, self.metric, replicas=1)
         per_device[s] = packed
 
     assert sample_params is not None, "process owns no mesh devices"
@@ -177,4 +191,35 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
     self.pivot_ids = assemble("pivot_ids", (max_p,), np.int32, True)
     self.pivot_vecs = assemble("pivot_vecs", (max_p, dim), dt, True)
     self.pivot_mask = assemble("pivot_mask", (words,), np.int32, True)
+
+    if dense:
+        from jax.experimental import multihost_utils
+
+        # agree on the global (C, P) padding shape: the dense geometry is
+        # data-dependent, so every process contributes its local maxima
+        # and all adopt the global max (one tiny host collective)
+        local_c = max(p["_dense_lay"]["perm"].shape[0]
+                      for p in per_device.values())
+        local_p = max(p["_dense_lay"]["perm"].shape[1]
+                      for p in per_device.values())
+        agreed = np.asarray(multihost_utils.process_allgather(
+            np.asarray([local_c, local_p], np.int64)))
+        C = int(agreed[..., 0].max())
+        Pb = int(agreed[..., 1].max())
+        from sptag_tpu.algo.dense import DenseTreeSearcher
+
+        for s, dev in local_shards:
+            lay = per_device[s].pop("_dense_lay")
+            per_device[s].update(
+                DenseTreeSearcher.pad_layout(lay, C, Pb, dim))
+        self.dense_perm = assemble("dense_perm", (C, Pb, dim), dt, True)
+        self.dense_ids = assemble("dense_ids", (C, Pb), np.int32, True)
+        self.dense_sq = assemble("dense_sq", (C, Pb), np.float32, True)
+        self.dense_cent = assemble("dense_cent", (C, dim), np.float32, True)
+        self.dense_cent_sq = assemble("dense_cent_sq", (C,), np.float32,
+                                      True)
+        self.dense_cent_valid = assemble("dense_cent_valid", (C,), bool,
+                                         True)
+        self.dense_cluster_size = Pb
+        self.dense_num_clusters = C
     return self

@@ -277,7 +277,8 @@ class ServingAdapter:
             if not hasattr(sharded, "search_dense"):
                 raise ValueError("index type has no dense mode")
             if not hasattr(sharded, "dense_perm"):
-                raise ValueError(
+                # same exception type + message as search_dense itself
+                raise RuntimeError(
                     "dense layout not packed — build with dense=True")
         self.mode = mode
 
@@ -462,20 +463,13 @@ class ShardedBKTIndex:
         C = max(h["perm"].shape[0] for h in host)
         Pb = max(h["perm"].shape[1] for h in host)
         D = host[0]["perm"].shape[2]
-        dp = np.zeros((n_dev, C, Pb, D), host[0]["perm"].dtype)
-        mi = np.full((n_dev, C, Pb), -1, np.int32)
-        ms = np.zeros((n_dev, C, Pb), np.float32)
-        ce = np.zeros((n_dev, C, D), np.float32)
-        cs = np.zeros((n_dev, C), np.float32)
-        cv = np.zeros((n_dev, C), bool)
-        for s, h in enumerate(host):
-            c, p = h["perm"].shape[:2]
-            dp[s, :c, :p] = h["perm"]
-            mi[s, :c, :p] = h["ids"]
-            ms[s, :c, :p] = h["sq"]
-            ce[s, :c] = h["cent"]
-            cs[s, :c] = h["cent_sq"]
-            cv[s, :c] = True
+        padded = [DenseTreeSearcher.pad_layout(h, C, Pb, D) for h in host]
+        dp = np.stack([p["dense_perm"] for p in padded])
+        mi = np.stack([p["dense_ids"] for p in padded])
+        ms = np.stack([p["dense_sq"] for p in padded])
+        ce = np.stack([p["dense_cent"] for p in padded])
+        cs = np.stack([p["dense_cent_sq"] for p in padded])
+        cv = np.stack([p["dense_cent_valid"] for p in padded])
         mesh = self.mesh
         r2 = NamedSharding(mesh, P(SHARD_AXIS, None))
         r3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))

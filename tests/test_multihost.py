@@ -38,7 +38,7 @@ _WORKER = textwrap.dedent("""
 
     idx = multihost.build_process_sharded(
         lambda s: data[s * n_local:(s + 1) * n_local], n, d,
-        DistCalcMethod.L2, mesh=make_mesh(),
+        DistCalcMethod.L2, mesh=make_mesh(), dense=True,
         params={"BKTNumber": 1, "BKTKmeansK": 4, "TPTNumber": 2,
                 "TPTLeafSize": 32, "NeighborhoodSize": 8, "CEF": 16,
                 "MaxCheckForRefineGraph": 64, "RefineIterations": 1,
@@ -52,7 +52,12 @@ _WORKER = textwrap.dedent("""
     hits = (ids[:, 0] == probes).mean()
     assert hits >= 0.9, (hits, ids[:, 0], probes)
     assert np.all(np.diff(dists, axis=1) >= -1e-3)
-    print(f"proc {pid} OK hits={hits}", flush=True)
+    # the multi-chip dense mode over the same DCN mesh (geometry agreed
+    # via the process_allgather host collective)
+    dd, di = idx.search_dense(data[probes], k=3, max_check=256)
+    dhits = (di[:, 0] == probes).mean()
+    assert dhits >= 0.9, (dhits, di[:, 0], probes)
+    print(f"proc {pid} OK hits={hits} dense={dhits}", flush=True)
 """)
 
 

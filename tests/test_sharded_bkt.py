@@ -146,5 +146,7 @@ def test_serving_adapter_dense_mode(built):
 
     beam_only = ShardedBKTIndex.build(data[:800], DistCalcMethod.L2,
                                       mesh=make_mesh(), params=PARAMS)
-    with pytest.raises(ValueError):
+    with pytest.raises(RuntimeError):      # same type as search_dense
         ServingAdapter(beam_only, feature_dim=data.shape[1], mode="dense")
+    with pytest.raises(ValueError):        # unknown mode string
+        ServingAdapter(index, feature_dim=data.shape[1], mode="Dense")
