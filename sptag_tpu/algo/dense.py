@@ -610,21 +610,29 @@ class DenseTreeSearcher:
                     cent_sq=cent_sq, cluster_size=P, num_clusters=C)
 
     @staticmethod
-    def pad_layout(lay: dict, C: int, Pb: int, dim: int) -> dict:
+    def pad_layout(lay: dict, C: int, Pb: int, dim: int,
+                   out: Optional[dict] = None) -> dict:
         """Pad one `build_layout` result to an agreed (C, Pb) geometry
         (shared by the single-host mesh packer and the multi-controller
         build so the padding semantics cannot diverge): -1 ids, zero
         vectors/norms, and a centroid-validity mask over the real blocks.
-        """
+
+        `out` may supply pre-allocated (C, Pb, ...) arrays (e.g. VIEWS
+        into a stacked per-shard buffer) to fill in place — the mesh
+        packer uses this so all shards' padded layouts never exist twice
+        in host memory.  Provided arrays must be zero-initialized except
+        dense_ids (filled with -1 here)."""
         c, p = lay["perm"].shape[:2]
-        out = dict(
-            dense_perm=np.zeros((C, Pb, dim), lay["perm"].dtype),
-            dense_ids=np.full((C, Pb), -1, np.int32),
-            dense_sq=np.zeros((C, Pb), np.float32),
-            dense_cent=np.zeros((C, dim), np.float32),
-            dense_cent_sq=np.zeros((C,), np.float32),
-            dense_cent_valid=np.zeros((C,), bool),
-        )
+        if out is None:
+            out = dict(
+                dense_perm=np.zeros((C, Pb, dim), lay["perm"].dtype),
+                dense_ids=np.empty((C, Pb), np.int32),
+                dense_sq=np.zeros((C, Pb), np.float32),
+                dense_cent=np.zeros((C, dim), np.float32),
+                dense_cent_sq=np.zeros((C,), np.float32),
+                dense_cent_valid=np.zeros((C,), bool),
+            )
+        out["dense_ids"][:] = -1
         out["dense_perm"][:c, :p] = lay["perm"]
         out["dense_ids"][:c, :p] = lay["ids"]
         out["dense_sq"][:c, :p] = lay["sq"]

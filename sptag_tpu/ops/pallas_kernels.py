@@ -188,7 +188,10 @@ def group_block_dots(data_perm: jax.Array, queries: jax.Array,
             pl.BlockSpec((G, D), lambda g, j, t: (g, 0)),
             pl.BlockSpec((1, P, D), lambda g, j, t: (t[g, j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, P), lambda g, j, t: (g, j, 0, 0)),
+        # 3D output with a flattened (group, union-slot) leading axis —
+        # the same block shape family as the proven probe_block_dots
+        # kernel ((1, minor, minor)); each grid step owns one block
+        out_specs=pl.BlockSpec((1, G, P), lambda g, j, t: (g * U + j, 0, 0)),
     )
 
     def kernel(t_ref, q_ref, blk_ref, out_ref):
@@ -203,12 +206,13 @@ def group_block_dots(data_perm: jax.Array, queries: jax.Array,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST)
-        out_ref[0, 0] = dot
+        out_ref[0] = dot
 
     out_dt = jnp.int32 if int_path else jnp.float32
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((NG, U, G, P), out_dt),
+        out_shape=jax.ShapeDtypeStruct((NG * U, G, P), out_dt),
         grid_spec=grid_spec,
         interpret=interpret,
     )(union_c, queries, data_perm)
+    return out.reshape(NG, U, G, P)

@@ -206,8 +206,6 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
             np.asarray([local_c, local_p], np.int64)))
         C = int(agreed[..., 0].max())
         Pb = int(agreed[..., 1].max())
-        from sptag_tpu.algo.dense import DenseTreeSearcher
-
         for s, dev in local_shards:
             lay = per_device[s].pop("_dense_lay")
             per_device[s].update(

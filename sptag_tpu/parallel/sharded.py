@@ -463,13 +463,21 @@ class ShardedBKTIndex:
         C = max(h["perm"].shape[0] for h in host)
         Pb = max(h["perm"].shape[1] for h in host)
         D = host[0]["perm"].shape[2]
-        padded = [DenseTreeSearcher.pad_layout(h, C, Pb, D) for h in host]
-        dp = np.stack([p["dense_perm"] for p in padded])
-        mi = np.stack([p["dense_ids"] for p in padded])
-        ms = np.stack([p["dense_sq"] for p in padded])
-        ce = np.stack([p["dense_cent"] for p in padded])
-        cs = np.stack([p["dense_cent_sq"] for p in padded])
-        cv = np.stack([p["dense_cent_valid"] for p in padded])
+        # preallocate the stacked buffers and fill per-shard VIEWS so the
+        # padded layouts never exist twice in host memory (dense_perm is a
+        # full second corpus copy)
+        dp = np.zeros((n_dev, C, Pb, D), host[0]["perm"].dtype)
+        mi = np.empty((n_dev, C, Pb), np.int32)
+        ms = np.zeros((n_dev, C, Pb), np.float32)
+        ce = np.zeros((n_dev, C, D), np.float32)
+        cs = np.zeros((n_dev, C), np.float32)
+        cv = np.zeros((n_dev, C), bool)
+        for s, h in enumerate(host):
+            DenseTreeSearcher.pad_layout(
+                h, C, Pb, D,
+                out=dict(dense_perm=dp[s], dense_ids=mi[s], dense_sq=ms[s],
+                         dense_cent=ce[s], dense_cent_sq=cs[s],
+                         dense_cent_valid=cv[s]))
         mesh = self.mesh
         r2 = NamedSharding(mesh, P(SHARD_AXIS, None))
         r3 = NamedSharding(mesh, P(SHARD_AXIS, None, None))
