@@ -165,3 +165,43 @@ def test_metadata_bin_golden_bytes():
     m.save(mb, ib)
     assert mb.getvalue() == meta_golden
     assert ib.getvalue() == idx_golden
+
+
+def test_wire_roundtrip_property():
+    """Randomized round-trips of the wire bodies: any RemoteSearchResult
+    the server can produce must unpack to an equal value (the golden
+    fixtures above pin exact bytes; this pins closure under the full value
+    space — counts, empty lists, None vs present metadata, sentinel
+    distances, non-ASCII index names and query strings)."""
+    rng = np.random.default_rng(123)
+    for _ in range(50):
+        n_idx = int(rng.integers(0, 4))
+        results = []
+        for i in range(n_idx):
+            k = int(rng.integers(0, 6))
+            ids = [int(x) for x in rng.integers(-1, 1 << 30, k)]
+            dists = [float(np.float32(x)) for x in
+                     rng.standard_normal(k) * 10]
+            if k and rng.random() < 0.3:
+                dists[-1] = float(np.float32(3.4e38))    # sentinel slot
+            metas = None
+            if rng.random() < 0.5:
+                metas = [bytes(rng.integers(0, 256, int(rng.integers(0, 9)),
+                                            dtype=np.uint8).tolist())
+                         for _ in range(k)]
+            results.append(wire.IndexSearchResult(
+                f"idx_{i}_é", ids, dists, metas))
+        status = wire.ResultStatus(int(rng.integers(0, 5)))
+        r = wire.RemoteSearchResult(status, results)
+        r2 = wire.RemoteSearchResult.unpack(r.pack())
+        assert r2.status == status
+        assert len(r2.results) == n_idx
+        for a, b in zip(results, r2.results):
+            assert b.index_name == a.index_name
+            assert b.ids == a.ids
+            assert b.metas == a.metas
+            np.testing.assert_allclose(b.dists, a.dists, rtol=0, atol=0)
+
+        q = wire.RemoteQuery("$opt:é→" + "".join(
+            chr(int(c)) for c in rng.integers(0x20, 0x7f, 12)) + "中")
+        assert wire.RemoteQuery.unpack(q.pack()).query == q.query
