@@ -19,6 +19,7 @@ nothing in this module changes.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -361,12 +362,44 @@ class ShardedBKTIndex:
         self.beam_width = 16
 
     @classmethod
+    def load(cls, folder: str,
+             mesh: Optional[Mesh] = None,
+             dense: bool = False) -> "ShardedBKTIndex":
+        """Load a mesh index persisted by `build(..., save_to=folder)`:
+        one reference-format sub-index folder per shard (`shard_000`,
+        `shard_001`, ...), exactly how each reference Server persists its
+        own partition.  The mesh size must match the shard count."""
+        import json
+
+        from sptag_tpu.core.index import load_index
+
+        with open(os.path.join(folder, "sharded.json")) as f:
+            meta = json.load(f)
+        mesh = mesh if mesh is not None else make_mesh()
+        if mesh.devices.size != meta["n_shards"]:
+            raise ValueError(
+                f"mesh has {mesh.devices.size} devices but the saved index "
+                f"has {meta['n_shards']} shards")
+        subs = [load_index(os.path.join(folder, f"shard_{s:03d}"))
+                for s in range(meta["n_shards"])]
+        return cls._assemble(subs, meta["n"], meta["dim"],
+                             DistCalcMethod(meta["metric"]), mesh,
+                             meta.get("empty_shards", []), dense)
+
+    def save(self, folder: str) -> None:
+        raise NotImplementedError(
+            "save happens at build time: ShardedBKTIndex.build(..., "
+            "save_to=folder) — the packed device arrays do not retain the "
+            "per-shard tree structures a reference-format save needs")
+
+    @classmethod
     def build(cls, data: np.ndarray,
               metric: DistCalcMethod = DistCalcMethod.L2,
               mesh: Optional[Mesh] = None,
               value_type=None,
               params: Optional[dict] = None,
-              dense: bool = False) -> "ShardedBKTIndex":
+              dense: bool = False,
+              save_to: Optional[str] = None) -> "ShardedBKTIndex":
         """Partition `data` into contiguous equal blocks, build one BKT
         sub-index per shard (host-side, device-batched k-means/graph build),
         and lay the per-shard arrays out over the mesh.
@@ -374,26 +407,26 @@ class ShardedBKTIndex:
         `dense=True` additionally packs each shard's dense tree-partition
         layout so `search_dense` (the multi-chip throughput mode) is
         available — at the cost of a second device-resident copy of the
-        corpus in cluster-contiguous order."""
+        corpus in cluster-contiguous order.
+
+        `save_to` persists every sub-index as a reference-format folder
+        under `save_to/shard_NNN` plus a `sharded.json` manifest, loadable
+        with `ShardedBKTIndex.load` — the persistence story of the
+        reference's one-Server-per-shard topology."""
         from sptag_tpu.algo.bkt import BKTIndex
         from sptag_tpu.core.types import value_type_of
 
-        self = cls(mesh)
-        self.metric = DistCalcMethod(metric)
-        n_dev = self.mesh.devices.size
+        mesh = mesh if mesh is not None else make_mesh()
+        n_dev = mesh.devices.size
         n = data.shape[0]
         if n < n_dev:
             raise ValueError(f"corpus ({n}) smaller than mesh ({n_dev})")
         n_local = -(-n // n_dev)
-        self.n = n
-        self.n_local = n_local
+        metric = DistCalcMethod(metric)
 
         if value_type is None:
             value_type = value_type_of(np.asarray(data).dtype)
 
-        blocks_data, blocks_graph, blocks_del = [], [], []
-        blocks_pid, blocks_pvec, blocks_pmask = [], [], []
-        m_width = 0
         shard_indexes = []
         empty_shards = []
         for s in range(n_dev):
@@ -406,21 +439,55 @@ class ShardedBKTIndex:
                 block = np.zeros((1, data.shape[1]), data.dtype)
             sub = BKTIndex(value_type)
             sub.set_parameter("DistCalcMethod",
-                              "Cosine" if self.metric ==
+                              "Cosine" if metric ==
                               DistCalcMethod.Cosine else "L2")
             for name, value in (params or {}).items():
                 sub.set_parameter(name, str(value))
             sub.build(block)
             shard_indexes.append(sub)
-            m_width = max(m_width, sub._graph.graph.shape[1])
+        if save_to is not None:
+            import json
+
+            os.makedirs(save_to, exist_ok=True)
+            for s, sub in enumerate(shard_indexes):
+                sub.save_index(os.path.join(save_to, f"shard_{s:03d}"))
+            # atomic manifest write: the per-shard saves are crash-safe
+            # (staged swap in save_index) — a torn manifest must not be
+            # the one thing that makes a good checkpoint unloadable
+            manifest = os.path.join(save_to, "sharded.json")
+            tmp = manifest + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"n_shards": n_dev, "n": n,
+                           "dim": int(data.shape[1]),
+                           "metric": int(metric),
+                           "empty_shards": empty_shards}, f)
+            os.replace(tmp, manifest)
+        return cls._assemble(shard_indexes, n, int(data.shape[1]), metric,
+                             mesh, empty_shards, dense)
+
+    @classmethod
+    def _assemble(cls, shard_indexes, n: int, dim: int,
+                  metric: DistCalcMethod, mesh: Mesh, empty_shards,
+                  dense: bool) -> "ShardedBKTIndex":
+        """Pack built sub-indexes into the mesh arrays (shared by build
+        and load)."""
+        self = cls(mesh)
+        self.metric = DistCalcMethod(metric)
+        n_dev = self.mesh.devices.size
+        n_local = -(-n // n_dev)
+        self.n = n
+        self.n_local = n_local
         self.base = shard_indexes[0].base
         self.params = shard_indexes[0].params
+        m_width = max(sub._graph.graph.shape[1] for sub in shard_indexes)
 
         from sptag_tpu.algo.engine import _num_words
         words = _num_words(n_local)
         max_p = max(len(sub._pivot_ids()) for sub in shard_indexes)
+        blocks_data, blocks_graph, blocks_del = [], [], []
+        blocks_pid, blocks_pvec, blocks_pmask = [], [], []
         for s, sub in enumerate(shard_indexes):
-            packed = pack_shard_block(sub, n_local, data.shape[1], m_width,
+            packed = pack_shard_block(sub, n_local, dim, m_width,
                                       max_p, words)
             if s in empty_shards:
                 packed["deleted"][:] = True

@@ -150,3 +150,31 @@ def test_serving_adapter_dense_mode(built):
         ServingAdapter(beam_only, feature_dim=data.shape[1], mode="dense")
     with pytest.raises(ValueError):        # unknown mode string
         ServingAdapter(index, feature_dim=data.shape[1], mode="Dense")
+
+
+def test_sharded_save_load_roundtrip(tmp_path):
+    """build(save_to=...) persists one reference-format folder per shard
+    plus a manifest; load() reassembles the mesh index with identical
+    search results in both modes (the persistence story of the
+    reference's one-Server-per-shard topology)."""
+    data, queries = _corpus(n=1200, d=16, nq=16)
+    mesh = make_mesh()
+    folder = str(tmp_path / "mesh_idx")
+    idx = ShardedBKTIndex.build(data, DistCalcMethod.L2, mesh=mesh,
+                                params=PARAMS, dense=True, save_to=folder)
+    d0, i0 = idx.search(queries, 5)
+    dd0, di0 = idx.search_dense(queries, 5, max_check=512)
+
+    idx2 = ShardedBKTIndex.load(folder, mesh=mesh, dense=True)
+    d1, i1 = idx2.search(queries, 5)
+    dd1, di1 = idx2.search_dense(queries, 5, max_check=512)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(di0, di1)
+    np.testing.assert_allclose(d0, d1, rtol=1e-6)
+    np.testing.assert_allclose(dd0, dd1, rtol=1e-6)
+
+    # mesh-size mismatch is rejected up front
+    import jax
+
+    with pytest.raises(ValueError):
+        ShardedBKTIndex.load(folder, mesh=make_mesh(jax.devices()[:4]))
