@@ -64,7 +64,8 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
                           metric: DistCalcMethod = DistCalcMethod.L2,
                           mesh=None, value_type=None,
                           params: Optional[dict] = None,
-                          dense: bool = False) -> ShardedBKTIndex:
+                          dense: bool = False,
+                          algo: str = "BKT") -> ShardedBKTIndex:
     """Build a ShardedBKTIndex across ALL processes of a multi-controller
     run; this process builds only its local devices' shards.
 
@@ -84,7 +85,12 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from sptag_tpu.algo.bkt import BKTIndex, pivot_budget
+    from sptag_tpu.algo.bkt import pivot_budget
+    from sptag_tpu.core.index import create_instance
+
+    if str(algo).upper() not in ("BKT", "KDT"):
+        raise ValueError(
+            f"sharded mesh indexes support BKT or KDT shards, not {algo!r}")
     from sptag_tpu.algo.engine import _num_words
     from sptag_tpu.core.types import ErrorCode, dtype_of, value_type_of
     from sptag_tpu.ops import distance as dist_ops
@@ -122,8 +128,8 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
                   if block_rows.dtype != np.dtype(np.float64)
                   else np.float32)
             block_rows = np.zeros((1, dim), dt)
-        sub = BKTIndex(value_type if value_type is not None
-                       else value_type_of(block_rows.dtype))
+        sub = create_instance(algo, value_type if value_type is not None
+                              else value_type_of(block_rows.dtype))
         sub.set_parameter("DistCalcMethod",
                           "Cosine" if self.metric == DistCalcMethod.Cosine
                           else "L2")

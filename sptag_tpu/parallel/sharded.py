@@ -399,10 +399,15 @@ class ShardedBKTIndex:
               value_type=None,
               params: Optional[dict] = None,
               dense: bool = False,
-              save_to: Optional[str] = None) -> "ShardedBKTIndex":
-        """Partition `data` into contiguous equal blocks, build one BKT
-        sub-index per shard (host-side, device-batched k-means/graph build),
-        and lay the per-shard arrays out over the mesh.
+              save_to: Optional[str] = None,
+              algo: str = "BKT") -> "ShardedBKTIndex":
+        """Partition `data` into contiguous equal blocks, build one
+        sub-index per shard (host-side, device-batched k-means/graph
+        build), and lay the per-shard arrays out over the mesh.
+
+        `algo` picks the shard index family: "BKT" (default) or "KDT"
+        (kd-tree forest shards — the walk seeds from each shard's fallback
+        pivot set, and `dense=True` cuts kd cells).
 
         `dense=True` additionally packs each shard's dense tree-partition
         layout so `search_dense` (the multi-chip throughput mode) is
@@ -413,8 +418,15 @@ class ShardedBKTIndex:
         under `save_to/shard_NNN` plus a `sharded.json` manifest, loadable
         with `ShardedBKTIndex.load` — the persistence story of the
         reference's one-Server-per-shard topology."""
-        from sptag_tpu.algo.bkt import BKTIndex
+        from sptag_tpu.core.index import create_instance
         from sptag_tpu.core.types import value_type_of
+
+        if str(algo).upper() not in ("BKT", "KDT"):
+            # fail before the expensive shard builds: the packer needs the
+            # graph-index composition (_graph/_pivot_ids/_dense_clusters)
+            raise ValueError(
+                f"sharded mesh indexes support BKT or KDT shards, not "
+                f"{algo!r}")
 
         mesh = mesh if mesh is not None else make_mesh()
         n_dev = mesh.devices.size
@@ -437,7 +449,7 @@ class ShardedBKTIndex:
                 # in the program without ever appearing in results
                 empty_shards.append(s)
                 block = np.zeros((1, data.shape[1]), data.dtype)
-            sub = BKTIndex(value_type)
+            sub = create_instance(algo, value_type)
             sub.set_parameter("DistCalcMethod",
                               "Cosine" if metric ==
                               DistCalcMethod.Cosine else "L2")

@@ -178,3 +178,23 @@ def test_sharded_save_load_roundtrip(tmp_path):
 
     with pytest.raises(ValueError):
         ShardedBKTIndex.load(folder, mesh=make_mesh(jax.devices()[:4]))
+
+
+def test_sharded_kdt_shards():
+    """algo="KDT" builds kd-tree forest shards: the walk seeds from each
+    shard's fallback pivots, dense mode cuts kd cells."""
+    data, queries = _corpus(n=1600, d=16, nq=32)
+    truth = _true_topk(data, queries, 10)
+    idx = ShardedBKTIndex.build(
+        data, DistCalcMethod.L2, mesh=make_mesh(), dense=True, algo="KDT",
+        params={"KDTNumber": 2, "TPTNumber": 4, "TPTLeafSize": 200,
+                "NeighborhoodSize": 16, "CEF": 64,
+                "MaxCheckForRefineGraph": 256, "RefineIterations": 1,
+                "MaxCheck": 1024})
+    _, ib = idx.search(queries, 10)
+    _, idn = idx.search_dense(queries, 10)
+    rb, rd = _recall(ib, truth), _recall(idn, truth)
+    assert rb >= 0.85, rb
+    assert rd >= 0.85, rd
+    d2, i2 = idx.search(data[:4], k=1)
+    assert list(i2[:, 0]) == [0, 1, 2, 3]
