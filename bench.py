@@ -33,7 +33,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".bench_cache")
 CACHE_VERSION = 3          # bump when index params/format change
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
-PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
 DEFAULT_BUDGET_S = 3000.0
 
 _t_start = time.time()
@@ -43,30 +43,38 @@ def _remaining(budget_s):
     return budget_s - (time.time() - _t_start)
 
 
-def probe_accelerator():
+def probe_accelerator(budget_s=float("inf")):
     """Initialize the default (TPU) backend in a subprocess with a hard
-    timeout; retry with backoff.  Returns the platform string or None —
-    PJRT init on the tunneled backend has been observed to hang
-    indefinitely, and a child process is the only safe place to find out."""
+    timeout; retry with backoff (round-3 hardening: 3 x 180 s attempts
+    before any CPU fallback — the tunnel has been observed to come back
+    between attempts).  Returns (platform|None, err, attempts_used) — PJRT
+    init on the tunneled backend can hang indefinitely, and a child
+    process is the only safe place to find out."""
     code = ("import jax, json; ds = jax.devices(); "
             "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))")
     last_err = ""
-    for attempt in range(PROBE_RETRIES):
+    for attempt in range(1, PROBE_RETRIES + 1):
+        if budget_s - (time.time() - _t_start) < PROBE_TIMEOUT_S + 120:
+            # keep enough budget for a measured CPU fallback rather than
+            # burning it all on a down tunnel
+            last_err += " | probe budget exhausted"
+            return None, last_err.strip(" |"), attempt - 1
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True, text=True,
                 timeout=PROBE_TIMEOUT_S)
             if out.returncode == 0 and out.stdout.strip():
                 info = json.loads(out.stdout.strip().splitlines()[-1])
-                return info["platform"], ""
+                return info["platform"], "", attempt
             last_err = (f"rc={out.returncode} "
                         f"stderr={out.stderr.strip()[-400:]}")
         except subprocess.TimeoutExpired:
             last_err = f"backend init timed out after {PROBE_TIMEOUT_S:.0f}s"
         except Exception as e:                       # noqa: BLE001
             last_err = repr(e)
-        time.sleep(2.0 * (attempt + 1))
-    return None, last_err
+        if attempt < PROBE_RETRIES:      # no pointless sleep after the last
+            time.sleep(10.0 * attempt)
+    return None, last_err, PROBE_RETRIES
 
 
 def make_dataset(n=200_000, d=128, nq=1000, seed=7, dtype=np.float32):
@@ -261,12 +269,14 @@ def run_bench():
 
     forced = os.environ.get("BENCH_PLATFORM")     # e.g. "cpu" to skip probe
     if forced:
-        platform, probe_err = (None, "forced") if forced == "cpu" \
-            else (forced, "")
+        platform, probe_err, attempts = (None, "forced", 0) \
+            if forced == "cpu" else (forced, "", 0)
     else:
-        platform, probe_err = probe_accelerator()
+        platform, probe_err, attempts = probe_accelerator(budget_s)
     result = {"metric": f"qps_per_chip_bkt_n{n}_d128_l2_recall@10",
               "value": 0.0, "unit": "qps", "vs_baseline": 0.0}
+    if attempts > 1 or (attempts and platform is None):
+        result["tpu_probe_attempts"] = attempts
 
     def checkpoint():
         """Stage results survive a watchdog kill: each completed stage

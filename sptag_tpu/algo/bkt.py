@@ -149,7 +149,9 @@ class BKTIndex(VectorIndex):
     def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:
         return GraphSearchEngine(self._host[:self._n], graph,
                                  self._pivot_ids(), self._deleted[:self._n],
-                                 self.dist_calc_method, self.base)
+                                 self.dist_calc_method, self.base,
+                                 score_dtype=getattr(
+                                     self.params, "beam_score_dtype", "auto"))
 
     def _get_engine(self) -> GraphSearchEngine:
         if self._dirty or self._engine is None:
@@ -305,27 +307,29 @@ class BKTIndex(VectorIndex):
 
     # ---- search -----------------------------------------------------------
 
-    def _search_batch(self, queries: np.ndarray,
-                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+    def _search_batch(self, queries: np.ndarray, k: int,
+                      max_check: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
         if self._n == 0:
             raise RuntimeError("index is empty")
         p = self.params
+        mc = max_check if max_check is not None else p.max_check
         if getattr(p, "search_mode", "beam") == "dense":
             d, ids = self._get_dense().search(
-                queries, min(k, self._n), max_check=p.max_check,
+                queries, min(k, self._n), max_check=mc,
                 group=getattr(p, "dense_query_group", 0),
                 union_factor=getattr(p, "dense_union_factor", 2))
         else:
-            d, ids = self._engine_search(queries, min(k, self._n))
+            d, ids = self._engine_search(queries, min(k, self._n), mc)
         return self._pad_results(d, ids, k)
 
-    def _engine_search(self, queries: np.ndarray, k: int
+    def _engine_search(self, queries: np.ndarray, k: int, max_check: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
         """Beam-walk branch of _search_batch; KDT overrides to seed from
         its kd-tree descent instead of the shared pivots."""
         p = self.params
         return self._get_engine().search(
-            queries, k, max_check=p.max_check,
+            queries, k, max_check=max_check,
             beam_width=getattr(p, "beam_width", 16),
             nbp_limit=p.no_better_propagation_limit,
             dynamic_pivots=p.other_dynamic_pivots)

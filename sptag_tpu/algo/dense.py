@@ -729,7 +729,10 @@ class DenseTreeSearcher:
         # misled bench configs)
         self.last_effective_group = G
         if group and int(group) > 1 and G != int(group):
-            key = (int(group), G, nq)
+            # keyed on (requested, effective) only — including nq would
+            # grow the set without bound in a long-lived server receiving
+            # many distinct batch sizes
+            key = (int(group), G)
             if key not in self._demotions:
                 self._demotions.add(key)
                 import logging

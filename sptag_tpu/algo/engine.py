@@ -111,6 +111,28 @@ def _mark_bits(words: jax.Array, ids: jax.Array) -> jax.Array:
         lambda row, t, v: row.at[t].set(v, mode="drop"))(words, target, val)
 
 
+def beam_width_for(beam_width: int, max_check: int, L: int) -> int:
+    """Budget-scaled beam width, shared by the single-chip and sharded
+    walks.  At high budgets wider pops cut the SERIAL iteration count
+    T = ceil(max_check/B) — the walk's real cost on TPU (roofline shows it
+    overhead-bound at ~3 GB/s, not bandwidth-bound) — with measured-flat
+    recall (B 16 -> 64 at MaxCheck 2048 on the 200k corpus: 0.8977 ->
+    0.8992).  `beam_width` is a FLOOR, never reduced: an explicitly tuned
+    BeamWidth above the auto cap of 64 is honored as-is."""
+    return max(1, min(max(beam_width, min(max_check // 64, 64)), L))
+
+
+def beam_pool_size(k: int, max_check: int, n: int,
+                   pool_size: Optional[int] = None) -> int:
+    """Budget-scaled beam (frontier) capacity, shared by the single-chip and
+    sharded search paths.  A fixed frontier saturates and flattens the
+    recall/MaxCheck curve (the reference's NG queue holds maxCheck*30 cells,
+    /root/reference/AnnService/inc/Core/Common/WorkSpace.h:182-208; measured
+    here: recall stuck at 0.82 from MaxCheck 512 to 8192 with L=64)."""
+    L = pool_size or max(2 * k, min(64 + max_check // 8, 1024))
+    return min(max(L, k), n)
+
+
 def _sorted_dup_mask(ids: jax.Array):
     """(Q, X) int -> (Q, X) bool, True on every occurrence of an id after
     the first (sort + inverse permutation)."""
@@ -131,7 +153,7 @@ def _sorted_dup_mask(ids: jax.Array):
 def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                         pivot_mask, queries, k: int, L: int, B: int, T: int,
                         metric: int, base: int, nbp_limit: int,
-                        inject: int = 4):
+                        inject: int = 4, data_score=None):
     """Shared-pivot seeding (BKT): one dense (Q, P) matmul scores the whole
     pivot set; the top-L pivots initialize every query's beam.  `pivot_mask`
     (W,) int32 is the precomputed packed bitset of the pivot ids.
@@ -168,7 +190,8 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
 
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
                  visited, k, L, B, T, metric, base, nbp_limit,
-                 spare_ids=spare_ids, spare_d=spare_d, inject=inject)
+                 spare_ids=spare_ids, spare_d=spare_d, inject=inject,
+                 data_score=data_score)
 
 
 @functools.partial(
@@ -176,7 +199,8 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
 def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
                                queries, k: int, L: int, B: int, T: int,
-                               metric: int, base: int, nbp_limit: int):
+                               metric: int, base: int, nbp_limit: int,
+                               data_score=None):
     """Per-query seeding (KDT): `seed_ids` (Q, S) come from a host-side tree
     descent per query (the reference's KDTSearch leaf seeding,
     KDTree.h:178-215); they are gathered and scored as one batched
@@ -205,7 +229,8 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
                          jnp.take_along_axis(seed_ids, pos, axis=1), -1)
 
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
-                 visited, k, L, B, T, metric, base, nbp_limit)
+                 visited, k, L, B, T, metric, base, nbp_limit,
+                 data_score=data_score)
 
 
 @functools.partial(
@@ -215,7 +240,7 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
 def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                          pivot_mask, queries3, k: int, L: int, B: int,
                          T: int, metric: int, base: int, nbp_limit: int,
-                         inject: int = 4):
+                         inject: int = 4, data_score=None):
     """(M, chunk, D) query chunks under one `lax.map` — a single device
     program for any batch size (one upload, one dispatch, one read; the
     tunneled backend costs ~60 ms per host round trip).  The per-chunk
@@ -224,7 +249,8 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     def body(q):
         return _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids,
                                    pivot_vecs, pivot_mask, q, k, L, B, T,
-                                   metric, base, nbp_limit, inject)
+                                   metric, base, nbp_limit, inject,
+                                   data_score=data_score)
     return jax.lax.map(body, queries3)
 
 
@@ -233,20 +259,34 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
 def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
                                 queries3, k: int, L: int, B: int, T: int,
-                                metric: int, base: int, nbp_limit: int):
+                                metric: int, base: int, nbp_limit: int,
+                                data_score=None):
     def body(args):
         s, q = args
         return _beam_search_seeded_kernel(data, sqnorm, graph, deleted, s,
                                           q, k, L, B, T, metric, base,
-                                          nbp_limit)
+                                          nbp_limit,
+                                          data_score=data_score)
     return jax.lax.map(body, (seeds3, queries3))
 
 
 def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
           k: int, L: int, B: int, T: int, metric: int, base: int,
-          nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0):
+          nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0,
+          data_score=None):
+    """`data_score`: optional low-precision (bf16) shadow of `data` used for
+    the in-loop candidate scoring — halves the dominant gather's HBM bytes
+    and doubles the MXU rate on TPU.  The loop's distances only ORDER the
+    beam; the final pool is re-ranked against the exact f32 rows before the
+    top-k, so returned distances (and the included/excluded boundary at k)
+    are computed at full precision."""
     Q = queries.shape[0]
     N = data.shape[0]
+    rerank = data_score is not None and data_score.dtype != data.dtype
+    score_src = data_score if data_score is not None else data
+    queries_s = (queries.astype(score_src.dtype)
+                 if queries.dtype != score_src.dtype and
+                 jnp.issubdtype(queries.dtype, jnp.floating) else queries)
     Ps = 0 if spare_ids is None else spare_ids.shape[1]
     use_spares = Ps > 0 and inject > 0
     # only REAL spare entries count as remaining work — the spare queue is
@@ -316,10 +356,10 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
 
         # ---- score fresh candidates (one batched contraction) -------------
         gather_idx = jnp.where(fresh, flat, 0)
-        cvecs = data[gather_idx]                                 # (Q, C, D)
+        cvecs = score_src[gather_idx]                            # (Q, C, D)
         csq = sqnorm[gather_idx]
         nd = dist_ops.batched_gathered_distance(
-            queries, cvecs, DistCalcMethod(metric), base, csq)
+            queries_s, cvecs, DistCalcMethod(metric), base, csq)
         nd = jnp.where(fresh, nd, MAX_DIST)
 
         # ---- mid-walk re-seed: inject spare pivots when the frontier falls
@@ -373,6 +413,14 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
              jnp.int32(0))
     cand_ids, cand_d, *_ = jax.lax.while_loop(cond, body, state)
 
+    if rerank:
+        # exact f32 re-rank of the final L-pool: one (Q, L, D) gather —
+        # about the cost of a single loop iteration's candidate gather
+        safe = jnp.maximum(cand_ids, 0)
+        exact = dist_ops.batched_gathered_distance(
+            queries, data[safe], DistCalcMethod(metric), base, sqnorm[safe])
+        cand_d = jnp.where(cand_ids >= 0, exact, MAX_DIST)
+
     # ---- final top-k with tombstones filtered -----------------------------
     dead = deleted[jnp.maximum(cand_ids, 0)] | (cand_ids < 0)
     out_d = jnp.where(dead, MAX_DIST, cand_d)
@@ -391,13 +439,30 @@ class GraphSearchEngine:
 
     def __init__(self, data: np.ndarray, graph: np.ndarray,
                  pivot_ids: np.ndarray, deleted: Optional[np.ndarray],
-                 metric: DistCalcMethod, base: int):
+                 metric: DistCalcMethod, base: int,
+                 score_dtype: str = "auto"):
         n = data.shape[0]
         assert graph.shape[0] == n, (graph.shape, n)
         self.n = n
         self.metric = DistCalcMethod(metric)
         self.base = base
         self.data = jnp.asarray(data)
+        # bf16 shadow corpus for in-loop scoring (BeamScoreDtype param):
+        # halves the walk's dominant gather bytes and doubles the MXU rate
+        # at +50% corpus HBM.  "auto" = bf16 on TPU only — CPU's bf16
+        # matmuls are emulated (slower) and the tests assert exact-f32
+        # distances there.  The final pool is re-ranked in f32 (_walk), so
+        # returned distances are exact either way; int corpora ignore this
+        # (int8 gathers are already 4x smaller than f32).
+        if score_dtype == "auto":
+            try:
+                score_dtype = ("bf16" if jax.devices()[0].platform == "tpu"
+                               else "f32")
+            except Exception:                           # noqa: BLE001
+                score_dtype = "f32"
+        self.data_score = (self.data.astype(jnp.bfloat16)
+                           if score_dtype == "bf16"
+                           and self.data.dtype == jnp.float32 else None)
         self.sqnorm = jax.jit(dist_ops.row_sqnorms)(self.data)
         self.graph = jnp.asarray(graph.astype(np.int32, copy=False))
         if deleted is None:
@@ -436,13 +501,8 @@ class GraphSearchEngine:
             queries = queries[None, :]
         nq = queries.shape[0]
         k_eff = min(k, self.n)
-        # pool (beam) capacity scales with the budget — a fixed frontier
-        # saturates and flattens the recall/MaxCheck curve (the reference's
-        # NG queue holds maxCheck*30 cells, WorkSpace.h:182-208; measured
-        # here: recall stuck at 0.82 from MaxCheck 512 to 8192 with L=64)
-        L = pool_size or max(2 * k_eff, min(64 + max_check // 8, 1024))
-        L = min(max(L, k_eff), self.n)
-        B = max(1, min(beam_width, L))
+        L = beam_pool_size(k_eff, max_check, self.n, pool_size)
+        B = beam_width_for(beam_width, max_check, L)
         T = max(1, -(-max_check // B))
         # continuous no-better-propagation limit: maxCheck/64 pops in the
         # reference (WorkSpace.h:191), aggregated B pops per iteration here
@@ -465,7 +525,7 @@ class GraphSearchEngine:
                     self.pivot_ids, self.pivot_vecs, self.pivot_mask,
                     jnp.asarray(q),
                     k_eff, L, B, T, int(self.metric), self.base, limit,
-                    inject=dynamic_pivots)
+                    inject=dynamic_pivots, data_score=self.data_score)
             else:
                 s = seeds.astype(np.int32, copy=False)
                 if q_pad != nq:
@@ -475,7 +535,8 @@ class GraphSearchEngine:
                 d, ids = _beam_search_seeded_kernel(
                     self.data, self.sqnorm, self.graph, self.deleted,
                     jnp.asarray(s), jnp.asarray(q),
-                    k_eff, L, B, T, int(self.metric), self.base, limit)
+                    k_eff, L, B, T, int(self.metric), self.base, limit,
+                    data_score=self.data_score)
             out_d[:, :k_eff] = np.asarray(d)[:nq]
             out_i[:, :k_eff] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -493,7 +554,7 @@ class GraphSearchEngine:
                 self.pivot_ids, self.pivot_vecs, self.pivot_mask,
                 jnp.asarray(q.reshape(m, chunk, D)),
                 k_eff, L, B, T, int(self.metric), self.base, limit,
-                inject=dynamic_pivots)
+                inject=dynamic_pivots, data_score=self.data_score)
         else:
             s = seeds.astype(np.int32, copy=False)
             if m * chunk != nq:
@@ -504,7 +565,8 @@ class GraphSearchEngine:
                 self.data, self.sqnorm, self.graph, self.deleted,
                 jnp.asarray(s.reshape(m, chunk, -1)),
                 jnp.asarray(q.reshape(m, chunk, D)),
-                k_eff, L, B, T, int(self.metric), self.base, limit)
+                k_eff, L, B, T, int(self.metric), self.base, limit,
+                data_score=self.data_score)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :k_eff] = d[:nq]

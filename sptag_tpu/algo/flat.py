@@ -161,10 +161,12 @@ class FlatIndex(VectorIndex):
 
     # ---- search -----------------------------------------------------------
 
-    def _search_batch(self, queries: np.ndarray,
-                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+    def _search_batch(self, queries: np.ndarray, k: int,
+                      max_check: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
         if self._n == 0:
             raise RuntimeError("index is empty")
+        del max_check                      # exact scan: no budget to bound
         data_d, sqnorm_d, invalid_d = self._snapshot()
         q = queries.shape[0]
         q_pad = _query_bucket(q)

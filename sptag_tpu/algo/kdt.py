@@ -21,7 +21,7 @@ type, seeding, and parameter registry differ.
 from __future__ import annotations
 
 import logging
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -73,8 +73,10 @@ class KDTIndex(BKTIndex):
         per_tree = max(max_check // 10, p.initial_dynamic_pivots) // trees
         return int(np.clip(per_tree, _MIN_BACKTRACK, 64))
 
-    def _seeds_for(self, queries: np.ndarray) -> np.ndarray:
-        backtrack = self._backtrack_for(self.params.max_check)
+    def _seeds_for(self, queries: np.ndarray,
+                   max_check: Optional[int] = None) -> np.ndarray:
+        backtrack = self._backtrack_for(
+            max_check if max_check is not None else self.params.max_check)
         return self._tree.collect_seeds(queries, backtrack=backtrack)
 
     def _partition_tree(self):
@@ -86,12 +88,12 @@ class KDTIndex(BKTIndex):
         return partition_from_kdtree(self._tree, self._n,
                                      self.params.dense_cluster_size)
 
-    def _engine_search(self, queries: np.ndarray, k: int
+    def _engine_search(self, queries: np.ndarray, k: int, max_check: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
         p = self.params
-        seeds = self._seeds_for(queries)
+        seeds = self._seeds_for(queries, max_check)
         return self._get_engine().search(
-            queries, k, max_check=p.max_check,
+            queries, k, max_check=max_check,
             beam_width=getattr(p, "beam_width", 16),
             nbp_limit=p.no_better_propagation_limit, seeds=seeds)
 

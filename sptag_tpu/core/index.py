@@ -37,7 +37,7 @@ from sptag_tpu.core.types import (
     dtype_of,
     enum_from_string,
 )
-from sptag_tpu.core.vectorset import MetadataSet, VectorSet
+from sptag_tpu.core.vectorset import MetadataSet, VectorSet, metas_for
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.utils.ini import IniReader
 
@@ -115,10 +115,13 @@ class VectorIndex(abc.ABC):
         """Build index structures over `data` (already normalized if cosine)."""
 
     @abc.abstractmethod
-    def _search_batch(self, queries: np.ndarray,
-                      k: int) -> Tuple[np.ndarray, np.ndarray]:
+    def _search_batch(self, queries: np.ndarray, k: int,
+                      max_check: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
         """(Q, D) queries (already normalized if cosine) -> ((Q, K) dists,
-        (Q, K) int32 ids), ascending, -1/MAX_DIST padded, excluding deleted."""
+        (Q, K) int32 ids), ascending, -1/MAX_DIST padded, excluding deleted.
+        `max_check` overrides the MaxCheck parameter for this call (budgeted
+        indexes only; exact indexes ignore it)."""
 
     @abc.abstractmethod
     def _add(self, data: np.ndarray) -> int:
@@ -213,20 +216,22 @@ class VectorIndex(abc.ABC):
                 mapping[self.metadata.get_metadata(i)] = i
         self._meta_to_vec = mapping
 
-    def search(self, query, k: int = 10,
-               with_metadata: bool = False) -> SearchResult:
-        dists, ids = self.search_batch(np.asarray(query)[None, :], k)
-        metas = None
-        if with_metadata and self.metadata is not None:
-            metas = [self.metadata.get_metadata(int(v)) if v >= 0 else b""
-                     for v in ids[0]]
+    def search(self, query, k: int = 10, with_metadata: bool = False,
+               max_check: Optional[int] = None) -> SearchResult:
+        dists, ids = self.search_batch(np.asarray(query)[None, :], k,
+                                       max_check=max_check)
+        metas = (metas_for(self.metadata, ids[0])
+                 if with_metadata else None)
         return SearchResult(ids[0], dists[0], metas)
 
-    def search_batch(self, queries: np.ndarray,
-                     k: int = 10) -> Tuple[np.ndarray, np.ndarray]:
+    def search_batch(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None
+                     ) -> Tuple[np.ndarray, np.ndarray]:
         """Batch search: the whole (Q, D) block is one device program —
         replaces the reference's OpenMP parallel-for over queries
-        (VectorIndex.cpp:212-220)."""
+        (VectorIndex.cpp:212-220).  `max_check` overrides the MaxCheck
+        parameter for this call only (stateless — safe under concurrent
+        searches, unlike set_parameter)."""
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
@@ -234,7 +239,7 @@ class VectorIndex(abc.ABC):
             raise ValueError(
                 f"query dim {queries.shape[1]} != index dim {self.feature_dim}")
         queries = self._prepare_query(queries)
-        return self._search_batch(queries, k)
+        return self._search_batch(queries, k, max_check)
 
     def _prepare_query(self, queries: np.ndarray) -> np.ndarray:
         """Queries are normalized for cosine, like the reference harness does

@@ -111,6 +111,11 @@ _COMMON_TAIL_SPECS = [
     # once and runs ceil(MaxCheck/B) iterations).  Larger B = fewer,
     # fatter device steps (throughput) but coarser budget granularity
     _spec("beam_width", int, 16, "BeamWidth"),
+    # TPU-only: dtype of the walk's in-loop candidate scoring.  "auto" =
+    # bf16 shadow corpus on TPU (half the gather bytes, 2x MXU rate; the
+    # final pool is re-ranked in exact f32), "f32" elsewhere.  Explicit
+    # "bf16"/"f32" forces either.
+    _spec("beam_score_dtype", str, "auto", "BeamScoreDtype"),
 ]
 
 _FILE_SPECS = [

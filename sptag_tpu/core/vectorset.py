@@ -64,6 +64,17 @@ class VectorSet:
         return cls(data, value_type)
 
 
+def metas_for(metadata: Optional["MetadataSet"],
+              ids) -> Optional[List[bytes]]:
+    """Result metadata for one query's id row: b"" for -1 padding
+    sentinels, None when there is no store.  The single place encoding
+    this convention — shared by VectorIndex.search, the executor's batch
+    path, and the mesh ServingAdapter so the wire paths cannot diverge."""
+    if metadata is None:
+        return None
+    return [metadata.get_metadata(int(v)) if v >= 0 else b"" for v in ids]
+
+
 class MetadataSet:
     """Per-vector opaque byte payloads.
 

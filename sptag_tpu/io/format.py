@@ -84,18 +84,27 @@ def read_graph(path_or_stream) -> np.ndarray:
 
 
 def write_deletes(path_or_stream, mask: np.ndarray) -> None:
-    """mask: (N,) bool/int8 tombstone flags."""
-    flags = np.ascontiguousarray(mask.astype(np.int8)).reshape(-1, 1)
+    """mask: (N,) bool/int8 tombstone flags.
+
+    Byte convention (verified against an index built by the reference
+    indexbuilder, round 3): deleted rows store 1, LIVE rows store -1 —
+    the Labelset's backing Dataset<int8> is memset to -1 at Initialize
+    (inc/Core/Common/Dataset.h:65) and Insert flips a cell to 1
+    (inc/Core/Common/Labelset.h:39-45)."""
+    m = mask.astype(bool).reshape(-1, 1)
+    flags = np.where(m, np.int8(1), np.int8(-1))
     with open_write(path_or_stream) as f:
-        f.write(np.int32(int(flags.sum())).tobytes())
-        write_matrix(f, flags)
+        f.write(np.int32(int(m.sum())).tobytes())
+        write_matrix(f, np.ascontiguousarray(flags))
 
 
 def read_deletes(path_or_stream) -> np.ndarray:
     with open_read(path_or_stream) as f:
         f.read(4)  # deleted count; recomputed from the flags
         flags = read_matrix(f, np.int8)
-    return flags.reshape(-1).astype(bool)
+    # deleted iff exactly 1 (Labelset::Contains, Labelset.h:34-37); the
+    # -1 fill bytes of live rows must NOT read as tombstones
+    return (flags.reshape(-1) == 1)
 
 
 def write_tree_forest(path_or_stream, tree_starts: np.ndarray,

@@ -76,6 +76,14 @@ def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
     float before the horizontal add): an int32 accumulator overflows on
     raw int16 L2 data (a single product reaches 2^30).  Floats contract
     in float32 on the MXU.
+
+    Exactness caveat vs the reference on int16: _mm_madd_epi16 computes
+    each int16 product PAIR exactly in int32 before its float horizontal
+    add, while this path rounds each individual product to float32
+    (32767^2 needs 30 mantissa bits, float32 has 24) — distances can
+    deviate by a few ULPs on raw int16 data near ties.  Accepted: the
+    deviation cannot flip a ranking beyond genuine near-ties, and an
+    int32 pair-sum emulation would halve MXU throughput.
     """
     dn = (((1,), (1,)), ((), ()))
     if exact_int_dot(q.dtype):
@@ -171,15 +179,22 @@ def batched_gathered_distance(q: jax.Array, cand: jax.Array,
             cf = cand.astype(jnp.float32)
             cand_sqnorm = jnp.sum(cf * cf, axis=-1)
         return jnp.maximum(qn + cand_sqnorm - 2.0 * dot, 0.0)
-    qf = q.astype(jnp.float32)
-    cf = cand.astype(jnp.float32)
+    if q.dtype == jnp.bfloat16 and cand.dtype == jnp.bfloat16:
+        # bf16 walk-scoring path (engine BeamScoreDtype=bf16): contract the
+        # native bf16 inputs on the MXU with f32 accumulation — half the
+        # gather bytes of the f32 path; callers re-rank the final pool in
+        # f32 so result distances stay exact
+        qf, cf = q, cand
+    else:
+        qf = q.astype(jnp.float32)
+        cf = cand.astype(jnp.float32)
     dot = jnp.einsum("qd,qcd->qc", qf, cf, precision=_FLOAT_PRECISION,
                      preferred_element_type=jnp.float32)
     if metric == int(DistCalcMethod.Cosine):
         return 1.0 - dot
-    qn = jnp.sum(qf * qf, axis=-1)[:, None]
+    qn = jnp.sum(qf.astype(jnp.float32) ** 2, axis=-1)[:, None]
     if cand_sqnorm is None:
-        cand_sqnorm = jnp.sum(cf * cf, axis=-1)
+        cand_sqnorm = jnp.sum(cf.astype(jnp.float32) ** 2, axis=-1)
     return jnp.maximum(qn + cand_sqnorm - 2.0 * dot, 0.0)
 
 

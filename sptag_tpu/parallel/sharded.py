@@ -260,7 +260,7 @@ class ServingAdapter:
     frontend's own store if needed)."""
 
     def __init__(self, sharded, feature_dim: int, value_type=None,
-                 mode: str = "beam"):
+                 mode: str = "beam", metadata=None):
         from sptag_tpu.core.types import VectorValueType, value_type_of
 
         self._impl = sharded
@@ -269,7 +269,16 @@ class ServingAdapter:
                            if value_type is not None
                            else value_type_of(np.dtype(
                                sharded.data.dtype)))
-        self.metadata = None
+        # frontend metadata store, keyed by GLOBAL row id (the mesh search
+        # returns original corpus ids): explicit argument wins, else the
+        # store the mesh index was built/loaded with.  The reference
+        # topology attaches metadata per Server shard
+        # (/root/reference/AnnService/src/Socket/RemoteSearchQuery.cpp:
+        # 94-210, src/Server/SearchService.cpp:205-262); here one frontend
+        # store is equivalent because shard-local ids are already remapped
+        # to global ids inside the merge kernel.
+        self.metadata = (metadata if metadata is not None
+                         else getattr(sharded, "metadata", None))
         # "dense" serves the multi-chip block scan (requires the index
         # built with dense=True); "beam" the per-shard walk
         if mode not in ("beam", "dense"):
@@ -287,23 +296,30 @@ class ServingAdapter:
     def num_samples(self) -> int:
         return self._impl.n
 
-    def search_batch(self, queries: np.ndarray, k: int = 10
+    def search_batch(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None
                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """`max_check` overrides the build-time budget per request in both
+        serving modes (reachable over the wire via the framework's
+        `$maxcheck` query option — an extension; the reference has no
+        per-request budget knob, serve/protocol.py docstring)."""
         if self.mode == "dense":
-            return self._impl.search_dense(np.asarray(queries), k=k)
-        return self._impl.search(np.asarray(queries), k=k)
+            return self._impl.search_dense(np.asarray(queries), k=k,
+                                           max_check=max_check)
+        return self._impl.search(np.asarray(queries), k=k,
+                                 max_check=max_check)
 
-    def search(self, query, k: int = 10, with_metadata: bool = False):
+    def search(self, query, k: int = 10, with_metadata: bool = False,
+               max_check: Optional[int] = None):
         from sptag_tpu.core.index import SearchResult
 
         q = np.asarray(query)
         if q.ndim == 1:
             q = q[None, :]
-        d, ids = self.search_batch(q, k=k)
-        # metas stays None even for with_metadata: this adapter has no
-        # metadata store (self.metadata is None), and the batch path
-        # already returns none in that case — the two paths must agree
-        return SearchResult(ids=ids[0], dists=d[0], metas=None)
+        d, ids = self.search_batch(q, k=k, max_check=max_check)
+        from sptag_tpu.core.vectorset import metas_for
+        metas = metas_for(self.metadata, ids[0]) if with_metadata else None
+        return SearchResult(ids=ids[0], dists=d[0], metas=metas)
 
 
 def pack_shard_block(sub, n_local: int, dim: int, m_width: int, max_p: int,
@@ -360,6 +376,7 @@ class ShardedBKTIndex:
         self.max_check = 2048
         self.nbp_limit = 3
         self.beam_width = 16
+        self.metadata = None
 
     @classmethod
     def load(cls, folder: str,
@@ -382,9 +399,18 @@ class ShardedBKTIndex:
                 f"has {meta['n_shards']} shards")
         subs = [load_index(os.path.join(folder, f"shard_{s:03d}"))
                 for s in range(meta["n_shards"])]
-        return cls._assemble(subs, meta["n"], meta["dim"],
+        self = cls._assemble(subs, meta["n"], meta["dim"],
                              DistCalcMethod(meta["metric"]), mesh,
                              meta.get("empty_shards", []), dense)
+        # frontend metadata (global-id keyed), persisted at the mesh-folder
+        # top level by build(..., metadata=...); lazy file-backed so a
+        # LAION-class blob is not pulled resident
+        mpath = os.path.join(folder, "metadata.bin")
+        ipath = os.path.join(folder, "metadataIndex.bin")
+        if os.path.exists(mpath) and os.path.exists(ipath):
+            from sptag_tpu.core.vectorset import FileMetadataSet
+            self.metadata = FileMetadataSet(mpath, ipath)
+        return self
 
     def save(self, folder: str) -> None:
         raise NotImplementedError(
@@ -400,7 +426,8 @@ class ShardedBKTIndex:
               params: Optional[dict] = None,
               dense: bool = False,
               save_to: Optional[str] = None,
-              algo: str = "BKT") -> "ShardedBKTIndex":
+              algo: str = "BKT",
+              metadata=None) -> "ShardedBKTIndex":
         """Partition `data` into contiguous equal blocks, build one
         sub-index per shard (host-side, device-batched k-means/graph
         build), and lay the per-shard arrays out over the mesh.
@@ -417,7 +444,13 @@ class ShardedBKTIndex:
         `save_to` persists every sub-index as a reference-format folder
         under `save_to/shard_NNN` plus a `sharded.json` manifest, loadable
         with `ShardedBKTIndex.load` — the persistence story of the
-        reference's one-Server-per-shard topology."""
+        reference's one-Server-per-shard topology.
+
+        `metadata` (a MetadataSet over the FULL corpus, row-aligned with
+        `data`) is held at the frontend keyed by global id — the mesh
+        search returns original corpus ids, so one store serves all
+        shards; persisted in reference metadata.bin/metadataIndex.bin
+        format at the mesh-folder top level when `save_to` is given."""
         from sptag_tpu.core.index import create_instance
         from sptag_tpu.core.types import value_type_of
 
@@ -473,9 +506,29 @@ class ShardedBKTIndex:
                            "dim": int(data.shape[1]),
                            "metric": int(metric),
                            "empty_shards": empty_shards}, f)
+            # metadata is staged (tmp + rename) BEFORE the manifest
+            # replace — the manifest is the commit point, so everything it
+            # vouches for must already be durable; a rebuild without
+            # metadata removes stale files so load() can't serve the
+            # previous corpus's payloads
+            mpath = os.path.join(save_to, "metadata.bin")
+            ipath = os.path.join(save_to, "metadataIndex.bin")
+            if metadata is not None:
+                metadata.save(mpath + f".tmp.{os.getpid()}",
+                              ipath + f".tmp.{os.getpid()}")
+                os.replace(mpath + f".tmp.{os.getpid()}", mpath)
+                os.replace(ipath + f".tmp.{os.getpid()}", ipath)
+            else:
+                for p in (mpath, ipath):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
             os.replace(tmp, manifest)
-        return cls._assemble(shard_indexes, n, int(data.shape[1]), metric,
+        self = cls._assemble(shard_indexes, n, int(data.shape[1]), metric,
                              mesh, empty_shards, dense)
+        self.metadata = metadata
+        return self
 
     @classmethod
     def _assemble(cls, shard_indexes, n: int, dim: int,
@@ -635,9 +688,9 @@ class ShardedBKTIndex:
         n_dev = self.mesh.devices.size
         k_local = min(k, self.n_local)     # per-shard beam cap
         k_final = min(k, self.n, k_local * n_dev)   # global merge cap
-        L = pool_size or max(2 * k_local, 64)
-        L = min(max(L, k_local), self.n_local)
-        B = max(1, min(beam_width, L))
+        from sptag_tpu.algo.engine import beam_pool_size, beam_width_for
+        L = beam_pool_size(k_local, max_check, self.n_local, pool_size)
+        B = beam_width_for(beam_width, max_check, L)
         T = max(1, -(-max_check // B))
         limit = max(self.nbp_limit, (max_check // 64) // B, 1)
         d, ids = _sharded_beam_kernel(

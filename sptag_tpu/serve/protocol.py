@@ -12,6 +12,11 @@ QueryParser.cpp:28-181) and SearchExecutionContext option extraction
   configured separator (default ``|``);
 * recognized options: ``indexname`` (comma-separated list), ``datatype``
   (Int8/UInt8/Int16/Float), ``extractmetadata`` (true/false), ``resultnum``.
+
+Framework extension beyond the reference's four options: ``maxcheck``
+overrides the index's MaxCheck search budget per request (the reference can
+only change MaxCheck index-wide via SetParameter; per-request budget is the
+knob its IndexSearcher sweeps offline, src/IndexSearcher/main.cpp:66-228).
 """
 
 from __future__ import annotations
@@ -62,6 +67,17 @@ class ParsedQuery:
             return int(raw) if raw is not None else None
         except ValueError:
             return None
+
+    @property
+    def max_check(self) -> Optional[int]:
+        """Per-request search budget override (framework extension; see
+        module docstring).  None = use the index's MaxCheck parameter."""
+        raw = self.options.get("maxcheck")
+        try:
+            v = int(raw) if raw is not None else None
+        except ValueError:
+            return None
+        return v if v is not None and v > 0 else None
 
     def extract_vector(self, value_type: VectorValueType,
                        separator: str = DEFAULT_SEPARATOR

@@ -27,14 +27,26 @@ from sptag_tpu.utils import trace
 log = logging.getLogger(__name__)
 
 
+#: hard ceiling on a packet's declared body size.  The header's body_length
+#: is attacker-controlled; without a cap one hostile 16-byte header makes
+#: readexactly() buffer multi-GB.  64 MiB comfortably covers the largest
+#: legitimate body (a max_batch x dim float32 query block).
+MAX_BODY_LENGTH = 64 << 20
+
+
 class SearchServer:
     def __init__(self, context: ServiceContext,
                  batch_window_ms: float = 2.0,
-                 max_batch: int = 1024):
+                 max_batch: int = 1024,
+                 max_connections: int = 256):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
         self.max_batch = max_batch
+        # reference parity: ConnectionManager hands out at most 256
+        # connection slots (/root/reference/AnnService/inc/Socket/
+        # ConnectionManager.h:23-67); excess clients are closed at accept
+        self.max_connections = max_connections
         self._next_cid = 1
         self._conns: Dict[int, asyncio.StreamWriter] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
@@ -64,6 +76,13 @@ class SearchServer:
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        if len(self._conns) >= self.max_connections:
+            # slot table full — close at accept, like the reference's
+            # ConnectionManager returning no slot
+            log.warning("connection limit (%d) reached; rejecting client",
+                        self.max_connections)
+            writer.close()
+            return
         cid = self._next_cid
         self._next_cid += 1
         self._conns[cid] = writer
@@ -71,11 +90,19 @@ class SearchServer:
             while True:
                 head = await reader.readexactly(wire.HEADER_SIZE)
                 header = wire.PacketHeader.unpack(head)
+                if not 0 <= header.body_length <= MAX_BODY_LENGTH:
+                    log.warning("cid %d: body_length %d exceeds cap; "
+                                "closing", cid, header.body_length)
+                    break
                 body = (await reader.readexactly(header.body_length)
                         if header.body_length else b"")
                 await self._dispatch(cid, writer, header, body)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
+        except Exception:                                    # noqa: BLE001
+            # malformed header/body must cost only THIS connection, never
+            # the server: log and drop the client
+            log.exception("cid %d: malformed packet; closing", cid)
         finally:
             self._conns.pop(cid, None)
             writer.close()

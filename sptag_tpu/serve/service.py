@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from sptag_tpu.core.index import VectorIndex, load_index
+from sptag_tpu.core.vectorset import metas_for
 from sptag_tpu.serve.protocol import (
     DEFAULT_SEPARATOR,
     ParsedQuery,
@@ -45,6 +46,9 @@ class ServiceSettings:
     socket_thread_num: int = 8
     default_max_result: int = 10
     vector_separator: str = DEFAULT_SEPARATOR
+    # ceiling for the wire-reachable $maxcheck override: unbounded, one
+    # request could pin the device with ceil(max_check/B) beam iterations
+    max_check_limit: int = 65536
 
 
 class ServiceContext:
@@ -100,6 +104,23 @@ class SearchExecutor:
         parsed = parse_query(query_text)
         return self._run(parsed)
 
+    def _sanitize_max_check(self, parsed: ParsedQuery) -> Optional[int]:
+        """Clamp the wire-reachable $maxcheck to the service ceiling and
+        round UP to a power of two: the budget feeds static kernel shape
+        parameters (L, T), so unquantized values would mint a fresh XLA
+        compile per distinct request value — unbounded compile-cache
+        growth in a long-lived server (rounding up never lowers the
+        recall the client asked for)."""
+        mc = parsed.max_check
+        if mc is None:
+            return None
+        if mc > 1:
+            mc = 1 << (mc - 1).bit_length()
+        # clamp AFTER quantizing: rounding up must never exceed the
+        # configured ceiling (a non-power-of-two limit admits at most one
+        # extra compiled shape — the limit itself)
+        return min(mc, self.context.settings.max_check_limit)
+
     def _select_indexes(self, parsed: ParsedQuery) -> Dict[str, VectorIndex]:
         names = parsed.index_names
         if not names:
@@ -126,7 +147,8 @@ class SearchExecutor:
             try:
                 res = index.search(vec.astype(
                     np.dtype(vec.dtype), copy=False), k=k,
-                    with_metadata=parsed.extract_metadata)
+                    with_metadata=parsed.extract_metadata,
+                    max_check=self._sanitize_max_check(parsed))
             except Exception:
                 log.exception("search failed on index %s", name)
                 return RemoteSearchResult(ResultStatus.FailedExecute, [])
@@ -147,9 +169,9 @@ class SearchExecutor:
             sel = tuple(sorted(self._select_indexes(p)))
             key = (sel, p.result_num
                    or self.context.settings.default_max_result,
-                   p.extract_metadata)
+                   p.extract_metadata, self._sanitize_max_check(p))
             groups.setdefault(key, []).append(i)
-        for (sel, k, with_meta), idxs in groups.items():
+        for (sel, k, with_meta, max_check), idxs in groups.items():
             if not sel:
                 for i in idxs:
                     results[i] = RemoteSearchResult(
@@ -172,7 +194,8 @@ class SearchExecutor:
                 if not ok:
                     continue
                 try:
-                    dists, ids = index.search_batch(np.stack(vecs), k)
+                    dists, ids = index.search_batch(np.stack(vecs), k,
+                                                    max_check=max_check)
                 except Exception:
                     log.exception("batch search failed on index %s", name)
                     for i in ok:
@@ -180,10 +203,8 @@ class SearchExecutor:
                             ResultStatus.FailedExecute, [])
                     continue
                 for row, i in enumerate(ok):
-                    metas = None
-                    if with_meta and index.metadata is not None:
-                        metas = [index.metadata.get_metadata(int(v))
-                                 if v >= 0 else b"" for v in ids[row]]
+                    metas = (metas_for(index.metadata, ids[row])
+                             if with_meta else None)
                     if results[i] is None:
                         results[i] = RemoteSearchResult(
                             ResultStatus.Success, [])

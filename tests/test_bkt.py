@@ -114,3 +114,92 @@ def test_bkt_add_triggers_tree_rebuild():
     assert index._adds_since_rebuild == 0   # rebuild fired
     d, ids = index.search_batch(new[:4], 1)
     assert (ids[:, 0] >= 300).all()
+
+
+def test_bkt_beam_bf16_scoring_matches_f32():
+    """BeamScoreDtype=bf16 (the TPU walk-scoring shadow corpus): recall
+    must match the f32 walk and returned distances must be EXACT f32 —
+    the final pool is re-ranked against the full-precision rows
+    (engine._walk), so approximation stays confined to beam ORDERING."""
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((4000, 32)).astype(np.float32)
+    queries = rng.standard_normal((32, 32)).astype(np.float32)
+    dn = (data ** 2).sum(1)
+    truth = np.argsort(dn[None, :] - 2 * (queries @ data.T), axis=1)[:, :10]
+
+    def build(score_dtype):
+        idx = sp.create_instance("BKT", "Float")
+        idx.set_parameter("DistCalcMethod", "L2")
+        for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                            ("TPTNumber", "2"), ("TPTLeafSize", "200"),
+                            ("NeighborhoodSize", "16"), ("CEF", "64"),
+                            ("MaxCheckForRefineGraph", "256"),
+                            ("RefineIterations", "1"), ("MaxCheck", "1024"),
+                            ("SearchMode", "beam"),
+                            ("BeamScoreDtype", score_dtype)]:
+            idx.set_parameter(name, value)
+        idx.build(data)
+        return idx
+
+    def recall(ids):
+        return np.mean([len(set(ids[i, :10]) & set(truth[i])) / 10
+                        for i in range(len(truth))])
+
+    d32, i32 = build("f32").search_batch(queries, 10)
+    d16, i16 = build("bf16").search_batch(queries, 10)
+    assert abs(recall(i16) - recall(i32)) <= 0.02, (recall(i16), recall(i32))
+    # exact-distance guarantee of the rerank
+    for r in range(8):
+        for c in range(10):
+            if i16[r, c] >= 0:
+                exact = float(((queries[r] - data[i16[r, c]]) ** 2).sum())
+                assert abs(float(d16[r, c]) - exact) < 1e-2
+
+
+def test_bkt_int8_beam_mode_recall():
+    """int8 cosine BEAM path (round-2 verdict: the int8 config was only
+    ever benched in dense mode) — the walk must hit the same exact-integer
+    ground truth the dense path is held to."""
+    from sptag_tpu.ops.distance import normalize
+
+    rng = np.random.default_rng(2)
+    raw = rng.standard_normal((4000, 64)).astype(np.float32)
+    data = np.clip(np.round(
+        raw / np.linalg.norm(raw, axis=1, keepdims=True) * 127),
+        -128, 127).astype(np.int8)
+    queries = data[rng.integers(0, len(data), 32)]
+    stored = normalize(data, 127).astype(np.int64)
+    qn = normalize(queries, 127).astype(np.int64)
+    truth = np.argsort(-(qn @ stored.T), axis=1)[:, :10]
+    idx = sp.create_instance("BKT", "Int8")
+    idx.set_parameter("DistCalcMethod", "Cosine")
+    idx.set_parameter("SearchMode", "beam")
+    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "200"),
+                        ("NeighborhoodSize", "16"), ("CEF", "64"),
+                        ("MaxCheckForRefineGraph", "256"),
+                        ("RefineIterations", "1"), ("MaxCheck", "1024")]:
+        idx.set_parameter(name, value)
+    idx.build(data)
+    _, ids = idx.search_batch(queries, 10)
+    r = np.mean([len(set(ids[i, :10]) & set(truth[i])) / 10
+                 for i in range(len(truth))])
+    assert r >= 0.9, r
+
+
+def test_beam_width_budget_scaling():
+    """B widens with MaxCheck (fewer serial device iterations at high
+    budgets, measured recall-neutral): the floor is the caller's
+    BeamWidth (NEVER reduced, even above the auto cap of 64), the
+    auto-scaled part caps at 64, and L bounds everything."""
+    from sptag_tpu.algo.engine import beam_pool_size, beam_width_for
+
+    def beff(beam_width, max_check, n=100_000, k=10):
+        return beam_width_for(beam_width, max_check,
+                              beam_pool_size(k, max_check, n))
+
+    assert beff(16, 512) == 16          # floor holds at small budgets
+    assert beff(16, 2048) == 32
+    assert beff(16, 8192) == 64         # auto part capped
+    assert beff(48, 1024) == 48         # explicit floor wins
+    assert beff(128, 2048) == 128       # explicit width above cap honored

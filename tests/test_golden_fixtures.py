@@ -140,17 +140,25 @@ def test_bkt_tree_bin_golden_bytes():
 
 
 def test_deletes_bin_golden_bytes():
+    """Byte convention VERIFIED against a real reference-built index in
+    round 3 (not hand-assembled): live rows carry the Dataset's -1 memset
+    fill (Dataset.h:65), deleted rows carry 1 (Labelset.h:39-45).  The
+    round-1 hand-assembled fixture wrongly used 0x00 for live rows, which
+    made every reference-built index load as fully tombstoned."""
     golden = (
         b"\x01\x00\x00\x00"         # deletedCount = 1
         b"\x03\x00\x00\x00"         # Dataset rows = 3
         b"\x01\x00\x00\x00"         # Dataset cols = 1
-        b"\x00\x01\x00"             # flags: row 1 deleted
+        b"\xff\x01\xff"             # flags: row 1 deleted, others -1 fill
     )
     mask = np.asarray([False, True, False])
     buf = io.BytesIO()
     fmt.write_deletes(buf, mask)
     assert buf.getvalue() == golden
     np.testing.assert_array_equal(fmt.read_deletes(io.BytesIO(golden)), mask)
+    # legacy tolerance: 0x00 (round-1/2 saves) still reads as live
+    legacy = golden[:12] + b"\x00\x01\x00"
+    np.testing.assert_array_equal(fmt.read_deletes(io.BytesIO(legacy)), mask)
 
 
 def test_metadata_bin_golden_bytes():

@@ -423,6 +423,8 @@ def test_server_over_sharded_mesh_index():
     from sptag_tpu.parallel.sharded import (
         ServingAdapter, ShardedBKTIndex, make_mesh)
 
+    from sptag_tpu.core.vectorset import MetadataSet
+
     rng = np.random.default_rng(8)
     d = 16
     data = rng.standard_normal((512, d)).astype(np.float32)
@@ -431,7 +433,8 @@ def test_server_over_sharded_mesh_index():
         params={"BKTNumber": 1, "BKTKmeansK": 4, "TPTNumber": 2,
                 "TPTLeafSize": 32, "NeighborhoodSize": 8, "CEF": 16,
                 "MaxCheckForRefineGraph": 64, "RefineIterations": 1,
-                "MaxCheck": 128})
+                "MaxCheck": 128},
+        metadata=MetadataSet(b"row%03d" % i for i in range(len(data))))
     ctx = ServiceContext(ServiceSettings(default_max_result=5))
     ctx.indexes["mesh"] = ServingAdapter(sharded, feature_dim=d)
 
@@ -447,6 +450,215 @@ def test_server_over_sharded_mesh_index():
         assert res.status == wire.ResultStatus.Success
         assert res.results[0].ids[0] == 7          # global id across shards
         assert res.results[0].dists[0] <= 1e-5
+        # mesh-served metadata: the wire response carries the frontend
+        # store's bytes for global ids (reference parity:
+        # RemoteSearchQuery.cpp:94-210 — each Server shard returns
+        # m_metadatas with its results)
+        res_m = client.search(f"$resultnum:3 $extractmetadata:true #{qb}")
+        assert res_m.status == wire.ResultStatus.Success
+        assert res_m.results[0].metas[0] == b"row007"
         client.close()
     finally:
         t.stop()
+
+
+# --------------------------------------------------------- socket hardening
+
+def test_server_survives_malformed_packets():
+    """One hostile client must cost only its own connection (reference: a
+    bad packet kills the Connection, never the Server).  Covers the two
+    attack shapes the round-2 review called out: a header whose
+    body_length demands a multi-GB read, and a SearchRequest body that is
+    not a RemoteQuery."""
+    import socket
+    import struct
+
+    from sptag_tpu.serve.server import MAX_BODY_LENGTH
+
+    ctx, data = _make_context()
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        # (a) huge declared body_length -> server closes the connection
+        # without attempting the read
+        s = socket.create_connection((host, port), timeout=5)
+        evil = wire.PacketHeader(wire.PacketType.SearchRequest,
+                                 wire.PacketProcessStatus.Ok,
+                                 MAX_BODY_LENGTH + 1, 0, 0)
+        s.sendall(evil.pack())
+        s.settimeout(5)
+        assert s.recv(1) == b""                   # EOF: closed, not hung
+        s.close()
+
+        # (b) garbage SearchRequest body (bad version) -> server answers
+        # FailedExecute instead of crashing or hanging
+        s = socket.create_connection((host, port), timeout=5)
+        junk = b"\xff" * 32
+        h = wire.PacketHeader(wire.PacketType.SearchRequest,
+                              wire.PacketProcessStatus.Ok, len(junk), 0, 0)
+        s.sendall(h.pack() + junk)
+        head = b""
+        while len(head) < wire.HEADER_SIZE:
+            chunk = s.recv(wire.HEADER_SIZE - len(head))
+            assert chunk, "server closed before responding"
+            head += chunk
+        rh = wire.PacketHeader.unpack(head)
+        assert rh.packet_type == wire.PacketType.SearchResponse
+        body = b""
+        while len(body) < rh.body_length:
+            body += s.recv(rh.body_length - len(body))
+        rr = wire.RemoteSearchResult.unpack(body)
+        assert rr.status == wire.ResultStatus.FailedExecute
+        s.close()
+
+        # (c) truncated header then disconnect — must not wedge the server
+        s = socket.create_connection((host, port), timeout=5)
+        s.sendall(b"\x01\x02\x03")
+        s.close()
+
+        # the server still serves a well-formed client afterwards
+        client = AnnClient(host, port, timeout_s=10.0)
+        client.connect()
+        qtext = "|".join(str(x) for x in data[3])
+        res = client.search(f"$resultnum:3 {qtext}")
+        assert res.status == wire.ResultStatus.Success
+        assert res.results[0].ids[0] == 3
+        client.close()
+    finally:
+        t.stop()
+
+
+def test_server_connection_cap():
+    """The accept loop enforces max_connections (reference: 256-slot
+    ConnectionManager, inc/Socket/ConnectionManager.h:23-67); a freed slot
+    becomes usable again."""
+    import socket
+
+    ctx, data = _make_context()
+    server = SearchServer(ctx, batch_window_ms=1.0, max_connections=2)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        c1 = AnnClient(host, port, timeout_s=5.0)
+        c1.connect()
+        c2 = AnnClient(host, port, timeout_s=5.0)
+        c2.connect()
+        # third client: accepted at TCP level but closed by the server —
+        # rejection shows as EOF on read or a reset on write, depending on
+        # who wins the close race
+        s3 = socket.create_connection((host, port), timeout=5)
+        s3.settimeout(5)
+        try:
+            s3.sendall(wire.PacketHeader(wire.PacketType.RegisterRequest,
+                                         wire.PacketProcessStatus.Ok, 0,
+                                         0, 0).pack())
+            assert s3.recv(1) == b""              # rejected: EOF
+        except (ConnectionResetError, BrokenPipeError):
+            pass                                  # rejected: reset
+        s3.close()
+        # slots free on disconnect: closing c2 admits a new client
+        c2.close()
+        time.sleep(0.2)
+        c4 = AnnClient(host, port, timeout_s=5.0)
+        c4.connect()
+        qtext = "|".join(str(x) for x in data[5])
+        res = c4.search(f"$resultnum:1 {qtext}")
+        assert res.results[0].ids[0] == 5
+        c4.close()
+        c1.close()
+    finally:
+        t.stop()
+
+
+def test_maxcheck_option_parsed_and_plumbed():
+    """The framework's $maxcheck extension: parsed from the query line and
+    handed to the index's per-call budget override (the reference can only
+    change MaxCheck index-wide via SetParameter)."""
+    p = parse_query("$maxcheck:4096 1|2|3")
+    assert p.max_check == 4096
+    assert parse_query("1|2|3").max_check is None
+    assert parse_query("$maxcheck:bogus 1|2|3").max_check is None
+    assert parse_query("$maxcheck:-5 1|2|3").max_check is None
+
+    class SpyIndex:
+        feature_dim = 3
+        value_type = sp.VectorValueType.Float
+        metadata = None
+        num_samples = 1
+
+        def __init__(self):
+            self.seen = []
+
+        def search_batch(self, queries, k=10, max_check=None):
+            self.seen.append(("batch", k, max_check))
+            n = len(queries)
+            return (np.zeros((n, k), np.float32),
+                    np.zeros((n, k), np.int32))
+
+        def search(self, query, k=10, with_metadata=False, max_check=None):
+            from sptag_tpu.core.index import SearchResult
+            self.seen.append(("one", k, max_check))
+            return SearchResult(np.zeros(k, np.int32),
+                                np.zeros(k, np.float32), None)
+
+    spy = SpyIndex()
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.indexes["main"] = spy
+    ex = SearchExecutor(ctx)
+    ex.execute("$maxcheck:2048 1|2|3")
+    ex.execute_batch(["$maxcheck:512 1|2|3", "$maxcheck:512 4|5|6",
+                      "1|2|3"])
+    assert ("one", 5, 2048) in spy.seen
+    # the two maxcheck:512 queries coalesce into ONE batch call; the
+    # unbudgeted query groups separately with None
+    assert ("batch", 5, 512) in spy.seen
+    assert ("batch", 5, None) in spy.seen
+
+
+def test_maxcheck_budget_changes_results_end_to_end():
+    """A real BKT index honors the per-request budget: a starved budget
+    must not outperform a saturating one, and the distances must come back
+    ascending in both."""
+    rng = np.random.default_rng(4)
+    data = rng.standard_normal((3000, 16)).astype(np.float32)
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "200"),
+                        ("NeighborhoodSize", "8"), ("CEF", "24"),
+                        ("MaxCheckForRefineGraph", "64"),
+                        ("RefineIterations", "0"), ("MaxCheck", "512")]:
+        index.set_parameter(name, value)
+    index.build(data)
+    queries = rng.standard_normal((16, 16)).astype(np.float32)
+    d = ((queries[:, None, :] - data[None, :, :]) ** 2).sum(-1)
+    truth = np.argsort(d, axis=1)[:, :10]
+
+    def recall(ids):
+        return np.mean([len(set(ids[i, :10]) & set(truth[i])) / 10
+                        for i in range(len(truth))])
+
+    _, ids_small = index.search_batch(queries, 10, max_check=32)
+    _, ids_big = index.search_batch(queries, 10, max_check=4096)
+    assert recall(ids_big) >= recall(ids_small)
+    assert recall(ids_big) >= 0.9
+
+
+def test_maxcheck_sanitizer_respects_limit():
+    """The $maxcheck DoS ceiling: quantized-then-clamped, so the sanitized
+    budget NEVER exceeds max_check_limit (round-up overshoot regression),
+    while still quantizing to powers of two below it (bounded compile-cache
+    growth)."""
+    ctx = ServiceContext(ServiceSettings(max_check_limit=40000))
+    ex = SearchExecutor(ctx)
+
+    def mc(text):
+        return ex._sanitize_max_check(parse_query(text + " 1|2|3"))
+
+    assert mc("$maxcheck:40000") == 40000          # clamped, not 65536
+    assert mc("$maxcheck:2000000000") == 40000
+    assert mc("$maxcheck:1000") == 1024            # quantized below limit
+    assert mc("") is None

@@ -157,11 +157,15 @@ def test_sharded_save_load_roundtrip(tmp_path):
     plus a manifest; load() reassembles the mesh index with identical
     search results in both modes (the persistence story of the
     reference's one-Server-per-shard topology)."""
+    from sptag_tpu.core.vectorset import MetadataSet
+
     data, queries = _corpus(n=1200, d=16, nq=16)
     mesh = make_mesh()
     folder = str(tmp_path / "mesh_idx")
-    idx = ShardedBKTIndex.build(data, DistCalcMethod.L2, mesh=mesh,
-                                params=PARAMS, dense=True, save_to=folder)
+    idx = ShardedBKTIndex.build(
+        data, DistCalcMethod.L2, mesh=mesh, params=PARAMS, dense=True,
+        save_to=folder,
+        metadata=MetadataSet(b"m%d" % i for i in range(len(data))))
     d0, i0 = idx.search(queries, 5)
     dd0, di0 = idx.search_dense(queries, 5, max_check=512)
 
@@ -172,6 +176,10 @@ def test_sharded_save_load_roundtrip(tmp_path):
     np.testing.assert_array_equal(di0, di1)
     np.testing.assert_allclose(d0, d1, rtol=1e-6)
     np.testing.assert_allclose(dd0, dd1, rtol=1e-6)
+    # frontend metadata survives the roundtrip (lazy file-backed on load)
+    assert idx2.metadata is not None
+    assert idx2.metadata.get_metadata(7) == b"m7"
+    assert idx2.metadata.get_metadata(1199) == b"m1199"
 
     # mesh-size mismatch is rejected up front
     import jax
@@ -198,3 +206,38 @@ def test_sharded_kdt_shards():
     assert rd >= 0.85, rd
     d2, i2 = idx.search(data[:4], k=1)
     assert list(i2[:, 0]) == [0, 1, 2, 3]
+
+
+def test_sharded_beam_pool_scales_with_budget():
+    """Regression for the round-2 saturation bug resurfacing in the mesh
+    path: ShardedBKTIndex.search used a FIXED L=64 frontier regardless of
+    MaxCheck (the exact plateau diagnosed single-chip: recall stuck at 0.82
+    from MaxCheck 512 to 8192).  The mesh path must use the same
+    budget-scaled pool formula (reference frontier sizing: WorkSpace.h:
+    182-208) and recall must rise monotonically with the budget."""
+    from sptag_tpu.algo.engine import beam_pool_size
+
+    # the shared formula itself scales with budget
+    assert beam_pool_size(10, 8192, 10_000) > beam_pool_size(10, 512, 10_000)
+    assert beam_pool_size(10, 512, 10_000) > 64
+
+    # uniform (cluster-free) corpus + deliberately weak graph so small
+    # budgets stay well below saturation
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((24_000, 32)).astype(np.float32)
+    queries = rng.standard_normal((32, 32)).astype(np.float32)
+    truth = _true_topk(data, queries, 10)
+    idx = ShardedBKTIndex.build(
+        data, DistCalcMethod.L2, mesh=make_mesh(),
+        params={"BKTNumber": 1, "BKTKmeansK": 8, "TPTNumber": 2,
+                "TPTLeafSize": 200, "NeighborhoodSize": 8, "CEF": 24,
+                "MaxCheckForRefineGraph": 128, "RefineIterations": 0,
+                "MaxCheck": 512})
+    recalls = []
+    for mc in (512, 2048, 8192):
+        _, ids = idx.search(queries, 10, max_check=mc)
+        recalls.append(_recall(ids, truth))
+    # monotone (small tolerance for tie-order jitter) and a real rise
+    assert recalls[1] >= recalls[0] - 0.02, recalls
+    assert recalls[2] >= recalls[1] - 0.02, recalls
+    assert recalls[2] > recalls[0], recalls
