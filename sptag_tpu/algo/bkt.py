@@ -284,15 +284,26 @@ class BKTIndex(VectorIndex):
                 searcher = self._build_dense_searcher(replicas=1)
                 self._refine_dense_cache = (key, searcher)
 
+            # grouped probing helps refine especially — its queries ARE
+            # corpus rows, maximally probe-local after the partition sort.
+            # RefineQueryGroup selects the refine knob PAIR; a config that
+            # only set the search-time DenseQueryGroup falls back to BOTH
+            # dense knobs (group and union factor together — mixing the
+            # pairs would silently change tuned builds)
+            rg = getattr(p, "refine_query_group", 0)
+            if rg:
+                group = rg
+                union = getattr(p, "refine_union_factor", 4)
+            else:
+                group = getattr(p, "dense_query_group", 0)
+                union = getattr(p, "dense_union_factor", 2)
+
             def search(queries: np.ndarray, k: int):
                 # a candidate pool at least as big as k keeps the RNG prune
-                # supplied even when the budget knob is set below CEF;
-                # grouped probing helps refine especially — its queries ARE
-                # corpus rows, maximally probe-local after the sort
+                # supplied even when the budget knob is set below CEF
                 return searcher.search(
                     queries, k, max_check=max(budget, 2 * k),
-                    group=getattr(p, "dense_query_group", 0),
-                    union_factor=getattr(p, "dense_union_factor", 2))
+                    group=group, union_factor=union)
             return search
 
         engine = self._make_engine(graph)

@@ -144,11 +144,18 @@ class BKTree:
         for p_full, idxs in sorted(buckets.items()):
             p_sub = _shape_bucket(min(p_full, self.samples))
             max_b = max(1, _MAX_BATCH_ROWS // p_full)
+            # when the bucket spans multiple chunks, pad the TAIL chunk's
+            # batch dim up to max_b too: it then reuses the full chunks'
+            # already-compiled (max_b, P) shape instead of minting its own
+            # — one compiled kernel pair per level instead of two (a
+            # tunneled-TPU compile costs 20-40 s; the padding is one extra
+            # partial batch of MXU compute)
+            force_b = max_b if len(idxs) > max_b else None
             for off in range(0, len(idxs), max_b):
                 chunk = idxs[off:off + max_b]
                 self._run_kmeans_chunk(
                     data, km_items, chunk, p_full, p_sub, max_b, rng, key,
-                    results)
+                    results, force_b=force_b)
 
         # ---- materialize children from labels
         for idx, (ni, ids, has_center) in enumerate(km_items):
@@ -193,7 +200,7 @@ class BKTree:
         return next_level
 
     def _run_kmeans_chunk(self, data, km_items, chunk, p_full, p_sub,
-                          max_b, rng, key, results):
+                          max_b, rng, key, results, force_b=None):
         """Run one padded (B, P) batch through device kmeans; fill results
         as (labels over the item's ids, counts (K,), medoid sample ids)."""
         # a node smaller than K can't seed K distinct centers; clamp (the
@@ -201,8 +208,11 @@ class BKTree:
         # nodes with > leaf_size samples and K <= default leaf budgets)
         K = min(self.kmeans_k, p_sub)
         # bucket the batch dim too — same recompile argument as the row
-        # dim — but never past the device row budget the caller chunked by
-        B = min(_shape_bucket(len(chunk), lo=1), max_b)
+        # dim — but never past the device row budget the caller chunked by;
+        # `force_b` pins the tail chunk to the full chunks' shape (see
+        # _next_level) so a bucket compiles exactly one kernel pair
+        B = (force_b if force_b is not None
+             else min(_shape_bucket(len(chunk), lo=1), max_b))
         D = data.shape[1]
         sub = np.zeros((B, p_sub, D), np.float32)
         sub_valid = np.zeros((B, p_sub), bool)

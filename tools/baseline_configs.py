@@ -37,7 +37,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 CACHE = os.path.join(REPO, ".bench_cache")
 
-from bench import exact_topk, make_dataset, probe_accelerator  # noqa: E402
+from bench import (  # noqa: E402
+    build_or_load,
+    exact_topk,
+    make_dataset,
+    probe_accelerator,
+)
 
 
 def _truth_cached(tag, fn):
@@ -81,12 +86,7 @@ def config_sift1m(build_only):
 
     n, d, nq, k = 1_000_000, 128, 2048, 10
     data, queries = make_dataset(n=n, d=d, nq=nq, seed=17)
-    folder = os.path.join(CACHE, "baseline_sift1m_shape")
-    t0 = time.perf_counter()
-    if os.path.exists(os.path.join(folder, "indexloader.ini")):
-        idx = sp.load_index(folder)
-        build_s, cached = time.perf_counter() - t0, True
-    else:
+    def _build():
         idx = sp.create_instance("BKT", "Float")
         idx.set_parameter("DistCalcMethod", "L2")
         for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "32"),
@@ -97,8 +97,12 @@ def config_sift1m(build_only):
                             ("DenseClusterSize", "512")]:
             idx.set_parameter(name, value)
         idx.build(data)
-        build_s, cached = time.perf_counter() - t0, False
-        idx.save_index(folder)
+        return idx
+
+    # bench.build_or_load: one cache policy (cache-version suffix +
+    # BENCH_COLD_BUILD) shared with the headline bench
+    idx, build_s, cached = build_or_load("baseline_sift1m_shape", _build,
+                                         budget_s=1e9)
     if build_only:
         return {"config": "SIFT1M-shape", "build_s": round(build_s, 1),
                 "build_cached": cached}
@@ -126,12 +130,7 @@ def config_glove100(build_only):
 
     n, d, nq, k = 400_000, 100, 2048, 10
     data, queries = make_dataset(n=n, d=d, nq=nq, seed=18)
-    folder = os.path.join(CACHE, "baseline_glove100_shape")
-    t0 = time.perf_counter()
-    if os.path.exists(os.path.join(folder, "indexloader.ini")):
-        idx = sp.load_index(folder)
-        build_s, cached = time.perf_counter() - t0, True
-    else:
+    def _build():
         idx = sp.create_instance("KDT", "Float")
         idx.set_parameter("DistCalcMethod", "Cosine")
         for name, value in [("KDTNumber", "2"), ("TPTNumber", "8"),
@@ -142,8 +141,10 @@ def config_glove100(build_only):
                             ("DenseClusterSize", "512")]:
             idx.set_parameter(name, value)
         idx.build(data)
-        build_s, cached = time.perf_counter() - t0, False
-        idx.save_index(folder)
+        return idx
+
+    idx, build_s, cached = build_or_load("baseline_glove100_shape", _build,
+                                         budget_s=1e9)
     if build_only:
         return {"config": "GloVe-100-shape", "build_s": round(build_s, 1),
                 "build_cached": cached}
@@ -163,12 +164,7 @@ def config_msmarco(build_only):
 
     n, d, nq, k = 200_000, 384, 2048, 10
     data, queries = make_dataset(n=n, d=d, nq=nq, seed=19, dtype=np.int8)
-    folder = os.path.join(CACHE, "baseline_msmarco_shape")
-    t0 = time.perf_counter()
-    if os.path.exists(os.path.join(folder, "indexloader.ini")):
-        idx = sp.load_index(folder)
-        build_s, cached = time.perf_counter() - t0, True
-    else:
+    def _build():
         idx = sp.create_instance("BKT", "Int8")
         idx.set_parameter("DistCalcMethod", "Cosine")
         for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "32"),
@@ -179,8 +175,10 @@ def config_msmarco(build_only):
                             ("DenseClusterSize", "512")]:
             idx.set_parameter(name, value)
         idx.build(data)
-        build_s, cached = time.perf_counter() - t0, False
-        idx.save_index(folder)
+        return idx
+
+    idx, build_s, cached = build_or_load("baseline_msmarco_shape", _build,
+                                         budget_s=1e9)
     if build_only:
         return {"config": "MS-MARCO-shape", "build_s": round(build_s, 1),
                 "build_cached": cached}
