@@ -240,4 +240,10 @@ class FlatParams(ParamSet):
               "DeletePercentageForRefine"),
         _spec("max_check", int, 8192, "MaxCheck"),
         _spec("batch_size", int, 256, "BatchSize"),
+        # TPU-only, opt-in: hardware-accelerated approximate top-k
+        # (lax.approx_max_k, recall_target 0.99 per op — the peak-FLOP/s
+        # KNN recipe, arXiv:2206.14286) instead of the exact sort-based
+        # selection.  Trades the index's exactness guarantee for
+        # selection speed at large N; distances of returned ids stay exact
+        _spec("approx_topk", bool, False, "ApproxTopK"),
     ]

@@ -141,3 +141,26 @@ def test_merge_index():
     assert a.num_samples == 40
     res = a.search(data[33], k=1, with_metadata=True)
     assert res.metas[0] == b"33"
+
+
+def test_flat_approx_topk_mode():
+    """ApproxTopK=true routes selection through lax.approx_max_k (the
+    peak-FLOP/s TPU KNN recipe, arXiv:2206.14286) — opt-in because it
+    trades FLAT's exactness guarantee; recall vs the exact mode must stay
+    >= the op's 0.99 target (the CPU lowering is exact, so this asserts
+    wiring + a conservative floor, not the TPU hardware op's recall)."""
+    rng = np.random.default_rng(14)
+    data = rng.standard_normal((4096, 32)).astype(np.float32)
+    queries = rng.standard_normal((64, 32)).astype(np.float32)
+    exact = create_instance("FLAT", "Float")
+    exact.set_parameter("DistCalcMethod", "L2")
+    exact.build(data)
+    _, ids_e = exact.search_batch(queries, 10)
+    approx = create_instance("FLAT", "Float")
+    approx.set_parameter("DistCalcMethod", "L2")
+    assert approx.set_parameter("ApproxTopK", "true")
+    approx.build(data)
+    _, ids_a = approx.search_batch(queries, 10)
+    overlap = np.mean([len(set(ids_a[i]) & set(ids_e[i])) / 10
+                       for i in range(len(queries))])
+    assert overlap >= 0.95, overlap

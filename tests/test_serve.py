@@ -342,6 +342,17 @@ def test_aggregator_scatter_gather_and_partial_timeout():
         for r in res.results:
             assert r.ids[0] == 5
 
+        # options ride through the aggregator untouched — the framework's
+        # $maxcheck extension and $extractmetadata both reach the backing
+        # servers (the aggregator forwards the raw query text, reference
+        # AggregatorExecute parity)
+        res_o = client.search("$indexname:shard_a,shard_b $resultnum:3 "
+                              "$extractmetadata:true $maxcheck:4096 "
+                              + "|".join(str(x) for x in data[5]))
+        assert res_o.status == wire.ResultStatus.Success
+        for r in res_o.results:
+            assert r.ids[0] == 5 and r.metas[0] == b"m5"
+
         # kill one backing server: the reader task sees EOF and marks it
         # Disconnected (the reference's on-close event,
         # AggregatorService.cpp:65-76), so the next query either skips the
