@@ -110,9 +110,16 @@ def _mark_bits(words: jax.Array, ids: jax.Array) -> jax.Array:
     run with a segmented scan, and let only each run's LAST element write
     ``existing | run_or`` (distinct words per row -> no scatter conflicts).
     """
-    Q, X = ids.shape
+    return _mark_bits_sorted(words, jnp.sort(ids, axis=1))
+
+
+def _mark_bits_sorted(words: jax.Array, s: jax.Array) -> jax.Array:
+    """_mark_bits for ids already sorted ascending along axis 1 — the walk
+    shares one argsort between duplicate detection and bit marking
+    (marking is an OR, so re-marking already-visited ids is a no-op and
+    the caller can pass ALL valid candidates, not just fresh ones)."""
+    Q, X = s.shape
     W = words.shape[1]
-    s = jnp.sort(ids, axis=1)
     w = jnp.right_shift(s, 5)
     b = jnp.left_shift(jnp.int32(1), s & 31)
     first = jnp.concatenate(
@@ -149,17 +156,29 @@ def beam_pool_size(k: int, max_check: int, n: int,
     return min(max(L, k), n)
 
 
-def _sorted_dup_mask(ids: jax.Array):
-    """(Q, X) int -> (Q, X) bool, True on every occurrence of an id after
-    the first (sort + inverse permutation)."""
+def _sorted_dedup(ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(Q, X) int -> (sorted ids (Q, X), dup mask (Q, X)).
+
+    One argsort serves both outputs: `dup` is True on every occurrence of
+    an id after the first (in original positions — the inverse permutation
+    comes from a SCATTER, not a second sort), and the sorted array feeds
+    `_mark_bits_sorted` directly.  Shared by the walk's per-iteration
+    dedupe, the seeded kernel's seed dedupe, and the dense epilogue's
+    replica dedupe — previously three near-copies costing three sorts."""
     Q = ids.shape[0]
     order = jnp.argsort(ids, axis=1, stable=True)
     sorted_ids = jnp.take_along_axis(ids, order, axis=1)
     dup_sorted = jnp.concatenate(
         [jnp.zeros((Q, 1), bool),
          sorted_ids[:, 1:] == sorted_ids[:, :-1]], axis=1)
-    inv = jnp.argsort(order, axis=1)
-    return jnp.take_along_axis(dup_sorted, inv, axis=1)
+    inv = jax.vmap(lambda o: jnp.zeros_like(o).at[o].set(
+        jnp.arange(o.shape[0], dtype=o.dtype)))(order)
+    return sorted_ids, jnp.take_along_axis(dup_sorted, inv, axis=1)
+
+
+def _sorted_dup_mask(ids: jax.Array):
+    """(Q, X) int -> (Q, X) bool duplicate mask (see _sorted_dedup)."""
+    return _sorted_dedup(ids)[1]
 
 
 @functools.partial(
@@ -231,9 +250,11 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
         queries, svecs, DistCalcMethod(metric), base, ssq)
     # duplicate seeds (same leaf reached twice) must not double-occupy the
     # beam: keep the first occurrence only
-    d0 = jnp.where((seed_ids < 0) | _sorted_dup_mask(seed_ids), MAX_DIST, d0)
+    seeds_safe = jnp.where(seed_ids >= 0, seed_ids, N)
+    sorted_seeds, seed_dup = _sorted_dedup(seeds_safe)
+    d0 = jnp.where((seed_ids < 0) | seed_dup, MAX_DIST, d0)
     visited = jnp.zeros((Q, _num_words(N)), jnp.int32)
-    visited = _mark_bits(visited, jnp.where(seed_ids >= 0, seed_ids, N))
+    visited = _mark_bits_sorted(visited, sorted_seeds)
     if S < L:
         d0 = jnp.concatenate(
             [d0, jnp.full((Q, L - S), MAX_DIST, jnp.float32)], axis=1)
@@ -364,11 +385,20 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
         flat = nbrs.reshape(Q, -1)                               # (Q, B*m)
         flat_safe = jnp.where(flat >= 0, flat, N)
         seen = _test_bits(visited, flat_safe)
+        # ONE argsort serves both the intra-batch duplicate mask and the
+        # bit marking (the loop previously paid three sorts per iteration:
+        # dup-mask argsort + inverse argsort + mark sort).  Sorting
+        # flat_safe keeps invalid ids (-> N) at the END so the array stays
+        # ascending for the segmented-OR marker; the inverse permutation
+        # comes from a scatter, not a second sort.
+        sorted_safe, dup = _sorted_dedup(flat_safe)
         # a node reached from two popped parents in the SAME iteration is
         # not yet in `visited` for either copy — dedupe within the batch or
         # the beam accumulates duplicate entries
-        fresh = (flat >= 0) & ~seen & ~_sorted_dup_mask(flat)
-        visited = _mark_bits(visited, jnp.where(fresh, flat, N))
+        fresh = (flat >= 0) & ~seen & ~dup
+        # mark ALL valid candidates (OR is idempotent — re-marking seen
+        # ids changes nothing), so the pre-sorted array is reusable as-is
+        visited = _mark_bits_sorted(visited, sorted_safe)
 
         # ---- score fresh candidates (one batched contraction) -------------
         gather_idx = jnp.where(fresh, flat, 0)
