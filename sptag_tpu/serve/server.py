@@ -48,8 +48,13 @@ class SearchServer:
         # ConnectionManager.h:23-67); excess clients are closed at accept
         self.max_connections = max_connections
         self._next_cid = 1
-        self._conns: Dict[int, asyncio.StreamWriter] = {}
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self._conns: Dict[int, Tuple[asyncio.StreamWriter,
+                                     asyncio.Lock]] = {}
+        # bounded: 256 pipelining connections could otherwise queue
+        # requests without limit (memory exhaustion the connection cap
+        # alone doesn't prevent); a full queue answers Dropped immediately
+        # — the reference's thread-pool depth plays the same role
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=8 * max_batch)
         self._server: Optional[asyncio.AbstractServer] = None
         self._batcher_task: Optional[asyncio.Task] = None
 
@@ -85,7 +90,12 @@ class SearchServer:
             return
         cid = self._next_cid
         self._next_cid += 1
-        self._conns[cid] = writer
+        # per-connection write lock: the reader task (register/heartbeat/
+        # shed responses) and the batcher task both write+drain the same
+        # StreamWriter; two concurrent drain() waiters trip an assertion
+        # inside asyncio's FlowControlMixin on Python 3.10/3.11 and would
+        # kill the batcher — all writes serialize through this lock
+        self._conns[cid] = (writer, asyncio.Lock())
         try:
             while True:
                 head = await reader.readexactly(wire.HEADER_SIZE)
@@ -96,7 +106,7 @@ class SearchServer:
                     break
                 body = (await reader.readexactly(header.body_length)
                         if header.body_length else b"")
-                await self._dispatch(cid, writer, header, body)
+                await self._dispatch(cid, header, body)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         except Exception:                                    # noqa: BLE001
@@ -107,33 +117,52 @@ class SearchServer:
             self._conns.pop(cid, None)
             writer.close()
 
-    async def _dispatch(self, cid: int, writer: asyncio.StreamWriter,
-                        header: wire.PacketHeader, body: bytes) -> None:
+    async def _send(self, cid: int, payload: bytes) -> None:
+        """Locked write+drain on a connection (see _on_client for why)."""
+        entry = self._conns.get(cid)
+        if entry is None:
+            return
+        writer, lock = entry
+        async with lock:
+            writer.write(payload)
+            await writer.drain()
+
+    async def _dispatch(self, cid: int, header: wire.PacketHeader,
+                        body: bytes) -> None:
         t = header.packet_type
         if t == wire.PacketType.RegisterRequest:
             # Connection::HandleRegisterRequest (Connection.cpp:351-363)
             resp = wire.PacketHeader(wire.PacketType.RegisterResponse,
                                      wire.PacketProcessStatus.Ok, 0, cid,
                                      header.resource_id)
-            writer.write(resp.pack())
-            await writer.drain()
+            await self._send(cid, resp.pack())
         elif t == wire.PacketType.HeartbeatRequest:
             resp = wire.PacketHeader(wire.PacketType.HeartbeatResponse,
                                      wire.PacketProcessStatus.Ok, 0,
                                      header.connection_id,
                                      header.resource_id)
-            writer.write(resp.pack())
-            await writer.drain()
+            await self._send(cid, resp.pack())
         elif t == wire.PacketType.SearchRequest:
             query = wire.RemoteQuery.unpack(body)
-            await self._queue.put((cid, header, query))
+            try:
+                self._queue.put_nowait((cid, header, query))
+            except asyncio.QueueFull:
+                # shed load at the edge rather than buffering unboundedly;
+                # the client sees a definitive, well-formed FailedExecute
+                # for THIS request (a body-less Dropped header would break
+                # result unpacking on the other side)
+                shed = wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedExecute, []).pack()
+                resp = wire.PacketHeader(wire.PacketType.SearchResponse,
+                                         wire.PacketProcessStatus.Dropped,
+                                         len(shed), cid, header.resource_id)
+                await self._send(cid, resp.pack() + shed)
         elif wire.is_request(t):
             # HandleNoHandlerResponse (Connection.cpp:374-398)
             resp = wire.PacketHeader(wire.response_type(t),
                                      wire.PacketProcessStatus.Dropped, 0,
                                      cid, header.resource_id)
-            writer.write(resp.pack())
-            await writer.drain()
+            await self._send(cid, resp.pack())
 
     # --------------------------------------------------------- batched serve
 
@@ -168,9 +197,6 @@ class SearchServer:
             results = [wire.RemoteSearchResult(
                 wire.ResultStatus.FailedExecute, [])] * len(batch)
         for (cid, header, query), result in zip(batch, results):
-            writer = self._conns.get(cid)
-            if writer is None:
-                continue
             if query is None:
                 result = wire.RemoteSearchResult(
                     wire.ResultStatus.FailedExecute, [])
@@ -180,8 +206,7 @@ class SearchServer:
                 wire.PacketProcessStatus.Ok, len(body), cid,
                 header.resource_id)
             try:
-                writer.write(resp.pack() + body)
-                await writer.drain()
+                await self._send(cid, resp.pack() + body)
             except ConnectionResetError:
                 pass
 

@@ -239,3 +239,37 @@ def test_grouped_refine_matches_ungrouped():
     r_gr = build(32)
     assert r_gr >= r_un - 0.03, (r_gr, r_un)
     assert r_gr >= 0.9, r_gr
+
+
+def test_bkt_uint8_end_to_end():
+    """UInt8 value type through the full index lifecycle (the distance
+    kernels are golden-tested per dtype; this pins the index-level path:
+    ingest normalization base 255, integer cosine convention, save/load)."""
+    from sptag_tpu.ops.distance import normalize
+
+    rng = np.random.default_rng(21)
+    raw = rng.random((3000, 32)).astype(np.float32)
+    data = np.clip(np.round(
+        raw / np.linalg.norm(raw, axis=1, keepdims=True) * 255),
+        0, 255).astype(np.uint8)
+    queries = data[rng.integers(0, len(data), 24)]
+    stored = normalize(data, 255).astype(np.int64)
+    qn = normalize(queries, 255).astype(np.int64)
+    truth = np.argsort(-(qn @ stored.T), axis=1)[:, :10]
+    idx = sp.create_instance("BKT", "UInt8")
+    idx.set_parameter("DistCalcMethod", "Cosine")
+    # beam mode: the uniform-on-sphere corpus has no cluster structure for
+    # the dense partition to exploit at this budget; the graph walk is the
+    # reference-parity path this test pins
+    idx.set_parameter("SearchMode", "beam")
+    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "200"),
+                        ("NeighborhoodSize", "16"), ("CEF", "64"),
+                        ("MaxCheckForRefineGraph", "256"),
+                        ("RefineIterations", "1"), ("MaxCheck", "1024")]:
+        idx.set_parameter(name, value)
+    idx.build(data)
+    _, ids = idx.search_batch(queries, 10)
+    r = np.mean([len(set(ids[i, :10]) & set(truth[i])) / 10
+                 for i in range(len(truth))])
+    assert r >= 0.9, r

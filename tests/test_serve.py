@@ -673,3 +673,59 @@ def test_maxcheck_sanitizer_respects_limit():
     assert mc("$maxcheck:2000000000") == 40000
     assert mc("$maxcheck:1000") == 1024            # quantized below limit
     assert mc("") is None
+
+
+def test_server_sheds_load_when_queue_full():
+    """The request queue is bounded (8 x max_batch); overflow answers a
+    well-formed FailedExecute instead of buffering unboundedly — the
+    memory-exhaustion path the 256-connection cap alone doesn't close."""
+    import socket
+
+    ctx, data = _make_context()
+    # 32-slot queue (8 x max_batch=4).  The 300 ms batch window makes the
+    # shed deterministic: after popping the first request the batcher
+    # WAITS inside the window for a 4th item, draining at most max_batch
+    # slots while the flood of 64 arrives back-to-back on localhost — at
+    # least 64 - 32 - 4 requests must hit QueueFull
+    server = SearchServer(ctx, batch_window_ms=300.0, max_batch=4)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        s = socket.create_connection((host, port), timeout=10)
+        s.settimeout(10)
+        qtext = "|".join(str(x) for x in data[3])
+        body = wire.RemoteQuery(qtext).pack()
+        n_flood = 64
+        for rid in range(n_flood):
+            h = wire.PacketHeader(wire.PacketType.SearchRequest,
+                                  wire.PacketProcessStatus.Ok, len(body),
+                                  0, rid)
+            s.sendall(h.pack() + body)
+        # collect all responses; every request gets exactly one, some
+        # shed (Dropped header + FailedExecute body), the rest served
+        dropped = served = 0
+        buf = b""
+        while dropped + served < n_flood:
+            chunk = s.recv(65536)
+            assert chunk, "server closed mid-flood"
+            buf += chunk
+            while len(buf) >= wire.HEADER_SIZE:
+                rh = wire.PacketHeader.unpack(buf[:wire.HEADER_SIZE])
+                if len(buf) < wire.HEADER_SIZE + rh.body_length:
+                    break
+                rbody = buf[wire.HEADER_SIZE:wire.HEADER_SIZE
+                            + rh.body_length]
+                buf = buf[wire.HEADER_SIZE + rh.body_length:]
+                rr = wire.RemoteSearchResult.unpack(rbody)
+                if rh.process_status == wire.PacketProcessStatus.Dropped:
+                    dropped += 1
+                    assert rr.status == wire.ResultStatus.FailedExecute
+                else:
+                    served += 1
+                    assert rr.status == wire.ResultStatus.Success
+        assert dropped > 0, "flood never tripped the bounded queue"
+        assert served > 0, "server served nothing"
+        s.close()
+    finally:
+        t.stop()
