@@ -47,6 +47,9 @@ class SearchServer:
         # connection slots (/root/reference/AnnService/inc/Socket/
         # ConnectionManager.h:23-67); excess clients are closed at accept
         self.max_connections = max_connections
+        # bound on how long one connection's drain() may block the batcher
+        # (slow-reader eviction; see _send)
+        self.drain_timeout_s = 15.0
         self._next_cid = 1
         self._conns: Dict[int, Tuple[asyncio.StreamWriter,
                                      asyncio.Lock]] = {}
@@ -118,14 +121,35 @@ class SearchServer:
             writer.close()
 
     async def _send(self, cid: int, payload: bytes) -> None:
-        """Locked write+drain on a connection (see _on_client for why)."""
+        """Locked write+drain on a connection (see _on_client for why).
+
+        Self-contained failure handling: the ONE batcher task services
+        every connection, so a send must never take it down (any OSError
+        -> drop that client) nor wedge it (a client that stops reading
+        blocks drain() at the high-water mark forever -> bounded wait,
+        then evict the slow reader).  Head-of-line blocking across
+        connections is otherwise this design's DoS surface."""
         entry = self._conns.get(cid)
         if entry is None:
             return
         writer, lock = entry
-        async with lock:
-            writer.write(payload)
-            await writer.drain()
+        try:
+            async with lock:
+                writer.write(payload)
+                await asyncio.wait_for(writer.drain(),
+                                       timeout=self.drain_timeout_s)
+        except asyncio.TimeoutError:
+            log.warning("cid %d: response drain exceeded %.0fs (client "
+                        "not reading); evicting", cid,
+                        self.drain_timeout_s)
+            self._conns.pop(cid, None)
+            writer.close()
+        except OSError:
+            # BrokenPipeError / ConnectionResetError / anything transport:
+            # the reader task's readexactly will observe the close and
+            # clean up; the batcher must not die
+            self._conns.pop(cid, None)
+            writer.close()
 
     async def _dispatch(self, cid: int, header: wire.PacketHeader,
                         body: bytes) -> None:
@@ -205,10 +229,7 @@ class SearchServer:
                 wire.PacketType.SearchResponse,
                 wire.PacketProcessStatus.Ok, len(body), cid,
                 header.resource_id)
-            try:
-                await self._send(cid, resp.pack() + body)
-            except ConnectionResetError:
-                pass
+            await self._send(cid, resp.pack() + body)
 
 
 def run_interactive(context: ServiceContext) -> None:

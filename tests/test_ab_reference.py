@@ -115,3 +115,45 @@ def test_reference_index_roundtrips_through_our_save(ref_index, tmp_path):
     np.testing.assert_array_equal(i0, i1)
     np.testing.assert_allclose(d0, d1, rtol=1e-6)
     assert again.metadata.get_metadata(5) == b"m5"
+
+
+def test_searcher_cli_on_reference_built_index(ref_index, tmp_path):
+    """The IndexSearcher-parity CLI drives a REFERENCE-BUILT folder
+    end-to-end (load -> MaxCheck sweep -> recall report) — the exact
+    workflow a reference user runs on their existing indexes after
+    switching (docs/MIGRATION.md)."""
+    import shutil
+
+    from sptag_tpu.tools import index_searcher
+
+    index, data = ref_index
+    # the fixture's extracted folder lives in the module-scope tmp dir;
+    # re-extract next to this test's tmp_path for the CLI
+    root = tmp_path / "idx"
+    with tarfile.open(FIXTURE) as tf:
+        tf.extractall(tmp_path)
+    shutil.move(str(tmp_path / "fix_index"), str(root))
+
+    rng = np.random.default_rng(31)
+    qs = (data[rng.integers(0, len(data), 32)]
+          + 0.2 * rng.standard_normal((32, 16)).astype(np.float32))
+    dn = (data ** 2).sum(1)
+    truth = np.argsort(dn[None, :] - 2 * (qs @ data.T), axis=1)[:, :10]
+    qtsv = str(tmp_path / "q.tsv")
+    with open(qtsv, "w") as f:
+        for i, row in enumerate(qs):
+            f.write("q%d\t" % i + "|".join(repr(float(x)) for x in row)
+                    + "\n")
+    tpath = str(tmp_path / "truth.txt")
+    with open(tpath, "w") as f:
+        for row in truth:
+            f.write(" ".join(str(int(v)) for v in row) + "\n")
+
+    rc = index_searcher.main([
+        "-x", str(root), "-q", qtsv, "-r", tpath, "-k", "10",
+        "-m", "256,1024", "-o", str(tmp_path / "res.txt"),
+        "Index.SearchMode=beam"])
+    assert rc == 0
+    # one result line per query per sweep point (2 MaxCheck values)
+    lines = open(str(tmp_path / "res.txt")).read().splitlines()
+    assert len(lines) == 64

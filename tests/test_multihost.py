@@ -22,8 +22,9 @@ _WORKER = textwrap.dedent("""
     jax.config.update("jax_platforms", "cpu")
     pid = int(sys.argv[1])
     port = sys.argv[2]
+    nproc = int(sys.argv[3])
     from sptag_tpu.parallel import multihost
-    multihost.initialize(f"localhost:{port}", num_processes=2,
+    multihost.initialize(f"localhost:{port}", num_processes=nproc,
                          process_id=pid)
     assert len(jax.devices()) == 8, jax.devices()
     from sptag_tpu.core.types import DistCalcMethod
@@ -69,28 +70,40 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh_search(tmp_path):
+def _run_mesh_procs(n_proc: int, devices_per_proc: int):
     env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{devices_per_proc}")
     env.pop("JAX_PLATFORMS", None)    # worker forces cpu via jax.config
     port = str(_free_port())          # fixed ports collide across CI runs
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _WORKER, str(i), port],
+        [sys.executable, "-c", _WORKER, str(i), port, str(n_proc)],
         env=env, cwd=os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for i in range(2)]
+        for i in range(n_proc)]
     outs = []
     try:
         for p in procs:
             out, _ = p.communicate(timeout=600)
             outs.append(out)
     finally:
-        # one worker dying leaves its peer blocked in jax.distributed
-        # initialize — never leak it past the test
+        # one worker dying leaves its peers blocked in jax.distributed
+        # initialize — never leak them past the test
         for p in procs:
             if p.poll() is None:
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i} OK" in out, out[-2000:]
+
+
+def test_two_process_mesh_search(tmp_path):
+    _run_mesh_procs(2, 4)
+
+
+def test_four_process_mesh_search(tmp_path):
+    """4 controllers x 2 devices = the same 8-device global mesh: the
+    geometry-agreement and per-process shard loading must be topology-
+    independent (a real DCN deployment varies hosts-per-pod freely)."""
+    _run_mesh_procs(4, 2)

@@ -729,3 +729,44 @@ def test_server_sheds_load_when_queue_full():
         s.close()
     finally:
         t.stop()
+
+
+def test_server_evicts_slow_reader_without_stalling_batcher():
+    """A client that sends requests but never reads responses blocks
+    drain() at the transport high-water mark; the batcher must evict it
+    after drain_timeout_s instead of wedging — other clients keep being
+    served (head-of-line-blocking regression)."""
+    import socket
+
+    ctx, data = _make_context(n=200)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    server.drain_timeout_s = 0.5
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        # non-reading flooder: big resultnum -> fat responses fill the
+        # 64 KiB transport buffer quickly
+        s = socket.create_connection((host, port), timeout=10)
+        qtext = "$resultnum:50 " + "|".join(str(x) for x in data[3])
+        body = wire.RemoteQuery(qtext).pack()
+        for rid in range(400):
+            h = wire.PacketHeader(wire.PacketType.SearchRequest,
+                                  wire.PacketProcessStatus.Ok, len(body),
+                                  0, rid)
+            try:
+                s.sendall(h.pack() + body)
+            except OSError:
+                break                       # server already evicted us
+        # the healthy client must still get answers while/after the
+        # flooder is stalled+evicted
+        c = AnnClient(host, port, timeout_s=20.0)
+        c.connect()
+        for i in (5, 6, 7):
+            res = c.search("|".join(str(x) for x in data[i]))
+            assert res.status == wire.ResultStatus.Success
+            assert res.results[0].ids[0] == i
+        c.close()
+        s.close()
+    finally:
+        t.stop()
