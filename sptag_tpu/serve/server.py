@@ -38,7 +38,8 @@ class SearchServer:
     def __init__(self, context: ServiceContext,
                  batch_window_ms: float = 2.0,
                  max_batch: int = 1024,
-                 max_connections: int = 256):
+                 max_connections: int = 256,
+                 drain_timeout_s: float = 15.0):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -49,7 +50,7 @@ class SearchServer:
         self.max_connections = max_connections
         # bound on how long one connection's drain() may block the batcher
         # (slow-reader eviction; see _send)
-        self.drain_timeout_s = 15.0
+        self.drain_timeout_s = drain_timeout_s
         self._next_cid = 1
         self._conns: Dict[int, Tuple[asyncio.StreamWriter,
                                      asyncio.Lock]] = {}
@@ -143,13 +144,17 @@ class SearchServer:
                         "not reading); evicting", cid,
                         self.drain_timeout_s)
             self._conns.pop(cid, None)
-            writer.close()
+            # abort, not close: a graceful close waits for the very write
+            # buffer the non-reading peer will never drain — the FD, the
+            # buffered bytes, and the wedged reader task would all leak
+            # (and the freed connection slot lets the attacker repeat)
+            writer.transport.abort()
         except OSError:
             # BrokenPipeError / ConnectionResetError / anything transport:
             # the reader task's readexactly will observe the close and
             # clean up; the batcher must not die
             self._conns.pop(cid, None)
-            writer.close()
+            writer.transport.abort()
 
     async def _dispatch(self, cid: int, header: wire.PacketHeader,
                         body: bytes) -> None:

@@ -57,3 +57,49 @@ def test_reader_uses_native_and_matches(tmp_path):
     assert reader.load_file(path)
     np.testing.assert_allclose(reader.vectors, data, rtol=1e-6)
     assert reader.metadata[13] == b"m13"
+
+
+def test_native_header_codec_cross_validates_python(lib):
+    """The C++ packet-header codec and serve/wire.py are two INDEPENDENT
+    implementations of inc/Socket/Packet.h:52-76; byte-for-byte agreement
+    in both directions pins the 16-byte layout from both sides (the same
+    role the reference-built index fixture plays for the file formats)."""
+    import ctypes
+
+    from sptag_tpu.serve import wire
+
+    lib.sptag_pack_header.restype = None
+    lib.sptag_pack_header.argtypes = [
+        ctypes.c_uint8, ctypes.c_uint8, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8)]
+    lib.sptag_unpack_header.restype = None
+    lib.sptag_unpack_header.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32)]
+
+    cases = [
+        (wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+         123456, 7, 99),
+        (wire.PacketType.HeartbeatResponse, wire.PacketProcessStatus.Dropped,
+         0, 0xFFFFFFFF, 0),
+        (wire.PacketType.RegisterRequest, wire.PacketProcessStatus.Failed,
+         1, 2, 3),
+    ]
+    for ptype, status, blen, cid, rid in cases:
+        # native pack == python pack
+        out = (ctypes.c_uint8 * 16)()
+        lib.sptag_pack_header(int(ptype), int(status), blen, cid, rid, out)
+        py = wire.PacketHeader(ptype, status, blen, cid, rid).pack()
+        native = bytes(out)
+        assert native == py, (native.hex(), py.hex())
+        # native unpack(python pack) == original fields
+        t = ctypes.c_uint8()
+        s = ctypes.c_uint8()
+        b = ctypes.c_uint32()
+        c = ctypes.c_uint32()
+        r = ctypes.c_uint32()
+        buf = (ctypes.c_uint8 * 16).from_buffer_copy(native)
+        lib.sptag_unpack_header(buf, t, s, b, c, r)
+        assert (t.value, s.value, b.value, c.value, r.value) == (
+            int(ptype), int(status), blen, cid, rid)
