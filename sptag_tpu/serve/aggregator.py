@@ -52,6 +52,11 @@ class RemoteServer:
     pending: dict = dataclasses.field(default_factory=dict)
     reader_task: Optional[asyncio.Task] = None
     next_rid: int = 1
+    # serializes write+drain: concurrent client tasks pipeline onto ONE
+    # backend connection, and two drain() waiters trip an assertion in
+    # asyncio's flow control on Python 3.10/3.11 (same class of bug fixed
+    # in serve/server.py round 3)
+    wlock: asyncio.Lock = dataclasses.field(default_factory=asyncio.Lock)
 
     @property
     def connected(self) -> bool:
@@ -245,8 +250,9 @@ class AggregatorService:
         fut = asyncio.get_event_loop().create_future()
         server.pending[rid] = fut
         try:
-            server.writer.write(header.pack() + body)
-            await server.writer.drain()
+            async with server.wlock:
+                server.writer.write(header.pack() + body)
+                await server.writer.drain()
             _, rbody = await asyncio.wait_for(
                 fut, self.context.search_timeout_s)
             result = wire.RemoteSearchResult.unpack(rbody)
