@@ -251,11 +251,25 @@ class AggregatorService:
         server.pending[rid] = fut
         try:
             async with server.wlock:
+                if server.writer is None:
+                    # a concurrent drop() (backend reset) beat us to the
+                    # lock; writer is gone and our future already failed
+                    server.pending.pop(rid, None)
+                    return wire.ResultStatus.FailedNetwork, []
                 server.writer.write(header.pack() + body)
                 await server.writer.drain()
             _, rbody = await asyncio.wait_for(
                 fut, self.context.search_timeout_s)
-            result = wire.RemoteSearchResult.unpack(rbody)
+            try:
+                result = wire.RemoteSearchResult.unpack(rbody)
+            except Exception:                            # noqa: BLE001
+                # a malformed backend body must cost one request, not the
+                # client's whole connection task — but stay observable:
+                # 100%-FailedNetwork from wire corruption must look
+                # different from connectivity loss in the logs
+                log.warning("malformed SearchResponse body from %s:%d "
+                            "(rid %d)", server.address, server.port, rid)
+                result = None
             if result is None:
                 return wire.ResultStatus.FailedNetwork, []
             return result.status, result.results

@@ -770,3 +770,90 @@ def test_server_evicts_slow_reader_without_stalling_batcher():
         s.close()
     finally:
         t.stop()
+
+
+def test_parse_query_fuzz_never_raises():
+    """The query parser faces raw client text; no input may raise (the
+    executor turns None-vector parses into FailedExecute, but an exception
+    in parse_query itself would bubble through the batcher)."""
+    import random
+    import string
+
+    rng = random.Random(0)
+    alphabet = string.printable + "\x00\xff$#|"
+    for _ in range(500):
+        text = "".join(rng.choice(alphabet)
+                       for _ in range(rng.randrange(0, 80)))
+        p = parse_query(text)
+        # accessors must be exception-free too, whatever the options hold
+        _ = (p.index_names, p.data_type, p.extract_metadata, p.result_num,
+             p.max_check)
+        for vt in (sp.VectorValueType.Float, sp.VectorValueType.Int8):
+            p.extract_vector(vt)    # None or an array; never a raise
+
+
+def test_aggregator_survives_garbage_backend_body():
+    """A backend that answers a SearchResponse with a garbage body must
+    yield FailedNetwork for that request — not kill the aggregator's
+    client handler task."""
+    import socket
+    import threading as th
+
+    # a fake "server": accepts the register, then answers every search
+    # with a correctly-framed packet whose body is noise
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    bport = lsock.getsockname()[1]
+
+    def fake_backend():
+        conn, _ = lsock.accept()
+        conn.settimeout(10)
+        buf = b""
+        while True:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            while len(buf) >= wire.HEADER_SIZE:
+                h = wire.PacketHeader.unpack(buf[:wire.HEADER_SIZE])
+                if len(buf) < wire.HEADER_SIZE + h.body_length:
+                    break
+                buf = buf[wire.HEADER_SIZE + h.body_length:]
+                if h.packet_type == wire.PacketType.RegisterRequest:
+                    conn.sendall(wire.PacketHeader(
+                        wire.PacketType.RegisterResponse,
+                        wire.PacketProcessStatus.Ok, 0, 1,
+                        h.resource_id).pack())
+                elif h.packet_type == wire.PacketType.SearchRequest:
+                    junk = b"\x01\x00\x00\x00garbage"   # major=1, then noise
+                    conn.sendall(wire.PacketHeader(
+                        wire.PacketType.SearchResponse,
+                        wire.PacketProcessStatus.Ok, len(junk), 1,
+                        h.resource_id).pack() + junk)
+        conn.close()
+
+    bt = th.Thread(target=fake_backend, daemon=True)
+    bt.start()
+
+    agg_ctx = AggregatorContext(search_timeout_s=5.0)
+    agg_ctx.servers = [RemoteServer("127.0.0.1", bport)]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+    try:
+        c = AnnClient(hg, pg, timeout_s=10.0)
+        c.connect()
+        res = c.search("1|2|3")
+        assert res.status == wire.ResultStatus.FailedNetwork
+        # the aggregator connection is still alive for the next request
+        res2 = c.search("4|5|6")
+        assert res2.status == wire.ResultStatus.FailedNetwork
+        c.close()
+    finally:
+        tg.stop()
+        lsock.close()
