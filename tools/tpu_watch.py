@@ -110,8 +110,11 @@ def run_stage(name: str, cmd, timeout_s: float, env=None) -> bool:
 def pipeline(stages) -> None:
     py = sys.executable
     if "1" in stages:
+        # BENCH_COLD_BUILD: the recovery run is where the true cold on-chip
+        # build_s gets recorded (verdict item 6); the driver's end-of-round
+        # bench then loads the warm cache and stays well inside its budget
         run_stage("bench", [py, "bench.py"], 5600,
-                  env={"BENCH_BUDGET_S": "5400"})
+                  env={"BENCH_BUDGET_S": "5400", "BENCH_COLD_BUILD": "1"})
     if "2" in stages:
         run_stage("baseline_configs",
                   [py, "tools/baseline_configs.py", "--configs", "1,2,4"],
