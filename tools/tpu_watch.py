@@ -121,6 +121,8 @@ def pipeline(stages) -> None:
                   7200)
     if "3" in stages:
         run_stage("sweep", [py, "tools/sweep_modes.py", "200000"], 3600)
+    if "4" in stages:
+        run_stage("dense_tune", [py, "tools/dense_tune.py", "200000"], 3600)
 
 
 def main() -> None:
