@@ -225,6 +225,23 @@ class BKTIndex(VectorIndex):
 
     def _get_dense(self) -> DenseTreeSearcher:
         """Lazy dense snapshot for the dense search mode."""
+        if not getattr(self.params, "build_graph", 1):
+            # dense-only index: refresh state WITHOUT materializing the
+            # beam engine — its device copies of data + graph would
+            # double HBM use for a mode that never reads them
+            with self._lock:
+                if self._dirty:
+                    self._engine = None
+                    self._dense = None
+                    self._dirty = False
+                    self._tombstones_dirty = False
+                elif self._tombstones_dirty:
+                    if self._dense is not None:
+                        self._dense.set_deleted(self._deleted[:self._n])
+                    self._tombstones_dirty = False
+                if self._dense is None:
+                    self._dense = self._build_dense_searcher()
+            return self._dense
         self._get_engine()          # refresh dirty state under one lock
         if self._dense is None:
             with self._lock:
@@ -248,6 +265,18 @@ class BKTIndex(VectorIndex):
         log.info("BKT forest built: %d nodes", self._tree.num_nodes)
 
         self._graph = self._new_graph()
+        if not getattr(self.params, "build_graph", 1):
+            # dense-only build (BuildGraph=0, a framework extension with
+            # no reference counterpart): the RNG graph's TPT partition +
+            # refine passes are the dominant build cost, and the MXU
+            # dense scan never reads the graph — skip it.  The graph
+            # array stays shape-correct (all -1) so save/load and the
+            # mutation bookkeeping are unchanged; beam search refuses
+            # with a clear error (_search_batch).
+            self._graph.graph = np.full(
+                (self._n, self._graph.neighborhood_size), -1, np.int32)
+            self._dirty = True
+            return
         try:
             with trace.span("build.rng_graph"):
                 self._graph.build(self._host[:self._n],
@@ -331,6 +360,11 @@ class BKTIndex(VectorIndex):
                 group=getattr(p, "dense_query_group", 0),
                 union_factor=getattr(p, "dense_union_factor", 2))
         else:
+            if not getattr(p, "build_graph", 1):
+                raise RuntimeError(
+                    "beam search needs the RNG graph, but this index was "
+                    "built with BuildGraph=0 (dense-only); use "
+                    "SearchMode=dense or rebuild with BuildGraph=1")
             d, ids = self._engine_search(queries, min(k, self._n), mc)
         return self._pad_results(d, ids, k)
 
@@ -362,12 +396,22 @@ class BKTIndex(VectorIndex):
     def _add(self, data: np.ndarray) -> int:
         begin = self._n
         count = data.shape[0]
-        engine = self._get_engine()   # snapshot BEFORE the rows land
+        link = bool(getattr(self.params, "build_graph", 1))
+        # snapshot BEFORE the rows land; dense-only indexes have no graph
+        # to link into (appended rows reach searches via the partition's
+        # nearest-center assignment until the next rebuild)
+        engine = self._get_engine() if link else None
         self._reserve(count)
         self._host[begin:begin + count] = data
         self._n += count
 
-        self._link_new_rows(engine, begin, count)
+        if link:
+            self._link_new_rows(engine, begin, count)
+        else:
+            self._graph.graph = np.concatenate(
+                [self._graph.graph,
+                 np.full((count, self._graph.graph.shape[1]), -1,
+                         np.int32)], axis=0)
         self._adds_since_rebuild += count
         if self._adds_since_rebuild >= self.params.add_count_for_rebuild:
             self._adds_since_rebuild = 0
@@ -574,16 +618,17 @@ class BKTIndex(VectorIndex):
 
         self._tree = self._new_tree()
         self._tree.build(self._host[:self._n])
-        try:
-            self._graph.refine_once(
-                self._host[:self._n],
-                self._refine_search_factory(self._graph.graph),
-                self._graph.neighborhood_size, int(self.dist_calc_method),
-                self.base)
-        finally:
-            # free the refine-time device snapshot (same as _build's clear)
-            self._refine_dense_cache = None
-        self._graph.repair_connectivity()
+        if getattr(self.params, "build_graph", 1):
+            try:
+                self._graph.refine_once(
+                    self._host[:self._n],
+                    self._refine_search_factory(self._graph.graph),
+                    self._graph.neighborhood_size,
+                    int(self.dist_calc_method), self.base)
+            finally:
+                # free the refine-time device snapshot (as _build's clear)
+                self._refine_dense_cache = None
+            self._graph.repair_connectivity()
         self._adds_since_rebuild = 0
         self._dirty = True
 

@@ -51,6 +51,9 @@ MAX_DIST = np.float32(3.4e38)
 # Distance at-or-below which a searched vector counts as "the same vector"
 # for DeleteIndex(vector) (reference BKTIndex.cpp:439-453 uses 1e-6).
 DELETE_EPS = 1e-6
+# pre-filter width for the exact-recheck in delete(): wide enough to admit
+# any true duplicate's expanded-form f32 residue at realistic norms
+_NEAR_EPS = 1e-2
 
 
 @dataclass
@@ -298,12 +301,26 @@ class VectorIndex(abc.ABC):
         k = int(getattr(self.params, "cef", 32))
         dists, ids = self._search_batch(data, min(k, self.num_samples))
         with self._lock:
-            for row_d, row_i in zip(dists, ids):
+            for q, row_d, row_i in zip(data, dists, ids):
                 for d, v in zip(row_d, row_i):
-                    if v >= 0 and d <= DELETE_EPS:
+                    if v >= 0 and d <= max(DELETE_EPS, _NEAR_EPS) and \
+                            self._exact_distance(q, int(v)) <= DELETE_EPS:
                         self._delete_id(int(v))
                         found_any = True
         return ErrorCode.Success if found_any else ErrorCode.VectorNotFound
+
+    def _exact_distance(self, q: np.ndarray, vid: int) -> float:
+        """Host recheck of one candidate at float64, by DIRECT subtraction/
+        dot — the reference compares its (exactly-zero-on-identical) scalar
+        L2 against 1e-6 (BKTIndex.cpp:439-453), while the MXU expanded form
+        ||q||^2+||x||^2-2qx leaves an O(||x||^2 * eps_f32) residue on
+        identical rows that would fail that test on large-norm data."""
+        x = self.get_sample(vid).astype(np.float64)
+        qf = q.astype(np.float64)
+        if self.dist_calc_method == DistCalcMethod.L2:
+            diff = qf - x
+            return float((diff * diff).sum())
+        return float(self.base) ** 2 - float(qf @ x)
 
     def delete_by_metadata(self, meta: bytes) -> ErrorCode:
         """Parity: VectorIndex::DeleteIndex(ByteArray) (VectorIndex.cpp:235-242)."""

@@ -142,6 +142,16 @@ class BKTParams(ParamSet):
             # target cluster size
             _spec("search_mode", str, "dense", "SearchMode"),
             _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
+            # 0 = dense-only build (framework extension): skip the RNG
+            # graph entirely — the index serves the MXU partition scan
+            # only, beam search raises.  Build cost drops to the k-means
+            # forest + layout (the graph's TPT + refine passes are the
+            # dominant build cost), which is what makes 10M-row
+            # single-chip corpora buildable in minutes.  Pair with a
+            # coarse BKTLeafSize (~DenseClusterSize/2): the partition cut
+            # never descends below the cluster size, so deep leaves buy
+            # nothing a shallow forest doesn't
+            _spec("build_graph", int, 1, "BuildGraph"),
             # closure assignment: each row is also packed into its
             # (replicas-1) nearest other blocks — boundary-row recall at
             # ~replicas x block memory and the same per-query score count
@@ -204,6 +214,8 @@ class KDTParams(ParamSet):
             # KDT search; the MXU dense scan is the opt-in fast path
             _spec("search_mode", str, "beam", "SearchMode"),
             _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
+            # 0 = dense-only build; see the BKT spec of the same name
+            _spec("build_graph", int, 1, "BuildGraph"),
             _spec("dense_replicas", int, 1, "DenseReplicas"),
             _spec("dense_query_group", int, 0, "DenseQueryGroup"),
             _spec("dense_union_factor", int, 2, "DenseUnionFactor"),

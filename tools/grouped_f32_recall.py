@@ -30,6 +30,12 @@ sys.path.insert(0, REPO)
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
     nq = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        # the env var alone does not stop the pre-registered axon plugin
+        # from initializing (and hanging on a down tunnel) — pin the list
+        jax.config.update("jax_platforms", "cpu")
     import bench
     import sptag_tpu as sp
     from sptag_tpu.utils import enable_compile_cache
