@@ -123,6 +123,8 @@ def pipeline(stages) -> None:
         run_stage("sweep", [py, "tools/sweep_modes.py", "200000"], 3600)
     if "4" in stages:
         run_stage("dense_tune", [py, "tools/dense_tune.py", "200000"], 3600)
+    if "5" in stages:
+        run_stage("scale_rows", [py, "tools/deep1b_single_chip.py"], 7200)
 
 
 def main() -> None:
