@@ -680,6 +680,11 @@ class ShardedBKTIndex:
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
+        if not int(getattr(self.params, "build_graph", 1)):
+            raise RuntimeError(
+                "mesh beam search needs the RNG graph, but the shards were "
+                "built with BuildGraph=0 (dense-only); use search_dense or "
+                "rebuild with BuildGraph=1")
         if self.metric == DistCalcMethod.Cosine and not normalized:
             queries = dist_ops.normalize(queries, self.base)
         max_check = max_check if max_check is not None else self.max_check

@@ -72,6 +72,27 @@ def test_dense_only_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(d0, d1, rtol=1e-6)
 
 
+def test_dense_only_sharded_mesh():
+    """BuildGraph=0 flows through the mesh build: dense search works over
+    8 shards, beam refuses — the 8-shard dense-only program is exactly
+    BASELINE config 3's topology (tools/deep1b_single_chip.py measures
+    the single-chip aggregate)."""
+    from sptag_tpu.parallel.sharded import ShardedBKTIndex
+
+    data, queries = _corpus(n=4000)
+    truth = _truth(data, queries, 10)
+    idx = ShardedBKTIndex.build(
+        data, dense=True,
+        params={"BuildGraph": "0", "BKTLeafSize": "64",
+                "DenseClusterSize": "128", "MaxCheck": "1024"})
+    _, ids = idx.search_dense(queries, 10)
+    recall = np.mean([len(set(ids[i]) & set(truth[i])) / 10
+                      for i in range(len(queries))])
+    assert recall > 0.85, recall
+    with pytest.raises(RuntimeError, match="BuildGraph=0"):
+        idx.search(queries[:4], 5)
+
+
 def test_dense_only_add_delete():
     data, queries = _corpus(n=2000)
     idx = _build(data)
