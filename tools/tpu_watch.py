@@ -121,6 +121,11 @@ def pipeline(stages) -> None:
                   7200)
     if "3" in stages:
         run_stage("sweep", [py, "tools/sweep_modes.py", "200000"], 3600)
+        # second index at refine budget 2048: beam recall with a
+        # production-quality graph (the 512-budget default caps it)
+        run_stage("sweep_refine2048",
+                  [py, "tools/sweep_modes.py", "200000"], 5400,
+                  env={"SWEEP_REFINE_BUDGET": "2048"})
     if "4" in stages:
         run_stage("dense_tune", [py, "tools/dense_tune.py", "200000"], 3600)
     if "5" in stages:
