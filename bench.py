@@ -478,6 +478,11 @@ def run_bench():
                 # failure of the beam metrics already recorded above
                 try:
                     idxk.set_parameter("SearchMode", "dense")
+                    # kd-cell partitions lose boundary neighbors badly;
+                    # closure replicas recover them (measured 50k CPU:
+                    # recall 0.859 -> 0.975 at replicas=2,
+                    # reports/KDT_DENSE_REPLICAS.md)
+                    idxk.set_parameter("DenseReplicas", "2")
                     idskd, qpskd, _ = timed_sweep(idxk, queriesk, k, batch,
                                                   budget_s, repeats=1)
                     result.update({
