@@ -1,0 +1,102 @@
+"""BeamWidth ladder experiment: does a wider per-iteration pop close the
+beam/dense throughput gap further?
+
+The walk is overhead-bound, not bandwidth-bound (algo/engine.py module
+docstring): its cost is the SERIAL iteration count T = ceil(MaxCheck/B)
+times a fixed per-iteration cost.  `beam_width_for` auto-scales B up to a
+cap of 64 (measured recall-flat 16 -> 64 on the 200k corpus).  This tool
+sweeps EXPLICIT BeamWidth values past the cap — an explicit value is a
+floor the engine honors as-is — to measure where recall starts paying for
+the extra width.  Counterpart knob in the reference: one node per pop,
+always (/root/reference/AnnService/src/Core/BKT/BKTIndex.cpp:110-156);
+width is a TPU-only degree of freedom.
+
+Reuses the bench's cached 200k index (tag bkt_f32_n200000); run AFTER
+bench.py has built it or the build cost is paid here.
+
+Usage: python tools/beam_width_tune.py [n] [out_path]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    out_path = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "reports", "BEAM_WIDTH.md")
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from sptag_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+
+    import sptag_tpu as sp
+    from bench import (make_dataset, _bkt_params, l2_truth, build_or_load,
+                       recall_at_k)
+
+    k = 10
+    nq = int(os.environ.get("BW_TUNE_NQ", "2048"))
+    checks = tuple(int(c) for c in
+                   os.environ.get("BW_TUNE_CHECKS", "2048,8192").split(","))
+    widths = tuple(int(w) for w in
+                   os.environ.get("BW_TUNE_WIDTHS", "0,64,128,256").split(","))
+    data, queries = make_dataset(n=n, nq=nq)
+    truth = l2_truth(data, queries, k)
+
+    def build():
+        index = sp.create_instance("BKT", "Float")
+        index.set_parameter("DistCalcMethod", "L2")
+        _bkt_params(index, n)
+        index.build(data)
+        return index
+
+    index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build, 1e9)
+    index.set_parameter("SearchMode", "beam")
+    dev = jax.devices()[0].platform
+
+    lines = [
+        "# BeamWidth ladder — beam-mode throughput vs width",
+        "",
+        f"Corpus n={n}, d=128, f32/L2; 2048 queries; recall@{k} vs exact "
+        f"truth; platform={dev}; index cached={cached}.",
+        "",
+        "| MaxCheck | BeamWidth | T iters | recall@10 | QPS |",
+        "|---|---|---|---|---|",
+    ]
+    from sptag_tpu.algo.engine import beam_pool_size, beam_width_for
+    for max_check in checks:
+        index.set_parameter("MaxCheck", str(max_check))
+        for bw in widths:
+            # bw=0 row = the auto ladder (beam_width_for's choice)
+            index.set_parameter("BeamWidth", str(bw if bw else 16))
+            L = beam_pool_size(k, max_check, n)
+            eff_b = beam_width_for(bw if bw else 16, max_check, L)
+            t_iters = -(-max_check // eff_b)
+            index.search_batch(queries, k)             # compile + warm
+            best = float("inf")
+            ids = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _, ids = index.search_batch(queries, k)
+                best = min(best, time.perf_counter() - t0)
+            recall = recall_at_k(ids[:, :k], truth, k)
+            lines.append(
+                f"| {max_check} | {'auto' if not bw else bw} ({eff_b}) | "
+                f"{t_iters} | {recall:.4f} | {len(queries) / best:,.0f} |")
+            print(lines[-1], flush=True)
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

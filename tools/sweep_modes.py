@@ -44,7 +44,8 @@ def main():
     # first 512 queries, the throughput section the full 2048
     data, queries_t = make_dataset(n=n, nq=2048)
     queries = queries_t[:512]
-    truth = l2_truth(data, queries, k)
+    truth_t = l2_truth(data, queries_t, k)
+    truth = truth_t[:512]
 
     # SWEEP_REFINE_BUDGET overrides MaxCheckForRefineGraph at build time
     # (own cache tag).  The bench's default 512 targets the <600 s cold
@@ -109,7 +110,6 @@ def main():
     # of once per 256-query batch.  The small-batch loop above remains the
     # latency harness (reference IndexSearcher reports per-query latency).
     nq_t = len(queries_t)
-    truth_t = l2_truth(data, queries_t, k)
     index.set_parameter("MaxCheck", "2048")
     lines += ["", "### Throughput (2048-query chunked batch, MaxCheck=2048)",
               "", "| mode | recall@10 | QPS |", "|---|---|---|"]
