@@ -91,14 +91,30 @@ def run_stage(name: str, cmd, timeout_s: float, env=None) -> bool:
         tail = (out.stdout.strip() or out.stderr.strip())[-2000:]
         log(f"stage {name} rc={out.returncode} in {time.time()-t0:.0f}s:\n"
             f"{tail}")
-        if name == "bench" and out.returncode == 0:
+        if name == "bench":
+            # bench.py ALWAYS exits 0 with a JSON line (the driver contract)
+            # — a tunnel death mid-run yields rc=0 with an "error" field.
+            # Success for the pipeline = a clean line with a real value, so
+            # a failed bench re-runs on the next healthy probe instead of
+            # being marked done with a zero-QPS artifact.
+            if out.returncode != 0:
+                return False
             for line in reversed(out.stdout.strip().splitlines()):
                 if line.startswith("{"):
-                    with open(os.path.join(REPO, "reports",
-                                           "bench_tpu_live.json"),
-                              "w") as f:
-                        f.write(line + "\n")
-                    break
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        return False
+                    ok = (not obj.get("error")
+                          and obj.get("value", 0) > 0
+                          and obj.get("platform") != "cpu")
+                    if ok:
+                        with open(os.path.join(REPO, "reports",
+                                               "bench_tpu_live.json"),
+                                  "w") as f:
+                            f.write(line + "\n")
+                    return ok
+            return False
         return out.returncode == 0
     except subprocess.TimeoutExpired:
         log(f"stage {name} exceeded {timeout_s:.0f}s — killed")
