@@ -197,14 +197,31 @@ def build_or_load(tag, builder, budget_s):
         t0 = time.perf_counter()
         index = sp.load_index(folder)
         return index, time.perf_counter() - t0, True
+    # resumable build: a tunnel death mid-build leaves stage checkpoints
+    # behind, and the retry (watcher re-run or next bench invocation)
+    # resumes at the first incomplete stage instead of restarting an
+    # hour-long build (core/index.py build(), utils/build_ckpt.py)
+    ckpt_root = os.path.join(CACHE_DIR, "build_ckpt")
+    had_env = os.environ.get("SPTAG_TPU_BUILD_CKPT")
+    os.environ["SPTAG_TPU_BUILD_CKPT"] = ckpt_root
     t0 = time.perf_counter()
-    index = builder()
+    try:
+        index = builder()
+    finally:
+        if had_env is None:
+            os.environ.pop("SPTAG_TPU_BUILD_CKPT", None)
+        else:
+            os.environ["SPTAG_TPU_BUILD_CKPT"] = had_env
     build_s = time.perf_counter() - t0
     try:
         index.save_index(folder)
     except Exception:                                   # noqa: BLE001
         pass                      # cache write failure must not fail the run
-    return index, build_s, False
+    # "resumed" (truthy) distinguishes a stage-checkpoint resume from both
+    # a full cold build (False) and a cache load (True): its build_s only
+    # covers the stages the retry actually ran
+    resumed = getattr(index, "build_resumed", False)
+    return index, build_s, ("resumed" if resumed else False)
 
 
 # graph/search knobs shared by every bench config, tuned for the synthetic

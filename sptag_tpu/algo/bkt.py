@@ -27,6 +27,7 @@ built and persisted for tree-format compatibility.
 
 from __future__ import annotations
 
+import io
 import logging
 import os
 import threading
@@ -251,7 +252,7 @@ class BKTIndex(VectorIndex):
 
     # ---- build ------------------------------------------------------------
 
-    def _build(self, data: np.ndarray) -> None:
+    def _build(self, data: np.ndarray, checkpoint=None) -> None:
         self._host = np.ascontiguousarray(data)
         self._n = data.shape[0]
         self._deleted = np.zeros(self._n, bool)
@@ -259,9 +260,25 @@ class BKTIndex(VectorIndex):
         self._adds_since_rebuild = 0
         self._structure_gen += 1
 
-        self._tree = self._new_tree()
-        with trace.span("build.bkt_tree"):
-            self._tree.build(self._host[:self._n])
+        # resumable build (utils/build_ckpt.py): the tree stage is loaded
+        # from the checkpoint when a prior run already finished it
+        self._tree = None
+        if checkpoint is not None:
+            raw = checkpoint.get_bytes("tree")
+            if raw is not None:
+                try:
+                    self._tree = self._load_tree(io.BytesIO(raw))
+                    log.info("build resume: tree stage from checkpoint")
+                except Exception:                      # noqa: BLE001
+                    self._tree = None                  # corrupt -> rebuild
+        if self._tree is None:
+            self._tree = self._new_tree()
+            with trace.span("build.bkt_tree"):
+                self._tree.build(self._host[:self._n])
+            if checkpoint is not None:
+                buf = io.BytesIO()
+                self._tree.save(buf)
+                checkpoint.put_bytes("tree", buf.getvalue())
         log.info("BKT forest built: %d nodes", self._tree.num_nodes)
 
         self._graph = self._new_graph()
@@ -281,7 +298,8 @@ class BKTIndex(VectorIndex):
             with trace.span("build.rng_graph"):
                 self._graph.build(self._host[:self._n],
                                   int(self.dist_calc_method), self.base,
-                                  self._refine_search_factory)
+                                  self._refine_search_factory,
+                                  checkpoint=checkpoint)
         finally:
             # free the mid-build device snapshot even when the build dies
             self._refine_dense_cache = None

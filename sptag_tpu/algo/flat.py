@@ -125,7 +125,8 @@ class FlatIndex(VectorIndex):
             dels[:self._n] = self._deleted[:self._n]
             self._deleted = dels
 
-    def _build(self, data: np.ndarray) -> None:
+    def _build(self, data: np.ndarray, checkpoint=None) -> None:
+        # exact index: single-stage build, nothing to checkpoint
         self._host = np.ascontiguousarray(data)
         self._n = data.shape[0]
         self._deleted = np.zeros(self._n, dtype=bool)

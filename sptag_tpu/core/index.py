@@ -114,8 +114,13 @@ class VectorIndex(abc.ABC):
     def _make_params(self) -> ParamSet: ...
 
     @abc.abstractmethod
-    def _build(self, data: np.ndarray) -> None:
-        """Build index structures over `data` (already normalized if cosine)."""
+    def _build(self, data: np.ndarray, checkpoint=None) -> None:
+        """Build index structures over `data` (already normalized if cosine).
+
+        `checkpoint` (utils/build_ckpt.BuildCheckpoint or None): stage
+        store for resumable builds — implementations that run multi-stage
+        pipelines load completed stages from it and save each stage as it
+        finishes; exact (single-stage) indexes ignore it."""
 
     @abc.abstractmethod
     def _search_batch(self, queries: np.ndarray, k: int,
@@ -198,16 +203,39 @@ class VectorIndex(abc.ABC):
     # ---- build / search ---------------------------------------------------
 
     def build(self, vectors, metadata: Optional[MetadataSet] = None,
-              with_meta_index: bool = False) -> ErrorCode:
-        """Parity: VectorIndex::BuildIndex (reference VectorIndex.cpp:192-208)."""
+              with_meta_index: bool = False,
+              checkpoint_dir: Optional[str] = None) -> ErrorCode:
+        """Parity: VectorIndex::BuildIndex (reference VectorIndex.cpp:192-208).
+
+        `checkpoint_dir` (or env SPTAG_TPU_BUILD_CKPT) enables RESUMABLE
+        builds — a framework extension with no reference counterpart: each
+        completed build stage (tree, per-TPT-tree candidate merge, refine
+        pass) is checkpointed there, and a re-run over the same data +
+        params resumes at the first incomplete stage instead of restarting
+        a possibly hour-long build after a backend death.  The checkpoint
+        is fingerprint-bound (utils/build_ckpt.py) and removed on success.
+        """
         data = self._prepare_vectors(vectors)
         if data.size == 0:
             return ErrorCode.EmptyData
+        if checkpoint_dir is None:
+            checkpoint_dir = os.environ.get("SPTAG_TPU_BUILD_CKPT") or None
+        ck = None
+        if checkpoint_dir:
+            from sptag_tpu.utils.build_ckpt import (BuildCheckpoint,
+                                                    build_fingerprint)
+            config = (f"{type(self).__name__}:{int(self.value_type)}:"
+                      f"{sorted(self.params.__dict__.items())!r}")
+            ck = BuildCheckpoint(checkpoint_dir,
+                                 build_fingerprint(data, config))
         with self._lock:
-            self._build(data)
+            self._build(data, checkpoint=ck)
             self.metadata = metadata
             if with_meta_index and metadata is not None:
                 self.build_meta_mapping()
+        self.build_resumed = ck is not None and ck.resumed
+        if ck is not None:
+            ck.clear()
         return ErrorCode.Success
 
     def build_meta_mapping(self) -> None:

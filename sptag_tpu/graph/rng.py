@@ -24,6 +24,7 @@ sequential semantics a TPU batch cannot and need not reproduce).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
@@ -42,6 +43,8 @@ MAX_DIST = np.float32(3.4e38)
 _ALLPAIRS_BUDGET = 1 << 26
 # node rows per rng_select / refine chunk
 _PRUNE_CHUNK = 4096
+# min seconds between candidate-stage checkpoint rewrites (build_candidates)
+_CKPT_MIN_INTERVAL_S = 60.0
 
 # SearchFn(queries (Q, D), k) -> (dists (Q, k), ids (Q, k))
 SearchFn = Callable[[np.ndarray, int], Tuple[np.ndarray, np.ndarray]]
@@ -78,24 +81,49 @@ class RelativeNeighborhoodGraph:
 
     def build(self, data: np.ndarray, metric: int, base: int,
               search_fn_factory: Optional[Callable[[np.ndarray], SearchFn]]
-              = None, seed: int = 31) -> None:
+              = None, seed: int = 31, checkpoint=None) -> None:
         """Full build: TPT candidates, then refine passes.
 
         `search_fn_factory(graph)` returns a SearchFn over the *current*
         graph (the index wires the beam engine in); when None, refine falls
         back to candidate-only pruning (no re-search).
+
+        `checkpoint` (utils/build_ckpt.BuildCheckpoint): resumable-build
+        stage store — each refine pass saves its output graph, and a
+        resumed build skips every pass a prior run completed (the
+        candidate stage checkpoints per TPT tree inside build_candidates).
         """
-        with trace.span("build.tpt_candidates"):
-            cand_ids, cand_d = self.build_candidates(data, metric, base,
-                                                     seed)
         m = self.neighborhood_size
         passes = max(self.refine_iterations, 1)
-        for it in range(passes):
+        # pass-level resume only applies with a search factory: without
+        # one, every pass re-prunes the SAME candidate lists (narrowing
+        # width), so the candidate checkpoint already covers the restart
+        start = 0
+        if checkpoint is not None and search_fn_factory is not None:
+            for it in reversed(range(passes - 1)):     # last pass not saved
+                saved = checkpoint.get_arrays(f"graph_pass{it}")
+                if saved is not None:
+                    self.graph = saved["graph"]
+                    start = it + 1
+                    log.info("build resume: refine pass %d/%d from "
+                             "checkpoint", it + 1, passes)
+                    break
+        cand_ids = cand_d = None
+        if start == 0:
+            with trace.span("build.tpt_candidates"):
+                cand_ids, cand_d = self.build_candidates(
+                    data, metric, base, seed, checkpoint=checkpoint)
+        # candidate-list width; mirrors build_candidates' C when the
+        # candidate stage was skipped by a pass-level resume
+        C = (cand_ids.shape[1] if cand_ids is not None else
+             min(max(m * self.neighborhood_scale, 1),
+                 max(data.shape[0] - 1, 1)))
+        for it in range(start, passes):
             last = it == passes - 1
-            width = m if last else min(cand_ids.shape[1],
-                                       m * self.neighborhood_scale)
+            width = m if last else min(C, m * self.neighborhood_scale)
             if it == 0 or search_fn_factory is None:
-                # first pass prunes the TPT candidates directly
+                # first pass (or no-factory mode) prunes the TPT
+                # candidates directly
                 with trace.span("build.rng_prune"):
                     self.graph = self.prune_candidates(
                         data, cand_ids, cand_d, width, metric, base)
@@ -104,6 +132,11 @@ class RelativeNeighborhoodGraph:
                     self.refine_once(data, search_fn_factory(self.graph),
                                      width, metric, base)
             log.info("RNG refine pass %d/%d width=%d", it + 1, passes, width)
+            if (checkpoint is not None and search_fn_factory is not None
+                    and not last):
+                # the final pass is not checkpointed: the full build's own
+                # save (or the bench cache) captures the finished graph
+                checkpoint.put_arrays(f"graph_pass{it}", graph=self.graph)
         self.repair_connectivity()
 
     def repair_connectivity(self) -> None:
@@ -160,21 +193,37 @@ class RelativeNeighborhoodGraph:
             log.info("connectivity repair: %d orphan nodes linked", fixed)
 
     def build_candidates(self, data: np.ndarray, metric: int, base: int,
-                         seed: int) -> Tuple[np.ndarray, np.ndarray]:
+                         seed: int, checkpoint=None
+                         ) -> Tuple[np.ndarray, np.ndarray]:
         """TPT forest -> (N, C) best-candidate lists, ascending distance.
 
         Parity: the TPT scatter phase of BuildGraph (NeighborhoodGraph.h:
         61-110); one `leaf_allpairs_topk` + `merge_candidates` device program
         pair per tree replaces the per-pair AddNeighbor insertion sorts.
+
+        Each tree draws from its own `[seed, t]`-keyed generator so a
+        checkpointed resume (`checkpoint` stage "candidates") reproduces
+        the exact partition stream the interrupted run would have used.
         """
         n = data.shape[0]
         C = min(max(self.neighborhood_size * self.neighborhood_scale, 1),
                 max(n - 1, 1))
-        rng = np.random.default_rng(seed)
         cand_ids = np.full((n, C), -1, np.int32)
         cand_d = np.full((n, C), MAX_DIST, np.float32)
+        start_t = 0
+        if checkpoint is not None:
+            saved = checkpoint.get_arrays("candidates")
+            if (saved is not None
+                    and saved["cand_ids"].shape == cand_ids.shape):
+                cand_ids = saved["cand_ids"]
+                cand_d = saved["cand_d"]
+                start_t = int(saved["trees_done"])
+                log.info("build resume: %d/%d TPT trees from checkpoint",
+                         start_t, self.tpt_number)
 
-        for t in range(self.tpt_number):
+        last_save = time.monotonic()
+        for t in range(start_t, self.tpt_number):
+            rng = np.random.default_rng([seed, t])
             leaves = tpt_partition(data, self.tpt_leaf_size,
                                    self.tpt_top_dims, self.tpt_samples, rng)
             new_ids, new_d = self._tree_candidates(
@@ -185,6 +234,18 @@ class RelativeNeighborhoodGraph:
             cand_ids = np.asarray(merged_ids)
             cand_d = np.asarray(merged_d)
             log.info("TPT tree %d/%d merged", t + 1, self.tpt_number)
+            if checkpoint is not None:
+                # throttled: the (N, C) arrays can be ~100 MB — rewriting
+                # them after EVERY tree would put O(trees x N x C) of
+                # synchronous IO on the build path for little extra resume
+                # granularity.  Always write the final tree's merge.
+                now = time.monotonic()
+                if (t + 1 == self.tpt_number
+                        or now - last_save >= _CKPT_MIN_INTERVAL_S):
+                    checkpoint.put_arrays("candidates", cand_ids=cand_ids,
+                                          cand_d=cand_d,
+                                          trees_done=np.int64(t + 1))
+                    last_save = now
         return cand_ids, cand_d
 
     def _tree_candidates(self, data, leaves, C, metric, base):

@@ -105,7 +105,12 @@ def run_stage(name: str, cmd, timeout_s: float, env=None) -> bool:
                         obj = json.loads(line)
                     except ValueError:
                         return False
-                    ok = (not obj.get("error")
+                    # bench failure spellings: "error" (in-process),
+                    # "child_error" (watchdog emitted a checkpointed
+                    # partial), "tpu_child_error" (CPU-fallback line)
+                    ok = (not any(obj.get(k) for k in
+                                  ("error", "child_error",
+                                   "tpu_child_error"))
                           and obj.get("value", 0) > 0
                           and obj.get("platform") != "cpu")
                     if ok:
