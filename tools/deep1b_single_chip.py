@@ -175,6 +175,10 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    # resumable builds: the 10M-row tree stage is the long pole here — a
+    # tunnel death mid-build resumes instead of restarting (build_ckpt.py)
+    os.environ.setdefault("SPTAG_TPU_BUILD_CKPT",
+                          os.path.join(CACHE, "build_ckpt"))
     results = []
     for name in args.configs.split(","):
         fn = {"deep1b": run_deep1b, "laion": run_laion_slice}[name]
