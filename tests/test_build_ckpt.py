@@ -110,6 +110,51 @@ def test_interrupted_build_resumes_completed_stages(tmp_path, monkeypatch):
     assert np.array_equal(ip, ir)
 
 
+def test_kdt_interrupted_build_resumes(tmp_path, monkeypatch):
+    """KDT inherits the resumable _build — its checkpointed tree must load
+    back as a KDTree (KDTIndex overrides _load_tree), not a BKTree."""
+    from sptag_tpu.trees.kdtree import KDTree
+
+    data = _mk_data()
+    ck_dir = str(tmp_path / "ck")
+    index = sp.create_instance("KDT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    for k, v in (("KDTNumber", "1"), ("TPTNumber", "2"),
+                 ("TPTLeafSize", "64"), ("NeighborhoodSize", "8"),
+                 ("CEF", "32"), ("MaxCheckForRefineGraph", "64"),
+                 ("RefineIterations", "2"), ("MaxCheck", "256")):
+        index.set_parameter(k, v)
+
+    real_refine = RelativeNeighborhoodGraph.refine_once
+
+    def dying_refine(self, *a, **kw):
+        raise RuntimeError("tunnel died")
+
+    monkeypatch.setattr(RelativeNeighborhoodGraph, "refine_once",
+                        dying_refine)
+    with pytest.raises(RuntimeError):
+        index.build(data, checkpoint_dir=ck_dir)
+    monkeypatch.setattr(RelativeNeighborhoodGraph, "refine_once",
+                        real_refine)
+
+    def no_tree_build(self, *a, **kw):
+        raise AssertionError("KDT tree stage re-ran on resume")
+
+    monkeypatch.setattr(KDTree, "build", no_tree_build)
+    resumed = sp.create_instance("KDT", "Float")
+    resumed.set_parameter("DistCalcMethod", "L2")
+    for k, v in (("KDTNumber", "1"), ("TPTNumber", "2"),
+                 ("TPTLeafSize", "64"), ("NeighborhoodSize", "8"),
+                 ("CEF", "32"), ("MaxCheckForRefineGraph", "64"),
+                 ("RefineIterations", "2"), ("MaxCheck", "256")):
+        resumed.set_parameter(k, v)
+    assert resumed.build(data, checkpoint_dir=ck_dir) == sp.ErrorCode.Success
+    assert resumed.build_resumed
+    assert isinstance(resumed._tree, KDTree)
+    _, ids = resumed.search_batch(data[:8], 5)
+    assert (ids[:, 0] == np.arange(8)).all()
+
+
 def test_fingerprint_binds_data_and_params(tmp_path):
     data = _mk_data()
     other = _mk_data(seed=4)
