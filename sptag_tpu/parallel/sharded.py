@@ -450,7 +450,13 @@ class ShardedBKTIndex:
         `data`) is held at the frontend keyed by global id — the mesh
         search returns original corpus ids, so one store serves all
         shards; persisted in reference metadata.bin/metadataIndex.bin
-        format at the mesh-folder top level when `save_to` is given."""
+        format at the mesh-folder top level when `save_to` is given.
+
+        With SPTAG_TPU_BUILD_CKPT set, each shard's build is resumable
+        (utils/build_ckpt.py): shard blocks differ, so their fingerprints
+        key distinct checkpoint subfolders — a death in shard s re-runs
+        shards [0, s) from their finished checkpoints' stages and resumes
+        s where it stopped."""
         from sptag_tpu.core.index import create_instance
         from sptag_tpu.core.types import value_type_of
 
