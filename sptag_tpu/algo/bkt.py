@@ -366,13 +366,17 @@ class BKTIndex(VectorIndex):
     # ---- search -----------------------------------------------------------
 
     def _search_batch(self, queries: np.ndarray, k: int,
-                      max_check: Optional[int] = None
+                      max_check: Optional[int] = None,
+                      search_mode: Optional[str] = None
                       ) -> Tuple[np.ndarray, np.ndarray]:
         if self._n == 0:
             raise RuntimeError("index is empty")
         p = self.params
         mc = max_check if max_check is not None else p.max_check
-        if getattr(p, "search_mode", "beam") == "dense":
+        mode = search_mode or getattr(p, "search_mode", "beam")
+        if mode not in ("beam", "dense"):
+            raise ValueError(f"unknown search mode {mode!r}")
+        if mode == "dense":
             d, ids = self._get_dense().search(
                 queries, min(k, self._n), max_check=mc,
                 group=getattr(p, "dense_query_group", 0),

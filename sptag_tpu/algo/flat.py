@@ -174,11 +174,12 @@ class FlatIndex(VectorIndex):
     # ---- search -----------------------------------------------------------
 
     def _search_batch(self, queries: np.ndarray, k: int,
-                      max_check: Optional[int] = None
+                      max_check: Optional[int] = None,
+                      search_mode: Optional[str] = None
                       ) -> Tuple[np.ndarray, np.ndarray]:
         if self._n == 0:
             raise RuntimeError("index is empty")
-        del max_check                      # exact scan: no budget to bound
+        del max_check, search_mode      # exact scan: no budget, no modes
         data_d, sqnorm_d, invalid_d = self._snapshot()
         q = queries.shape[0]
         q_pad = _query_bucket(q)

@@ -124,12 +124,15 @@ class VectorIndex(abc.ABC):
 
     @abc.abstractmethod
     def _search_batch(self, queries: np.ndarray, k: int,
-                      max_check: Optional[int] = None
+                      max_check: Optional[int] = None,
+                      search_mode: Optional[str] = None
                       ) -> Tuple[np.ndarray, np.ndarray]:
         """(Q, D) queries (already normalized if cosine) -> ((Q, K) dists,
         (Q, K) int32 ids), ascending, -1/MAX_DIST padded, excluding deleted.
         `max_check` overrides the MaxCheck parameter for this call (budgeted
-        indexes only; exact indexes ignore it)."""
+        indexes only; exact indexes ignore it).  `search_mode` overrides
+        the SearchMode parameter ("beam"/"dense") for this call (graph
+        indexes only)."""
 
     @abc.abstractmethod
     def _add(self, data: np.ndarray) -> int:
@@ -248,21 +251,24 @@ class VectorIndex(abc.ABC):
         self._meta_to_vec = mapping
 
     def search(self, query, k: int = 10, with_metadata: bool = False,
-               max_check: Optional[int] = None) -> SearchResult:
+               max_check: Optional[int] = None,
+               search_mode: Optional[str] = None) -> SearchResult:
         dists, ids = self.search_batch(np.asarray(query)[None, :], k,
-                                       max_check=max_check)
+                                       max_check=max_check,
+                                       search_mode=search_mode)
         metas = (metas_for(self.metadata, ids[0])
                  if with_metadata else None)
         return SearchResult(ids[0], dists[0], metas)
 
     def search_batch(self, queries: np.ndarray, k: int = 10,
-                     max_check: Optional[int] = None
+                     max_check: Optional[int] = None,
+                     search_mode: Optional[str] = None
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Batch search: the whole (Q, D) block is one device program —
         replaces the reference's OpenMP parallel-for over queries
-        (VectorIndex.cpp:212-220).  `max_check` overrides the MaxCheck
-        parameter for this call only (stateless — safe under concurrent
-        searches, unlike set_parameter)."""
+        (VectorIndex.cpp:212-220).  `max_check` and `search_mode` override
+        the MaxCheck / SearchMode parameters for this call only (stateless
+        — safe under concurrent searches, unlike set_parameter)."""
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
@@ -270,7 +276,7 @@ class VectorIndex(abc.ABC):
             raise ValueError(
                 f"query dim {queries.shape[1]} != index dim {self.feature_dim}")
         queries = self._prepare_query(queries)
-        return self._search_batch(queries, k, max_check)
+        return self._search_batch(queries, k, max_check, search_mode)
 
     def _prepare_query(self, queries: np.ndarray) -> np.ndarray:
         """Queries are normalized for cosine, like the reference harness does

@@ -297,26 +297,35 @@ class ServingAdapter:
         return self._impl.n
 
     def search_batch(self, queries: np.ndarray, k: int = 10,
-                     max_check: Optional[int] = None
+                     max_check: Optional[int] = None,
+                     search_mode: Optional[str] = None
                      ) -> Tuple[np.ndarray, np.ndarray]:
-        """`max_check` overrides the build-time budget per request in both
-        serving modes (reachable over the wire via the framework's
-        `$maxcheck` query option — an extension; the reference has no
-        per-request budget knob, serve/protocol.py docstring)."""
-        if self.mode == "dense":
+        """`max_check` / `search_mode` override the adapter's build-time
+        budget and configured mode per request (reachable over the wire
+        via the framework's `$maxcheck` / `$searchmode` query options —
+        extensions; the reference has no per-request knobs,
+        serve/protocol.py docstring).  A `$searchmode:dense` request on an
+        adapter whose index was not packed dense raises, surfaced as
+        FailedExecute by the service layer."""
+        mode = search_mode or self.mode
+        if mode not in ("beam", "dense"):     # same contract as the ctor
+            raise ValueError(f"unknown serving mode: {mode!r}")
+        if mode == "dense":
             return self._impl.search_dense(np.asarray(queries), k=k,
                                            max_check=max_check)
         return self._impl.search(np.asarray(queries), k=k,
                                  max_check=max_check)
 
     def search(self, query, k: int = 10, with_metadata: bool = False,
-               max_check: Optional[int] = None):
+               max_check: Optional[int] = None,
+               search_mode: Optional[str] = None):
         from sptag_tpu.core.index import SearchResult
 
         q = np.asarray(query)
         if q.ndim == 1:
             q = q[None, :]
-        d, ids = self.search_batch(q, k=k, max_check=max_check)
+        d, ids = self.search_batch(q, k=k, max_check=max_check,
+                                   search_mode=search_mode)
         from sptag_tpu.core.vectorset import metas_for
         metas = metas_for(self.metadata, ids[0]) if with_metadata else None
         return SearchResult(ids=ids[0], dists=d[0], metas=metas)

@@ -13,10 +13,13 @@ QueryParser.cpp:28-181) and SearchExecutionContext option extraction
 * recognized options: ``indexname`` (comma-separated list), ``datatype``
   (Int8/UInt8/Int16/Float), ``extractmetadata`` (true/false), ``resultnum``.
 
-Framework extension beyond the reference's four options: ``maxcheck``
+Framework extensions beyond the reference's four options: ``maxcheck``
 overrides the index's MaxCheck search budget per request (the reference can
 only change MaxCheck index-wide via SetParameter; per-request budget is the
-knob its IndexSearcher sweeps offline, src/IndexSearcher/main.cpp:66-228).
+knob its IndexSearcher sweeps offline, src/IndexSearcher/main.cpp:66-228),
+and ``searchmode`` (``beam``/``dense``) picks the search engine per request
+— one served index can answer parity-mode and MXU-scan traffic
+concurrently (the reference has a single search path, so no analog).
 """
 
 from __future__ import annotations
@@ -78,6 +81,15 @@ class ParsedQuery:
         except ValueError:
             return None
         return v if v is not None and v > 0 else None
+
+    @property
+    def search_mode(self) -> Optional[str]:
+        """Per-request engine pick, "beam" or "dense" (framework
+        extension; see module docstring).  None = the index's SearchMode
+        parameter; unknown values also map to None so a typo degrades to
+        the configured default rather than failing the query."""
+        raw = (self.options.get("searchmode") or "").lower()
+        return raw if raw in ("beam", "dense") else None
 
     def extract_vector(self, value_type: VectorValueType,
                        separator: str = DEFAULT_SEPARATOR

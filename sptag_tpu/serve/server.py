@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Dict, Optional, Tuple
 
 from sptag_tpu.serve import wire
@@ -268,7 +269,15 @@ def main(argv=None) -> int:
     parser.add_argument("-c", "--config", required=True)
     parser.add_argument("-m", "--mode", choices=("socket", "interactive"),
                         default="interactive")
+    parser.add_argument("--platform", default=os.environ.get(
+        "SPTAG_TPU_PLATFORM"), help="pin the jax platform (e.g. cpu) — "
+        "environments that pre-register an accelerator plugin ignore "
+        "JAX_PLATFORMS, and a dead remote backend would hang every search")
     args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     context = ServiceContext.from_ini(args.config)
     if args.mode == "interactive":
         run_interactive(context)

@@ -148,7 +148,8 @@ class SearchExecutor:
                 res = index.search(vec.astype(
                     np.dtype(vec.dtype), copy=False), k=k,
                     with_metadata=parsed.extract_metadata,
-                    max_check=self._sanitize_max_check(parsed))
+                    max_check=self._sanitize_max_check(parsed),
+                    search_mode=parsed.search_mode)
             except Exception:
                 log.exception("search failed on index %s", name)
                 return RemoteSearchResult(ResultStatus.FailedExecute, [])
@@ -169,9 +170,11 @@ class SearchExecutor:
             sel = tuple(sorted(self._select_indexes(p)))
             key = (sel, p.result_num
                    or self.context.settings.default_max_result,
-                   p.extract_metadata, self._sanitize_max_check(p))
+                   p.extract_metadata, self._sanitize_max_check(p),
+                   p.search_mode)
             groups.setdefault(key, []).append(i)
-        for (sel, k, with_meta, max_check), idxs in groups.items():
+        for (sel, k, with_meta, max_check, search_mode), idxs in \
+                groups.items():
             if not sel:
                 for i in idxs:
                     results[i] = RemoteSearchResult(
@@ -195,7 +198,8 @@ class SearchExecutor:
                     continue
                 try:
                     dists, ids = index.search_batch(np.stack(vecs), k,
-                                                    max_check=max_check)
+                                                    max_check=max_check,
+                                                    search_mode=search_mode)
                 except Exception:
                     log.exception("batch search failed on index %s", name)
                     for i in ok:

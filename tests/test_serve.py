@@ -603,13 +603,15 @@ def test_maxcheck_option_parsed_and_plumbed():
         def __init__(self):
             self.seen = []
 
-        def search_batch(self, queries, k=10, max_check=None):
+        def search_batch(self, queries, k=10, max_check=None,
+                         search_mode=None):
             self.seen.append(("batch", k, max_check))
             n = len(queries)
             return (np.zeros((n, k), np.float32),
                     np.zeros((n, k), np.int32))
 
-        def search(self, query, k=10, with_metadata=False, max_check=None):
+        def search(self, query, k=10, with_metadata=False, max_check=None,
+                   search_mode=None):
             from sptag_tpu.core.index import SearchResult
             self.seen.append(("one", k, max_check))
             return SearchResult(np.zeros(k, np.int32),
@@ -656,6 +658,63 @@ def test_maxcheck_budget_changes_results_end_to_end():
     _, ids_big = index.search_batch(queries, 10, max_check=4096)
     assert recall(ids_big) >= recall(ids_small)
     assert recall(ids_big) >= 0.9
+
+
+def test_searchmode_option_parsed_and_end_to_end():
+    """The framework's $searchmode extension: one served index answers
+    parity-mode (beam) and MXU-scan (dense) traffic per request; unknown
+    values degrade to the index's configured SearchMode; a beam request
+    against a graph-less (BuildGraph=0) index fails that query only."""
+    assert parse_query("$searchmode:dense 1|2").search_mode == "dense"
+    assert parse_query("$searchmode:BEAM 1|2").search_mode == "beam"
+    assert parse_query("$searchmode:zigzag 1|2").search_mode is None
+    assert parse_query("1|2").search_mode is None
+
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((2000, 16)).astype(np.float32)
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "200"),
+                        ("NeighborhoodSize", "8"), ("CEF", "24"),
+                        ("MaxCheckForRefineGraph", "64"),
+                        ("RefineIterations", "1"), ("MaxCheck", "512"),
+                        ("SearchMode", "beam")]:
+        index.set_parameter(name, value)
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.indexes["main"] = index
+    ex = SearchExecutor(ctx)
+
+    line = "|".join(str(float(v)) for v in data[7])
+    r_beam = ex.execute(f"$searchmode:beam {line}")
+    r_dense = ex.execute(f"$searchmode:dense {line}")
+    assert r_beam.status == wire.ResultStatus.Success
+    assert r_dense.status == wire.ResultStatus.Success
+    assert r_beam.results[0].ids[0] == 7
+    assert r_dense.results[0].ids[0] == 7
+    # per-request override matches the equivalent direct call
+    _, direct = index.search_batch(data[7:8], 5, search_mode="dense")
+    assert list(direct[0]) == list(r_dense.results[0].ids)
+    # batch path: mixed modes group separately, both succeed
+    outs = ex.execute_batch([f"$searchmode:dense {line}",
+                             f"$searchmode:beam {line}", line])
+    assert all(o.status == wire.ResultStatus.Success for o in outs)
+    assert all(o.results[0].ids[0] == 7 for o in outs)
+
+    # dense-only index: beam per-request fails, dense-by-default succeeds
+    only = sp.create_instance("BKT", "Float")
+    only.set_parameter("DistCalcMethod", "L2")
+    for name, value in [("BuildGraph", "0"), ("BKTNumber", "1"),
+                        ("BKTKmeansK", "8"), ("MaxCheck", "512")]:
+        only.set_parameter(name, value)
+    only.build(data)
+    ctx2 = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx2.indexes["main"] = only
+    ex2 = SearchExecutor(ctx2)
+    assert ex2.execute(line).status == wire.ResultStatus.Success
+    assert ex2.execute(f"$searchmode:beam {line}").status == \
+        wire.ResultStatus.FailedExecute
 
 
 def test_maxcheck_sanitizer_respects_limit():

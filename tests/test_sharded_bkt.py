@@ -144,12 +144,26 @@ def test_serving_adapter_dense_mode(built):
     res = ad.search(data[3], k=3)
     assert res.ids[0] == 3
 
+    # per-request $searchmode override: a dense-configured adapter answers
+    # a beam request (and vice versa) without reconstruction
+    d_beam, ids_beam = ad.search_batch(queries[:8], 5, search_mode="beam")
+    assert ids_beam.shape == (8, 5)
+    d_direct, ids_direct = index.search(queries[:8], 5)
+    assert np.array_equal(ids_beam, np.asarray(ids_direct))
+
     beam_only = ShardedBKTIndex.build(data[:800], DistCalcMethod.L2,
                                       mesh=make_mesh(), params=PARAMS)
     with pytest.raises(RuntimeError):      # same type as search_dense
         ServingAdapter(beam_only, feature_dim=data.shape[1], mode="dense")
     with pytest.raises(ValueError):        # unknown mode string
         ServingAdapter(index, feature_dim=data.shape[1], mode="Dense")
+    # a beam-mode adapter over an un-packed index still raises on a
+    # per-request dense ask (search_dense's own error), surfaced as
+    # FailedExecute by the service layer
+    ad_beam = ServingAdapter(beam_only, feature_dim=data.shape[1],
+                             mode="beam")
+    with pytest.raises(RuntimeError):
+        ad_beam.search_batch(queries[:4], 5, search_mode="dense")
 
 
 def test_sharded_save_load_roundtrip(tmp_path):
