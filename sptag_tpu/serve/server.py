@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 from typing import Dict, Optional, Tuple
 
 from sptag_tpu.serve import wire
@@ -269,15 +268,13 @@ def main(argv=None) -> int:
     parser.add_argument("-c", "--config", required=True)
     parser.add_argument("-m", "--mode", choices=("socket", "interactive"),
                         default="interactive")
-    parser.add_argument("--platform", default=os.environ.get(
-        "SPTAG_TPU_PLATFORM"), help="pin the jax platform (e.g. cpu) — "
-        "environments that pre-register an accelerator plugin ignore "
-        "JAX_PLATFORMS, and a dead remote backend would hang every search")
+    parser.add_argument("--platform", default=None,
+                        help="pin the jax platform (e.g. cpu); default "
+                        "honors SPTAG_TPU_PLATFORM (utils.pin_platform)")
     args = parser.parse_args(argv)
-    if args.platform:
-        import jax
+    from sptag_tpu.utils import pin_platform
 
-        jax.config.update("jax_platforms", args.platform)
+    pin_platform(args.platform)
     context = ServiceContext.from_ini(args.config)
     if args.mode == "interactive":
         run_interactive(context)

@@ -23,6 +23,7 @@ from typing import List
 from sptag_tpu.core.index import create_instance
 from sptag_tpu.core.types import ErrorCode, enum_from_string, VectorValueType
 from sptag_tpu.io.reader import ReaderOptions, load_vectors
+from sptag_tpu.utils import pin_platform
 
 log = logging.getLogger(__name__)
 
@@ -57,7 +58,11 @@ def main(argv=None) -> int:
                         help="BKT | KDT | FLAT")
     parser.add_argument("-t", "--thread", type=int, default=32)
     parser.add_argument("--delimiter", default="|")
+    parser.add_argument("--platform", default=None,
+                        help="pin the jax platform (e.g. cpu); default "
+                        "honors SPTAG_TPU_PLATFORM")
     args = parser.parse_args(argv)
+    pin_platform(args.platform)
 
     value_type = enum_from_string(VectorValueType, args.vectortype)
     options = ReaderOptions(value_type=value_type,

@@ -31,6 +31,7 @@ import numpy as np
 from sptag_tpu.core.index import load_index
 from sptag_tpu.io.reader import ReaderOptions, load_vectors
 from sptag_tpu.tools.index_builder import split_passthrough
+from sptag_tpu.utils import pin_platform
 
 log = logging.getLogger(__name__)
 
@@ -72,7 +73,11 @@ def main(argv=None) -> int:
     parser.add_argument("-b", "--batch", type=int, default=256)
     parser.add_argument("-o", "--output", default=None)
     parser.add_argument("--delimiter", default="|")
+    parser.add_argument("--platform", default=None,
+                        help="pin the jax platform (e.g. cpu); default "
+                        "honors SPTAG_TPU_PLATFORM")
     args = parser.parse_args(argv)
+    pin_platform(args.platform)
 
     index = load_index(args.index)
     for name, value in params:

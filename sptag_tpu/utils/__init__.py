@@ -20,6 +20,23 @@ def query_bucket(q: int, cap: int) -> int:
 _cache_enabled = False
 
 
+def pin_platform(platform=None) -> None:
+    """Pin jax's platform list before any backend initializes.
+
+    Environments that pre-register an accelerator plugin (sitecustomize)
+    ignore the JAX_PLATFORMS env var, and a dead REMOTE backend then hangs
+    the first array operation forever — CLIs call this with their
+    --platform flag (default: the SPTAG_TPU_PLATFORM env var) so e.g.
+    `--platform cpu` always works.  No-op when nothing is requested."""
+    import os
+
+    p = platform or os.environ.get("SPTAG_TPU_PLATFORM")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
 def enable_compile_cache() -> None:
     """Point jax at a persistent compilation cache (idempotent).
 
