@@ -17,6 +17,12 @@ Aggregator/AggregatorService.cpp, inc/Aggregator/AggregatorContext.h:29-61):
   downgrades the overall status to the per-request partial statuses
   (Timeout / FailedNetwork, :242-262).
 
+Framework extension: ``[Service] MergeTopK=true`` re-ranks the gathered
+lists into ONE globally sorted top-K list per index name (`merge_top_k`)
+— the merge the reference leaves to every client (and what the mesh path
+does on-device with `lax.top_k` over the all-gather).  Off by default for
+reference parity.
+
 (The intra-pod TPU equivalent of this whole file is
 sptag_tpu/parallel/sharded.py — one pjit program over ICI.  This module is
 the DCN/external edge for reference-topology deployments.)
@@ -35,6 +41,53 @@ from sptag_tpu.utils.ini import IniReader
 log = logging.getLogger(__name__)
 
 RECONNECT_INTERVAL_S = 30.0
+
+
+def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
+                ) -> List[wire.IndexSearchResult]:
+    """Re-rank flat-gathered per-server lists into one globally sorted
+    top-K list per index name (framework extension; the reference returns
+    the lists unmerged, AggregatorService.cpp:316-366).
+
+    `per_server` is one result list per replying backend.  K per index =
+    the most REAL (non-sentinel) entries any single backend returned for
+    that name.  Vector ids are shard-LOCAL, so two servers' equal ids may
+    be different vectors: duplicate detection keys on the metadata
+    payload when one is present (metadata is the cross-shard identity the
+    reference's client-side merge lives with too) and otherwise on
+    (server, id) — replicated no-metadata deployments may therefore
+    return the same vector once per replica.  Ties break on distance
+    then id for determinism."""
+    groups: dict = {}
+    for srv_i, results in enumerate(per_server):
+        for r in results:
+            groups.setdefault(r.index_name, []).append((srv_i, r))
+    out: List[wire.IndexSearchResult] = []
+    for name, rs in groups.items():
+        k = max(sum(1 for v in r.ids if v >= 0) for _, r in rs)
+        has_meta = any(r.metas is not None for _, r in rs)
+        entries = []
+        for srv_i, r in rs:
+            metas = (r.metas if r.metas is not None
+                     else [b""] * len(r.ids))
+            for vid, dist, meta in zip(r.ids, r.dists, metas):
+                if vid >= 0:
+                    key = meta if (has_meta and meta) else (srv_i, int(vid))
+                    entries.append((float(dist), int(vid), meta, key))
+        entries.sort(key=lambda e: (e[0], e[1]))
+        seen = set()
+        best = []
+        for dist, vid, meta, key in entries:
+            if key in seen:
+                continue
+            seen.add(key)
+            best.append((dist, vid, meta))
+            if len(best) == k:
+                break
+        out.append(wire.IndexSearchResult(
+            name, [v for _, v, _ in best], [d for d, _, _ in best],
+            [m for _, _, m in best] if has_meta else None))
+    return out
 
 
 @dataclasses.dataclass
@@ -81,10 +134,12 @@ class RemoteServer:
 class AggregatorContext:
     def __init__(self, listen_addr: str = "0.0.0.0",
                  listen_port: int = 8100,
-                 search_timeout_s: float = 9.0):
+                 search_timeout_s: float = 9.0,
+                 merge_top_k: bool = False):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
+        self.merge_top_k = merge_top_k
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -97,6 +152,9 @@ class AggregatorContext:
                                                  "8100")),
             search_timeout_s=float(reader.get_parameter(
                 "Service", "SearchTimeout", "9")),
+            merge_top_k=reader.get_parameter(
+                "Service", "MergeTopK", "false").lower() in
+            ("true", "1", "yes"),
         )
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
@@ -239,6 +297,8 @@ class AggregatorService:
             if status != wire.ResultStatus.Success:
                 merged.status = status
             merged.results.extend(results)
+        if self.context.merge_top_k:
+            merged.results = merge_top_k([r for _, r in replies])
         return merged
 
     async def _query_one(self, idx: int, server: RemoteServer, body: bytes):

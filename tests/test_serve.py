@@ -851,6 +851,90 @@ def test_parse_query_fuzz_never_raises():
             p.extract_vector(vt)    # None or an array; never a raise
 
 
+def test_merge_top_k_unit():
+    """Global re-rank extension: groups by index name, drops -1 sentinels,
+    dedups by METADATA identity (replicated vectors merge; same local id
+    on different shards does NOT conflate), K = most real entries any one
+    backend returned, metadata stays aligned."""
+    from sptag_tpu.serve.aggregator import merge_top_k
+
+    # server 0 and server 1 replicate vector m3 (same metadata, same
+    # vector): dedup keeps the best distance.  K = 3 (server 1's count).
+    s0 = [wire.IndexSearchResult("x", [3, 9, -1], [0.5, 2.0, 3.4e38],
+                                 [b"m3", b"m9", b""]),
+          wire.IndexSearchResult("y", [0, -1], [1.0, 3.4e38],
+                                 [b"ga", b""])]
+    s1 = [wire.IndexSearchResult("x", [7, 3, 1], [0.25, 0.9, 4.0],
+                                 [b"m7", b"m3", b"m1"]),
+          # same LOCAL id 0 as server 0's y-row, different vector (gb):
+          # both must survive the merge
+          wire.IndexSearchResult("y", [0, 1], [0.5, 5.0],
+                                 [b"gb", b"gy1"])]
+    out = merge_top_k([s0, s1])
+    assert [r.index_name for r in out] == ["x", "y"]
+    x = out[0]
+    assert x.dists == [0.25, 0.5, 2.0]   # m3 deduped to its best distance
+    assert x.metas == [b"m7", b"m3", b"m9"]
+    y = out[1]
+    assert y.metas == [b"gb", b"ga"]     # local-id collision NOT conflated
+    assert y.ids == [0, 0]
+
+    # without metadata there is no cross-server identity: (server, id)
+    # keying keeps replicated entries separate rather than guessing
+    n0 = [wire.IndexSearchResult("z", [4], [1.0], None)]
+    n1 = [wire.IndexSearchResult("z", [4], [1.0], None)]
+    z = merge_top_k([n0, n1])[0]
+    assert z.ids == [4] and z.metas is None  # k=1 caps the duplicate
+
+
+def test_aggregator_merge_top_k_end_to_end():
+    """MergeTopK=true: two servers shard one corpus under the SAME index
+    name; the aggregator returns ONE globally sorted list whose metadata
+    (global-row identity) matches exact brute force."""
+    rng = np.random.default_rng(3)
+    n, d = 400, 8
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    half = n // 2
+    ctxs = []
+    for lo, hi in ((0, half), (half, n)):
+        index = sp.create_instance("FLAT", "Float")
+        index.set_parameter("DistCalcMethod", "L2")
+        index.build(data[lo:hi], sp.MetadataSet(
+            f"g{i}".encode() for i in range(lo, hi)), with_meta_index=True)
+        ctx = ServiceContext(ServiceSettings(default_max_result=5))
+        ctx.add_index("main", index)
+        ctxs.append(ctx)
+    servers = [SearchServer(c, batch_window_ms=1.0) for c in ctxs]
+    threads = [_ServerThread(s) for s in servers]
+    for t in threads:
+        t.start()
+    addrs = [t.wait_ready() for t in threads]
+
+    agg_ctx = AggregatorContext(search_timeout_s=10.0, merge_top_k=True)
+    agg_ctx.servers = [RemoteServer(h, p) for h, p in addrs]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+    try:
+        client = AnnClient(hg, pg, timeout_s=10.0)
+        client.connect()
+        q = data[123]
+        truth = np.argsort(((data - q) ** 2).sum(1))[:5]
+        res = client.search("$extractmetadata:true $resultnum:5 "
+                            + "|".join(str(float(v)) for v in q))
+        assert res.status == wire.ResultStatus.Success
+        assert len(res.results) == 1          # ONE list, not one per server
+        got = [m.decode() for m in res.results[0].metas]
+        assert got == [f"g{i}" for i in truth]
+        assert res.results[0].dists == sorted(res.results[0].dists)
+        client.close()
+    finally:
+        tg.stop()
+        for t in threads:
+            t.stop()
+
+
 def test_aggregator_survives_garbage_backend_body():
     """A backend that answers a SearchResponse with a garbage body must
     yield FailedNetwork for that request — not kill the aggregator's
