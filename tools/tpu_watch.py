@@ -152,6 +152,10 @@ def pipeline(stages, done) -> None:
         plan.append(("sweep_refine2048",
                      [py, "tools/sweep_modes.py", "200000"], 5400,
                      {"SWEEP_REFINE_BUDGET": "2048"}))
+    if "6" in stages:
+        # verdict item 4 follow-up: where does recall pay for width?
+        plan.append(("beam_width", [py, "tools/beam_width_tune.py",
+                                    "200000"], 3600, None))
     if "4" in stages:
         plan.append(("dense_tune", [py, "tools/dense_tune.py", "200000"],
                      3600, None))
@@ -180,7 +184,7 @@ def main() -> None:
     stages = args.stages.split(",")
     done = set()
     want = {"1": "bench", "2": "baseline_configs", "4": "dense_tune",
-            "5": "scale_rows"}
+            "5": "scale_rows", "6": "beam_width"}
     total = len([s for s in stages if s in want]) + \
         (2 if "3" in stages else 0)
     while True:
