@@ -100,6 +100,11 @@ def write_string(s) -> bytes:
 def read_string(buf: bytes, off: int) -> Tuple[bytes, int]:
     (n,) = _U32.unpack_from(buf, off)
     off += 4
+    if off + n > len(buf):
+        # bytes slicing is lenient past end-of-buffer; a length prefix
+        # pointing beyond the body is a truncated/hostile packet and must
+        # fail decode, not silently deliver a shortened payload
+        raise struct.error("string length %d exceeds buffer" % n)
     return bytes(buf[off:off + n]), off + n
 
 
@@ -119,10 +124,13 @@ class RemoteQuery:
 
     @classmethod
     def unpack(cls, buf: bytes) -> Optional["RemoteQuery"]:
-        major, _, qtype = _U16X2_U8.unpack_from(buf, 0)
-        if major != cls.MAJOR:
-            return None
-        q, _ = read_string(buf, _U16X2_U8.size)
+        try:
+            major, _, qtype = _U16X2_U8.unpack_from(buf, 0)
+            if major != cls.MAJOR:
+                return None
+            q, _ = read_string(buf, _U16X2_U8.size)
+        except struct.error:
+            return None       # truncated body — hostile peers send anything
         return cls(q.decode("utf-8", "replace"), qtype)
 
 
@@ -165,32 +173,35 @@ class RemoteSearchResult:
 
     @classmethod
     def unpack(cls, buf: bytes) -> Optional["RemoteSearchResult"]:
-        major, _, status = _U16X2_U8.unpack_from(buf, 0)
-        if major != cls.MAJOR:
-            return None
-        off = _U16X2_U8.size
-        (count,) = _U32.unpack_from(buf, off)
-        off += 4
-        results: List[IndexSearchResult] = []
-        for _ in range(count):
-            name, off = read_string(buf, off)
-            (num,) = _U32.unpack_from(buf, off)
+        try:
+            major, _, status = _U16X2_U8.unpack_from(buf, 0)
+            if major != cls.MAJOR:
+                return None
+            off = _U16X2_U8.size
+            (count,) = _U32.unpack_from(buf, off)
             off += 4
-            (with_meta,) = struct.unpack_from("<?", buf, off)
-            off += 1
-            ids: List[int] = []
-            dists: List[float] = []
-            for _ in range(num):
-                vid, dist = _VID_DIST.unpack_from(buf, off)
-                off += _VID_DIST.size
-                ids.append(vid)
-                dists.append(dist)
-            metas = None
-            if with_meta:
-                metas = []
+            results: List[IndexSearchResult] = []
+            for _ in range(count):
+                name, off = read_string(buf, off)
+                (num,) = _U32.unpack_from(buf, off)
+                off += 4
+                (with_meta,) = struct.unpack_from("<?", buf, off)
+                off += 1
+                ids: List[int] = []
+                dists: List[float] = []
                 for _ in range(num):
-                    m, off = read_string(buf, off)
-                    metas.append(m)
-            results.append(IndexSearchResult(name.decode(), ids, dists,
-                                             metas))
+                    vid, dist = _VID_DIST.unpack_from(buf, off)
+                    off += _VID_DIST.size
+                    ids.append(vid)
+                    dists.append(dist)
+                metas = None
+                if with_meta:
+                    metas = []
+                    for _ in range(num):
+                        m, off = read_string(buf, off)
+                        metas.append(m)
+                results.append(IndexSearchResult(
+                    name.decode("utf-8", "replace"), ids, dists, metas))
+        except struct.error:
+            return None       # truncated body — hostile peers send anything
         return cls(status, results)
