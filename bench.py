@@ -49,12 +49,31 @@ def probe_accelerator(budget_s=float("inf")):
     before any CPU fallback — the tunnel has been observed to come back
     between attempts).  Returns (platform|None, err, attempts_used) — PJRT
     init on the tunneled backend can hang indefinitely, and a child
-    process is the only safe place to find out."""
-    code = ("import jax, json; ds = jax.devices(); "
-            "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))")
+    process is the only safe place to find out.
+
+    The probe also compiles ONE fresh shape: the tunnel's remote-compile
+    service fails independently of device init (observed 2026-07-30/31 —
+    `jax.devices()` fine, every new-shape compile hung), and a
+    devices-only probe would pass and then strand the build until the
+    watchdog deadline, burning the TPU child's whole budget before the
+    CPU retry.  The child runs with the persistent compilation cache
+    stripped from its environment, so the compile is guaranteed live (a
+    cached executable would mask a dead compile service); one fused jit
+    call keeps the added cost to a single kernel compile inside
+    PROBE_TIMEOUT_S."""
+    import random
+
+    dim = 241 + random.randrange(0, 4000, 2)
+    code = ("import jax, jax.numpy as jnp, json; ds = jax.devices(); "
+            "f = jax.jit(lambda x: jnp.tanh(x * 0.731).sum()); "
+            "v = float(f(jnp.ones((3, %d), jnp.float32))); "
+            "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))"
+            % dim)
+    child_env = {k: v for k, v in os.environ.items()
+                 if k != "JAX_COMPILATION_CACHE_DIR"}
     last_err = ""
     for attempt in range(1, PROBE_RETRIES + 1):
-        if budget_s - (time.time() - _t_start) < PROBE_TIMEOUT_S + 120:
+        if _remaining(budget_s) < PROBE_TIMEOUT_S + 120:
             # keep enough budget for a measured CPU fallback rather than
             # burning it all on a down tunnel
             last_err += " | probe budget exhausted"
@@ -62,7 +81,7 @@ def probe_accelerator(budget_s=float("inf")):
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True, text=True,
-                timeout=PROBE_TIMEOUT_S)
+                timeout=PROBE_TIMEOUT_S, env=child_env)
             if out.returncode == 0 and out.stdout.strip():
                 info = json.loads(out.stdout.strip().splitlines()[-1])
                 return info["platform"], "", attempt

@@ -48,27 +48,27 @@ def log(msg: str) -> None:
 
 
 def probe(timeout_s: float = 180.0) -> bool:
-    """Healthy = devices init AND a fresh-shape compile both finish.
+    """Healthy = devices init AND a LIVE fresh-shape compile both finish.
 
-    The compile probe uses a random prime-ish dim so its executable can
-    never be served from the persistent cache (a cache hit would mask a
-    dead compile service)."""
+    The child runs without any persistent compilation cache (none is
+    enabled in-process and the env var is stripped), so the compile
+    cannot be served from cache — a cache hit would mask a dead compile
+    service.  One fused jit call keeps it to a single kernel compile."""
     dim = random.choice([241, 251, 257, 263, 269, 271, 277, 281]) + \
         random.randrange(0, 2000, 2)
     code = (
-        "import jax, jax.numpy as jnp, json, sys;"
-        "sys.path.insert(0, %r);"
-        "from sptag_tpu.utils import enable_compile_cache;"
-        "enable_compile_cache();"
+        "import jax, jax.numpy as jnp, json;"
         "d = jax.devices();"
-        "x = jnp.ones((3, %d), jnp.float32);"
-        "v = float(jnp.tanh(x * 0.731).sum());"
+        "f = jax.jit(lambda x: jnp.tanh(x * 0.731).sum());"
+        "v = float(f(jnp.ones((3, %d), jnp.float32)));"
         "print(json.dumps({'platform': d[0].platform, 'v': v}))"
-        % (REPO, dim))
+        % dim)
+    env = {k: v for k, v in os.environ.items()
+           if k != "JAX_COMPILATION_CACHE_DIR"}
     try:
         out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True,
-                             timeout=timeout_s)
+                             timeout=timeout_s, env=env)
         if out.returncode == 0 and '"platform"' in out.stdout:
             info = json.loads(out.stdout.strip().splitlines()[-1])
             log(f"probe OK: platform={info['platform']} (fresh d={dim})")
