@@ -43,6 +43,26 @@ def _remaining(budget_s):
     return budget_s - (time.time() - _t_start)
 
 
+def probe_snippet():
+    """(child code, child env) for a live-backend probe — shared with
+    tools/tpu_watch.py so the two probes cannot diverge.  The snippet
+    initializes devices AND compiles one fused fresh-shape kernel; the
+    env strips the persistent compilation cache so the compile is
+    guaranteed live (a cached executable would mask a dead
+    remote-compile service)."""
+    import random
+
+    dim = 241 + random.randrange(0, 4000, 2)
+    code = ("import jax, jax.numpy as jnp, json; ds = jax.devices(); "
+            "f = jax.jit(lambda x: jnp.tanh(x * 0.731).sum()); "
+            "v = float(f(jnp.ones((3, %d), jnp.float32))); "
+            "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))"
+            % dim)
+    child_env = {k: v for k, v in os.environ.items()
+                 if k != "JAX_COMPILATION_CACHE_DIR"}
+    return code, child_env
+
+
 def probe_accelerator(budget_s=float("inf")):
     """Initialize the default (TPU) backend in a subprocess with a hard
     timeout; retry with backoff (round-3 hardening: 3 x 180 s attempts
@@ -61,16 +81,7 @@ def probe_accelerator(budget_s=float("inf")):
     cached executable would mask a dead compile service); one fused jit
     call keeps the added cost to a single kernel compile inside
     PROBE_TIMEOUT_S."""
-    import random
-
-    dim = 241 + random.randrange(0, 4000, 2)
-    code = ("import jax, jax.numpy as jnp, json; ds = jax.devices(); "
-            "f = jax.jit(lambda x: jnp.tanh(x * 0.731).sum()); "
-            "v = float(f(jnp.ones((3, %d), jnp.float32))); "
-            "print(json.dumps({'platform': ds[0].platform, 'n': len(ds)}))"
-            % dim)
-    child_env = {k: v for k, v in os.environ.items()
-                 if k != "JAX_COMPILATION_CACHE_DIR"}
+    code, child_env = probe_snippet()
     last_err = ""
     for attempt in range(1, PROBE_RETRIES + 1):
         if _remaining(budget_s) < PROBE_TIMEOUT_S + 120:

@@ -29,7 +29,6 @@ Usage: python tools/tpu_watch.py [--interval 540] [--once] [--stages 1,2,3]
 import argparse
 import json
 import os
-import random
 import subprocess
 import sys
 import time
@@ -48,30 +47,19 @@ def log(msg: str) -> None:
 
 
 def probe(timeout_s: float = 180.0) -> bool:
-    """Healthy = devices init AND a LIVE fresh-shape compile both finish.
+    """Healthy = devices init AND a LIVE fresh-shape compile both finish
+    (snippet shared with bench.probe_accelerator — one probe semantic)."""
+    sys.path.insert(0, REPO)
+    from bench import probe_snippet
 
-    The child runs without any persistent compilation cache (none is
-    enabled in-process and the env var is stripped), so the compile
-    cannot be served from cache — a cache hit would mask a dead compile
-    service.  One fused jit call keeps it to a single kernel compile."""
-    dim = random.choice([241, 251, 257, 263, 269, 271, 277, 281]) + \
-        random.randrange(0, 2000, 2)
-    code = (
-        "import jax, jax.numpy as jnp, json;"
-        "d = jax.devices();"
-        "f = jax.jit(lambda x: jnp.tanh(x * 0.731).sum());"
-        "v = float(f(jnp.ones((3, %d), jnp.float32)));"
-        "print(json.dumps({'platform': d[0].platform, 'v': v}))"
-        % dim)
-    env = {k: v for k, v in os.environ.items()
-           if k != "JAX_COMPILATION_CACHE_DIR"}
+    code, env = probe_snippet()
     try:
         out = subprocess.run([sys.executable, "-c", code],
                              capture_output=True, text=True,
                              timeout=timeout_s, env=env)
         if out.returncode == 0 and '"platform"' in out.stdout:
             info = json.loads(out.stdout.strip().splitlines()[-1])
-            log(f"probe OK: platform={info['platform']} (fresh d={dim})")
+            log(f"probe OK: platform={info['platform']}")
             return info["platform"] != "cpu"
         log(f"probe rc={out.returncode}: {out.stderr.strip()[-200:]}")
     except subprocess.TimeoutExpired:
