@@ -31,7 +31,7 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".bench_cache")
-CACHE_VERSION = 3          # bump when index params/format change
+CACHE_VERSION = 4          # bump when index params/format/build semantics change
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
 DEFAULT_BUDGET_S = 3000.0

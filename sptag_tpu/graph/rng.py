@@ -94,12 +94,22 @@ class RelativeNeighborhoodGraph:
         candidate stage checkpoints per TPT tree inside build_candidates).
         """
         m = self.neighborhood_size
-        passes = max(self.refine_iterations, 1)
-        # pass-level resume only applies with a search factory: without
-        # one, every pass re-prunes the SAME candidate lists (narrowing
-        # width), so the candidate checkpoint already covers the restart
+        # RefineIterations counts SEARCH passes, like the reference's
+        # m_iRefineIter (RefineGraph runs iter-1 wide passes + 1 final,
+        # NeighborhoodGraph.h:113-130; its first pass walks the raw TPT
+        # candidate rows).  Here the candidate lists are RNG-pruned once
+        # at wide width to make them walkable, then every refine pass
+        # re-searches — non-final passes at CEF*CEFScale budget and wide
+        # width, the final pass at CEF and the target width.  Round-3
+        # direction-B A/B traced our graph-quality gap (0.959 vs their
+        # 0.995 on equal knobs) to running one search pass FEWER than the
+        # reference at equal RefineIterations plus the unused CEFScale.
+        passes = self.refine_iterations if search_fn_factory is not None \
+            else 0
+        width_wide = min(max(m * self.neighborhood_scale, 1),
+                         max(data.shape[0] - 1, 1))
         start = 0
-        if checkpoint is not None and search_fn_factory is not None:
+        if checkpoint is not None and passes > 0:
             for it in reversed(range(passes - 1)):     # last pass not saved
                 saved = checkpoint.get_arrays(f"graph_pass{it}")
                 if saved is not None:
@@ -108,32 +118,29 @@ class RelativeNeighborhoodGraph:
                     log.info("build resume: refine pass %d/%d from "
                              "checkpoint", it + 1, passes)
                     break
-        cand_ids = cand_d = None
         if start == 0:
             with trace.span("build.tpt_candidates"):
                 cand_ids, cand_d = self.build_candidates(
                     data, metric, base, seed, checkpoint=checkpoint)
-        # candidate-list width; mirrors build_candidates' C when the
-        # candidate stage was skipped by a pass-level resume
-        C = (cand_ids.shape[1] if cand_ids is not None else
-             min(max(m * self.neighborhood_scale, 1),
-                 max(data.shape[0] - 1, 1)))
+            with trace.span("build.rng_prune"):
+                # prune-only width: wide when refine passes will narrow
+                # it, final width when none will (RefineIterations=0 is
+                # the candidates-only escape hatch)
+                self.graph = self.prune_candidates(
+                    data, cand_ids, cand_d,
+                    width_wide if passes > 0 else m, metric, base)
+            log.info("RNG initial prune width=%d",
+                     width_wide if passes > 0 else m)
         for it in range(start, passes):
             last = it == passes - 1
-            width = m if last else min(C, m * self.neighborhood_scale)
-            if it == 0 or search_fn_factory is None:
-                # first pass (or no-factory mode) prunes the TPT
-                # candidates directly
-                with trace.span("build.rng_prune"):
-                    self.graph = self.prune_candidates(
-                        data, cand_ids, cand_d, width, metric, base)
-            else:
-                with trace.span("build.refine_pass"):
-                    self.refine_once(data, search_fn_factory(self.graph),
-                                     width, metric, base)
+            width = m if last else width_wide
+            with trace.span("build.refine_pass"):
+                self.refine_once(data, search_fn_factory(self.graph),
+                                 width, metric, base,
+                                 cef=(self.cef if last
+                                      else self.cef * self.cef_scale))
             log.info("RNG refine pass %d/%d width=%d", it + 1, passes, width)
-            if (checkpoint is not None and search_fn_factory is not None
-                    and not last):
+            if checkpoint is not None and not last:
                 # the final pass is not checkpointed: the full build's own
                 # save (or the bench cache) captures the finished graph
                 checkpoint.put_arrays(f"graph_pass{it}", graph=self.graph)
@@ -315,15 +322,19 @@ class RelativeNeighborhoodGraph:
         return out
 
     def refine_once(self, data: np.ndarray, search_fn: SearchFn, width: int,
-                    metric: int, base: int) -> None:
+                    metric: int, base: int,
+                    cef: Optional[int] = None) -> None:
         """One refine pass: re-search every node, RNG-prune the results.
 
         Parity: RefineGraph (NeighborhoodGraph.h:113-143) — each node's new
-        row comes from a fresh CEF-budget search, self excluded.  Batched and
+        row comes from a fresh `cef`-budget search (default self.cef; the
+        build's non-final passes pass cef*cef_scale, matching the
+        reference's wide iterations), self excluded.  Batched and
         double-buffered: all searches in the pass read the pass-start graph.
         """
         n = data.shape[0]
-        k = min(self.cef + 1, n)
+        cef = self.cef if cef is None else cef
+        k = min(cef + 1, n)
         new_graph = np.full((n, width), -1, np.int32)
         for off in range(0, n, _PRUNE_CHUNK):
             stop = min(off + _PRUNE_CHUNK, n)
@@ -344,7 +355,7 @@ class RelativeNeighborhoodGraph:
             d = np.take_along_axis(d, order, axis=1)
             ids = np.take_along_axis(ids, order, axis=1)
             ids = np.where(d >= MAX_DIST, -1, ids)
-            C = min(ids.shape[1], self.cef)
+            C = min(ids.shape[1], cef)
             ids = ids[:, :C]
             d = d[:, :C]
             vecs = data[np.maximum(ids, 0)].astype(np.float32)

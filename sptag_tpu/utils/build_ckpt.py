@@ -32,10 +32,20 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
+# stage-format version, folded into every fingerprint: a checkpoint
+# written under DIFFERENT build semantics must never resume (bumped with
+# the refine-pass restructure — an old graph_pass0 held the initial-prune
+# output, which the current code would misread as a completed search pass
+# and silently skip one)
+STAGE_VERSION = 2
+
+
 def build_fingerprint(data: np.ndarray, config_repr: str) -> str:
     """Cheap, stable identity of a build: shape + dtype + a 64-row strided
-    sample of the corpus bytes + the full param/config repr."""
+    sample of the corpus bytes + the full param/config repr + the
+    checkpoint STAGE_VERSION."""
     h = hashlib.sha1()
+    h.update(b"stage_v%d;" % STAGE_VERSION)
     h.update(repr(data.shape).encode())
     h.update(str(data.dtype).encode())
     if data.shape[0]:

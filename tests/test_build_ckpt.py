@@ -77,24 +77,27 @@ def test_interrupted_build_resumes_completed_stages(tmp_path, monkeypatch):
     monkeypatch.setattr(RelativeNeighborhoodGraph, "refine_once",
                         real_refine)
 
-    # stage files survived the crash: tree + candidates + pass0 graph
+    # stage files survived the crash: tree + candidates (the cheap
+    # initial prune is recomputed on resume; refine passes checkpoint
+    # after they complete — the crash was in the first one)
     sub = [p for p in (tmp_path / "ck").iterdir() if p.is_dir()]
     assert len(sub) == 1
     names = {p.name for p in sub[0].iterdir()}
     assert "tree.bin" in names
     assert "candidates.npz" in names
-    assert "graph_pass0.npz" in names
 
-    # the resumed build must not re-run tree or candidate stages
+    # the resumed build must not re-run the tree stage nor any TPT tree's
+    # all-pairs work (build_candidates itself runs again but serves every
+    # tree from the checkpoint)
     def no_tree_build(self, *a, **kw):
         raise AssertionError("tree stage re-ran on resume")
 
-    def no_candidates(self, *a, **kw):
-        raise AssertionError("candidate stage re-ran on resume")
+    def no_tree_candidates(self, *a, **kw):
+        raise AssertionError("TPT all-pairs re-ran on resume")
 
     monkeypatch.setattr(BKTree, "build", no_tree_build)
-    monkeypatch.setattr(RelativeNeighborhoodGraph, "build_candidates",
-                        no_candidates)
+    monkeypatch.setattr(RelativeNeighborhoodGraph, "_tree_candidates",
+                        no_tree_candidates)
     resumed = _mk_index()
     assert resumed.build(data, checkpoint_dir=ck_dir) == sp.ErrorCode.Success
     assert resumed.build_resumed

@@ -184,7 +184,14 @@ def test_kdt_maxcheck_sweep_monotone_50k():
     for name, value in [("KDTNumber", "2"), ("TPTNumber", "4"),
                         ("TPTLeafSize", "500"), ("NeighborhoodSize", "16"),
                         ("CEF", "64"), ("MaxCheckForRefineGraph", "256"),
-                        ("RefineIterations", "1"), ("MaxCheck", "512")]:
+                        # RefineIterations now counts SEARCH passes
+                        # (reference RefineGraph parity, graph/rng.py); 0 =
+                        # the pure TPT-candidate graph, which isolates this
+                        # guard from refine-search quality (a 256-budget
+                        # refine pass on UNIFORM d=100 data replaces
+                        # all-pairs rows with worse search results — true
+                        # of the reference at that budget too)
+                        ("RefineIterations", "0"), ("MaxCheck", "512")]:
         index.set_parameter(name, value)
     index.build(data)
     dn = (data ** 2).sum(1)
