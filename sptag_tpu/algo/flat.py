@@ -74,6 +74,58 @@ def _flat_search_kernel(data, sqnorm, invalid, queries, k: int,
     return dists, ids
 
 
+def _pack_sign_bits(centered: jax.Array) -> jax.Array:
+    """(R, D) centered values -> (R, W) int32 packed sign bits, W =
+    ceil(D/32).  Bit i of word w = sign(x[32w + i]) > 0; D is zero-padded
+    so query and corpus pads contribute identical bits (XOR = 0)."""
+    r, d = centered.shape
+    w = (d + 31) // 32
+    pad = w * 32 - d
+    bits = (centered > 0)
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros((r, pad), bool)], axis=1)
+    bits = bits.reshape(r, w, 32).astype(jnp.int32)
+    powers = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    return (bits * powers[None, None, :]).sum(axis=2).astype(jnp.int32)
+
+
+_PACK_JIT = jax.jit(_pack_sign_bits)    # one wrapper -> shape-keyed cache
+
+
+@functools.partial(jax.jit, static_argnames=("k", "R", "metric", "base"))
+def _flat_sketch_kernel(data, sqnorm, invalid, sketches, mean, queries,
+                        k: int, R: int, metric: int, base: int):
+    """Sketch-shortlist exact search: XOR+popcount Hamming scan over the
+    packed sign sketches (1/32 of the corpus scan bytes), `lax.top_k`
+    shortlist of R rows, exact distances on the gathered rows only, final
+    top-k.  The Hamming accumulation unrolls over the W words so the
+    (Q, N) running sum is the only large intermediate — never (Q, N, W).
+    """
+    Q = queries.shape[0]
+    qbits = _pack_sign_bits(queries.astype(jnp.float32) - mean[None, :])
+    W = sketches.shape[1]
+    ham = jnp.zeros((Q, sketches.shape[0]), jnp.int32)
+    for w in range(W):
+        ham = ham + jax.lax.population_count(
+            jnp.bitwise_xor(qbits[:, w:w + 1], sketches[None, :, w]))
+    ham = jnp.where(invalid[None, :], jnp.int32(1 << 30), ham)
+    _, short = jax.lax.top_k(-ham, R)                       # (Q, R)
+    rows = data[short]                                      # (Q, R, D)
+    if metric == int(DistCalcMethod.L2):
+        d = dist_ops.batched_gathered_distance(
+            queries, rows, DistCalcMethod.L2, base, sqnorm[short])
+    else:
+        d = dist_ops.batched_gathered_distance(
+            queries, rows, DistCalcMethod.Cosine, base, sqnorm[short])
+    d = jnp.where(invalid[short], jnp.float32(MAX_DIST), d)
+    neg, pos = jax.lax.top_k(-d, k)
+    dists = -neg
+    ids = jnp.take_along_axis(short, pos, axis=1)
+    ids = jnp.where(dists >= jnp.float32(MAX_DIST), -1, ids)
+    return dists, ids.astype(jnp.int32)
+
+
 @register_algo
 class FlatIndex(VectorIndex):
     algo = IndexAlgoType.FLAT
@@ -86,6 +138,7 @@ class FlatIndex(VectorIndex):
         self._num_deleted = 0
         self._dirty = True
         self._device: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+        self._sketch: Optional[Tuple[jax.Array, jax.Array]] = None
 
     def _make_params(self) -> FlatParams:
         return FlatParams()
@@ -168,8 +221,29 @@ class FlatIndex(VectorIndex):
             data_d = jnp.asarray(data)
             sqnorm_d = dist_ops.row_sqnorms(data_d)
             self._device = (data_d, sqnorm_d, jnp.asarray(invalid))
+            self._sketch = None          # derived; rebuilt on demand
             self._dirty = False
             return self._device
+
+    def _sketch_snapshot(self):
+        """(device tuple, packed (Npad, W) int32 sketches, (D,) f32 mean)
+        as ONE atomic read — the sketch cache is keyed to the exact device
+        snapshot it was derived from, so a concurrent mutation rebuilding
+        the snapshot can never pair v1 data with v2 sketches (or cache
+        stale sketches after its own rebuild).  +N*ceil(D/32)*4 bytes of
+        HBM, derived lazily."""
+        with self._lock:
+            device = self._snapshot()
+            if self._sketch is not None and self._sketch[0] is device:
+                return device, self._sketch[1], self._sketch[2]
+            data_d, _, invalid_d = device
+            f = data_d.astype(jnp.float32)
+            live = (~invalid_d).astype(jnp.float32)
+            mean = ((f * live[:, None]).sum(0)
+                    / jnp.maximum(live.sum(), 1.0))
+            packed = _PACK_JIT(f - mean[None, :])
+            self._sketch = (device, packed, mean)
+            return device, packed, mean
 
     # ---- search -----------------------------------------------------------
 
@@ -188,10 +262,29 @@ class FlatIndex(VectorIndex):
                 [queries, np.zeros((q_pad - q, queries.shape[1]),
                                    queries.dtype)], axis=0)
         k_eff = min(k, data_d.shape[0])
-        dists, ids = _flat_search_kernel(
-            data_d, sqnorm_d, invalid_d, jnp.asarray(queries), k_eff,
-            int(self.dist_calc_method), self.base,
-            approx=bool(getattr(self.params, "approx_topk", False)))
+        if getattr(self.params, "sketch_prefilter", False) \
+                and data_d.shape[0] > 256:
+            # re-read atomically WITH the sketches (a concurrent mutation
+            # may have rebuilt the snapshot since the read above)
+            (data_d, sqnorm_d, invalid_d), sketches, mean = \
+                self._sketch_snapshot()
+            k_eff = min(k, data_d.shape[0])
+            # auto shortlist scales with N: the sketch's per-neighbor miss
+            # rate is roughly rank-relative, so a fixed R starves large
+            # corpora (measured 50k d=128 clustered: R=160 -> 0.48 recall,
+            # R=N/48 -> 1.0); the cap bounds the (Q, R, D) re-rank gather
+            R = getattr(self.params, "sketch_rerank", 0) or min(
+                max(128, 16 * k_eff, data_d.shape[0] // 32), 8192)
+            R = min(max(R, k_eff), data_d.shape[0])
+            dists, ids = _flat_sketch_kernel(
+                data_d, sqnorm_d, invalid_d, sketches, mean,
+                jnp.asarray(queries), k_eff, R,
+                int(self.dist_calc_method), self.base)
+        else:
+            dists, ids = _flat_search_kernel(
+                data_d, sqnorm_d, invalid_d, jnp.asarray(queries), k_eff,
+                int(self.dist_calc_method), self.base,
+                approx=bool(getattr(self.params, "approx_topk", False)))
         dists = np.asarray(dists)[:q]
         ids = np.asarray(ids)[:q]
         if k_eff < k:

@@ -258,4 +258,13 @@ class FlatParams(ParamSet):
         # selection.  Trades the index's exactness guarantee for
         # selection speed at large N; distances of returned ids stay exact
         _spec("approx_topk", bool, False, "ApproxTopK"),
+        # TPU-only, opt-in: 1-bit sign-sketch pre-filter (XOR-friendly
+        # binary quantization, arXiv:2008.02002 PAPERS.md).  The scan
+        # reads packed (N, ceil(D/32)) int32 sketches — 1/32 of the f32
+        # corpus bytes — Hamming-shortlists SketchRerank candidates via
+        # XOR+popcount on the VPU, and exact-scores only those on the MXU.
+        # Approximate like ApproxTopK; returned distances stay exact.
+        _spec("sketch_prefilter", bool, False, "SketchPrefilter"),
+        # shortlist size; 0 = auto: min(max(128, 16k, N/32), 8192)
+        _spec("sketch_rerank", int, 0, "SketchRerank"),
     ]
