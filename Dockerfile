@@ -10,7 +10,7 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 WORKDIR /app
 COPY . .
 
-RUN pip install --no-cache-dir "jax[cpu]" numpy pytest
+RUN pip install --no-cache-dir "jax[cpu]" numpy pytest hypothesis
 RUN g++ -O2 -shared -fPIC -std=c++17 -pthread \
     -o native/libsptag_host.so native/sptag_host.cpp
 

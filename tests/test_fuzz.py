@@ -17,8 +17,11 @@ properties generalize them:
 import base64
 
 import numpy as np
-from hypothesis import given, settings
-from hypothesis import strategies as st
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings           # noqa: E402
+from hypothesis import strategies as st          # noqa: E402
 
 import sptag_tpu as sp
 from sptag_tpu.serve import wire
