@@ -139,7 +139,14 @@ class RelativeNeighborhoodGraph:
                                  width, metric, base,
                                  cef=(self.cef if last
                                       else self.cef * self.cef_scale))
-            log.info("RNG refine pass %d/%d width=%d", it + 1, passes, width)
+            # sampled graph-accuracy log per pass — reference RefineGraph
+            # prints GraphAccuracyEstimation after every iteration
+            # (NeighborhoodGraph.h:123,134).  Guarded: the estimate costs
+            # a (100, N) distance pass, skip it when nobody listens
+            if log.isEnabledFor(logging.INFO):
+                log.info("RNG refine pass %d/%d width=%d acc=%.4f",
+                         it + 1, passes, width,
+                         self.accuracy_estimation(data, metric, base))
             if checkpoint is not None and not last:
                 # the final pass is not checkpointed: the full build's own
                 # save (or the bench cache) captures the finished graph
@@ -388,8 +395,14 @@ class RelativeNeighborhoodGraph:
         d = np.array(dist_ops.pairwise_distance(
             q, jnp.asarray(data), metric))
         d[np.arange(len(pick)), pick] = MAX_DIST
-        m = self.graph.shape[1]
-        truth = np.argsort(d, axis=1)[:, :m]
+        m = min(self.graph.shape[1], max(n - 1, 1))
+        # argpartition: O(N) per row vs argsort's O(N log N) — this runs
+        # on the build hot path once per refine pass when INFO logging is
+        # enabled
+        part = np.argpartition(d, m - 1, axis=1)[:, :m]
+        rows = np.take_along_axis(d, part, axis=1)
+        order = np.argsort(rows, axis=1)
+        truth = np.take_along_axis(part, order, axis=1)
         hits = 0
         total = 0
         for row, node in enumerate(pick):
