@@ -19,6 +19,23 @@ accelerator never comes up the bench falls back to the CPU backend and still
 reports a measured number, labeled with "platform".  Built indexes are cached
 under .bench_cache/ so repeat invocations skip the build; build_s is reported
 separately.  A wall-clock budget bounds the whole run.
+
+Round-4 hardening (the round-3 failure was rc=124 with EMPTY stdout — the
+driver killed the buffering parent before it printed anything):
+  * STREAMING — the child prints a parseable headline JSON line the moment
+    any stage completes (flushed), and the parent re-prints child lines as
+    they arrive instead of buffering to the end.  An external kill at any
+    point after the first stage leaves a valid line on stdout; the driver
+    parses the LAST complete line, which is always the most complete result.
+  * Stage 0 is a FLAT (exact, matmul+top_k) headline on the same corpus —
+    no graph build, so a measured line exists within ~1-2 min of a cold
+    start, long before the BKT build finishes.
+  * One envelope — BENCH_BUDGET_S (default 1500 s) — is read once; probe
+    timeout/retries, the TPU child deadline, and the CPU-retry reserve are
+    all derived from it so the worst case (probes + TPU child + CPU child +
+    margin) fits inside the envelope by construction.
+  * tests/test_bench_stream.py SIGKILLs the parent mid-run and asserts a
+    parseable headline was already emitted.
 """
 
 import json
@@ -31,10 +48,17 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".bench_cache")
-CACHE_VERSION = 4          # bump when index params/format/build semantics change
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
-PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
-DEFAULT_BUDGET_S = 3000.0
+CACHE_VERSION = 5          # bump when index params/format/build semantics change
+                           # (v5: FinalRefineSearchMode=beam default + exact int16)
+DEFAULT_BUDGET_S = 1500.0
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+# probe budget derived from the envelope unless explicitly overridden: a
+# 1500 s run gets 150 s probes x2; a 300 s smoke run gets 37 s x1
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
+                                       str(max(20.0, min(180.0,
+                                                         _BUDGET_S / 8)))))
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES",
+                                   "2" if _BUDGET_S >= 1200 else "1"))
 
 _t_start = time.time()
 
@@ -335,16 +359,39 @@ def run_bench():
     if attempts > 1 or (attempts and platform is None):
         result["tpu_probe_attempts"] = attempts
 
+    def _best_printable():
+        """The most complete headline available RIGHT NOW.  Before the BKT
+        sweep lands, the FLAT stage-0 measurement is promoted to the
+        headline slot (with an honest metric name) so an early kill still
+        leaves a measured line rather than zeros."""
+        if result["value"] > 0:
+            return dict(result)
+        if result.get("flat_qps", 0) > 0:
+            obj = dict(result)
+            obj["metric"] = f"qps_per_chip_flat_n{n}_d128_l2_exact"
+            obj["value"] = result["flat_qps"]
+            obj["vs_baseline"] = result.get("flat_vs_baseline", 0.0)
+            return obj
+        return None
+
     def checkpoint():
-        """Stage results survive a watchdog kill: each completed stage
-        atomically rewrites the partial file the parent falls back to (a
-        hung compile in a LATER stage must not erase earlier numbers)."""
+        """Stage results survive a watchdog kill two ways: each completed
+        stage (a) STREAMS the current best headline to stdout immediately
+        (flushed — the driver parses the last complete JSON line, so an
+        external kill after any stage still yields a parsed artifact), and
+        (b) atomically rewrites the partial file the parent falls back to
+        (a hung compile in a LATER stage must not erase earlier numbers)."""
+        best = _best_printable()
+        if best is None:
+            return
+        best["partial"] = True
+        best["total_s"] = round(time.time() - _t_start, 1)
+        print(json.dumps(best), flush=True)
         try:
             os.makedirs(CACHE_DIR, exist_ok=True)
             tmp = os.path.join(CACHE_DIR, f".partial.{os.getpid()}")
             with open(tmp, "w") as f:
-                json.dump(dict(result, partial=True,
-                               total_s=round(time.time() - _t_start, 1)), f)
+                json.dump(best, f)
             os.replace(tmp, os.path.join(CACHE_DIR, "partial_result.json"))
         except Exception:                                # noqa: BLE001
             pass
@@ -379,8 +426,37 @@ def run_bench():
         # trip, so throughput is only visible with enough queries in flight
         data, queries = make_dataset(n=n, nq=4096)
 
-        # CPU baseline timing + full ground truth from the same code path
+        # CPU baseline timing first — vs_baseline for every later stage
         cpu_qps = cpu_brute_force_qps(data, queries, k=k, sample=50)
+        result["cpu_baseline_qps"] = round(cpu_qps, 1)
+
+        # stage 0 — FLAT exact headline (one matmul + top_k, no graph
+        # build): a measured line exists within minutes of a cold start,
+        # long before the BKT build finishes.  Exactness is asserted
+        # against a 50-query exact-topk sample rather than the full truth
+        # (which may itself be minutes of CPU when the disk cache is cold).
+        with trace.span("bench.flat_quick"):
+            flat = sp.create_instance("FLAT", "Float")
+            flat.set_parameter("DistCalcMethod", "L2")
+            flat.build(data)
+            flat.search_batch(queries[:batch], k)        # compile
+            flat.search_batch(queries, k)                # full-set shape
+            t0 = time.perf_counter()
+            _, flat_ids = flat.search_batch(queries, k)
+            flat_dt = time.perf_counter() - t0
+            dn_s = (data ** 2).sum(1)
+            sample_truth = exact_topk(data, dn_s, queries[:50], k)
+            result.update({
+                "flat_qps": round(len(queries) / flat_dt, 1),
+                "flat_vs_baseline": round(
+                    len(queries) / flat_dt / cpu_qps, 2),
+                "flat_recall_sample": recall_at_k(
+                    flat_ids[:50], sample_truth, k),
+            })
+            del flat
+        checkpoint()
+
+        # full ground truth from the same code path (disk-cached)
         truth = l2_truth(data, queries, k)
 
         def build():
@@ -555,14 +631,7 @@ def run_bench():
         os.remove(os.path.join(CACHE_DIR, "partial_result.json"))
     except OSError:
         pass
-    print(json.dumps(result))
-
-
-def _last_json_line(text):
-    for line in reversed(text.strip().splitlines()):
-        if line.startswith("{"):
-            return line
-    return None
+    print(json.dumps(result), flush=True)
 
 
 def _attach_last_tpu(obj):
@@ -585,71 +654,150 @@ def _fallback_result(err):
     return result
 
 
+def _run_streaming_child(argv, env, timeout_s):
+    """Run one bench child, RE-PRINTING every JSON line it emits as it
+    arrives (flushed) — the round-3 lesson: a parent that buffers output
+    until the children finish produces an EMPTY artifact when the driver's
+    own timeout fires first.  Returns (last_json_line|None, err)."""
+    import threading
+
+    script = os.path.abspath(__file__)
+    p = subprocess.Popen([sys.executable, script] + argv,
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True, bufsize=1)
+    last = {"line": None}
+    stderr_tail = []
+
+    def _drain_out():
+        for line in p.stdout:
+            line = line.strip()
+            if line.startswith("{"):
+                last["line"] = line
+                print(line, flush=True)
+
+    def _drain_err():
+        for line in p.stderr:
+            stderr_tail.append(line)
+            del stderr_tail[:-8]
+
+    to = threading.Thread(target=_drain_out, daemon=True)
+    te = threading.Thread(target=_drain_err, daemon=True)
+    to.start(), te.start()
+    err = ""
+    try:
+        p.wait(timeout=timeout_s)
+        to.join(timeout=10)
+        if p.returncode != 0:
+            te.join(timeout=10)      # stderr still mid-read otherwise —
+            # the tail decides the fallback path and lands in the artifact
+            err = (f"child rc={p.returncode} "
+                   f"stderr={''.join(stderr_tail).strip()[-300:]}")
+    except subprocess.TimeoutExpired:
+        p.kill()
+        err = (f"bench child exceeded {timeout_s:.0f}s — hung backend/"
+               "remote compile; killed")
+        to.join(timeout=10)
+    except Exception as e:                               # noqa: BLE001
+        p.kill()
+        err = repr(e)[:300]
+    return last["line"], err
+
+
 def main():
     """Watchdog parent: the measurement runs in a CHILD process under a
-    hard deadline.  The tunneled backend's remote-compile service has been
-    observed to HANG indefinitely on new compiles (not just error), which
-    no in-process budget check can escape; a hung child is killed and the
-    bench retries once on the CPU backend (compiles are local) so the
-    round always gets a measured JSON line."""
+    hard deadline derived from ONE envelope (BENCH_BUDGET_S).  Child JSON
+    lines are streamed through as they arrive, so the driver's artifact is
+    parseable from the first completed stage onward no matter when an
+    external kill lands.  The tunneled backend's remote-compile service
+    has been observed to HANG indefinitely on new compiles (not just
+    error), which no in-process budget check can escape; a hung child is
+    killed and the bench retries once on the CPU backend (compiles are
+    local) so the round always ends with a measured JSON line — and the
+    worst case (probes + TPU child + CPU child + margin) fits inside the
+    envelope by construction."""
     if os.environ.get("BENCH_CHILD") == "1":
         run_bench()
         return
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
+    budget_s = _BUDGET_S
     t_parent = time.time()
-    script = os.path.abspath(__file__)
     env = dict(os.environ, BENCH_CHILD="1")
-    cpu_reserve = 700.0            # parent keeps room for the CPU retry
+    # envelope split: the TPU child gets the budget minus a CPU-retry
+    # reserve and a parent margin; small budgets squeeze the reserve
+    # rather than overrunning the envelope
+    margin = 30.0
+    cpu_reserve = min(600.0, max(120.0, budget_s * 0.35))
     try:      # a stale partial from an older crashed run must not win
         os.remove(os.path.join(CACHE_DIR, "partial_result.json"))
     except OSError:
         pass
-    # small budgets: the TPU child gets most of the budget and the CPU
-    # retry squeezes into what remains (+120 s grace) rather than adding a
-    # fixed 600 s on top of an already-spent budget
-    tpu_timeout = max(min(600.0, budget_s), budget_s - cpu_reserve)
-    env["BENCH_BUDGET_S"] = str(max(tpu_timeout - 60.0, 60.0))
-    err = ""
-    try:
-        p = subprocess.run([sys.executable, script] + sys.argv[1:],
-                           env=env, capture_output=True, text=True,
-                           timeout=tpu_timeout)
-        line = _last_json_line(p.stdout)
-        if line is not None:
-            print(line)
+    tpu_timeout = max(60.0, budget_s - cpu_reserve - margin)
+    env["BENCH_BUDGET_S"] = str(max(tpu_timeout - 30.0, 45.0))
+    line, err = _run_streaming_child(sys.argv[1:], env, tpu_timeout)
+    if line is not None and not err:
+        return                       # final line already streamed
+
+    def _is_full_headline(text):
+        """Only a measured BKT headline ends the run early — a stage-0
+        FLAT partial must not suppress the CPU retry that could still
+        measure the real headline inside the reserved budget."""
+        try:
+            obj = json.loads(text)
+            return (obj.get("metric", "").startswith("qps_per_chip_bkt")
+                    and obj.get("value", 0) > 0)
+        except Exception:                                # noqa: BLE001
+            return False
+
+    def _print_annotated(text, extra):
+        try:
+            obj = json.loads(text)
+            obj.update(extra)
+            if obj.get("platform") != "tpu":
+                _attach_last_tpu(obj)
+            print(json.dumps(obj), flush=True)
+            return True
+        except Exception:                                # noqa: BLE001
+            return False
+
+    if line is not None and _is_full_headline(line):
+        # child was killed after producing the real headline — re-print
+        # it LAST with the error attached so the tail line is annotated
+        if _print_annotated(line, {"child_error": err}):
             return
-        err = f"child rc={p.returncode} stderr={p.stderr.strip()[-300:]}"
-    except subprocess.TimeoutExpired:
-        err = (f"bench child exceeded {tpu_timeout:.0f}s — hung backend/"
-               "remote compile; killed")
-    except Exception as e:                               # noqa: BLE001
-        err = repr(e)[:300]
-    # a killed child may have checkpointed real accelerator numbers from
-    # its completed stages — prefer those over a CPU re-measurement
-    if _emit_partial(err):
-        return
     env["BENCH_PLATFORM"] = "cpu"
-    cpu_timeout = max(120.0, min(600.0,
-                                 budget_s - (time.time() - t_parent) + 120))
-    env["BENCH_BUDGET_S"] = str(max(cpu_timeout - 100.0, 60.0))
-    try:
-        p = subprocess.run([sys.executable, script] + sys.argv[1:],
-                           env=env, capture_output=True, text=True,
-                           timeout=cpu_timeout)
-        line = _last_json_line(p.stdout)
-        if line is not None:
-            obj = json.loads(line)
-            obj["tpu_child_error"] = err
-            print(json.dumps(obj))
+    cpu_timeout = max(90.0, budget_s - (time.time() - t_parent) - margin)
+    env["BENCH_BUDGET_S"] = str(max(cpu_timeout - 30.0, 45.0))
+    line2, err2 = _run_streaming_child(sys.argv[1:], env, cpu_timeout)
+
+    def _rank(text):
+        """full-BKT beats stage-0 FLAT; at equal stage, a measured TPU
+        line beats the CPU one (the old flow's accelerator-first
+        preference, kept now that the CPU retry always runs)."""
+        if text is None:
+            return -1
+        try:
+            obj = json.loads(text)
+        except Exception:                                # noqa: BLE001
+            return -1
+        score = 0 if obj.get("value", 0) > 0 else -1
+        if score >= 0 and _is_full_headline(text):
+            score += 2
+        if score >= 0 and obj.get("platform") == "tpu":
+            score += 1
+        return score
+
+    best = line if _rank(line) >= _rank(line2) else line2
+    if best is not None and _rank(best) >= 0:
+        extra = {"tpu_child_error": err} if best is line2 else \
+            {"child_error": err}
+        if best is line2 and err2:
+            extra["child_error"] = err2
+        if _print_annotated(best, extra):
             return
-        err += f" | cpu retry rc={p.returncode}"
-    except Exception as e:                               # noqa: BLE001
-        err += f" | cpu retry {repr(e)[:200]}"
-    # the CPU retry may itself have checkpointed a measured headline
-    # before being killed — recover it rather than printing zeros
+    err += f" | cpu retry: {err2}"
+    # nothing measured streamed: the checkpoint file is the last resort
     if _emit_partial(err):
         return
-    print(json.dumps(_fallback_result(err)))
+    print(json.dumps(_fallback_result(err)), flush=True)
 
 
 def _emit_partial(err):

@@ -305,7 +305,8 @@ class BKTIndex(VectorIndex):
             self._refine_dense_cache = None
         self._dirty = True
 
-    def _refine_search_factory(self, graph: np.ndarray):
+    def _refine_search_factory(self, graph: np.ndarray,
+                               final: bool = False):
         """SearchFn over a mid-build graph snapshot, at the refine budget
         (MaxCheckForRefineGraph — reference RefineSearchIndex,
         BKTIndex.cpp:266-276).
@@ -313,12 +314,22 @@ class BKTIndex(VectorIndex):
         RefineSearchMode=dense (default) routes the per-node refine
         searches through the MXU cluster scan instead of the beam walk —
         graph build becomes matmul-bound (the beam-refine pass measured
-        ~20x the rest of the build combined off-TPU)."""
+        ~20x the rest of the build combined off-TPU).  The FINAL pass
+        honors FinalRefineSearchMode (default "beam"): dense-refined
+        graphs score 0.937-0.940 under the reference's walk vs
+        0.990-1.000 beam-refined (reports/AB_REFERENCE.md), so the pass
+        that defines the saved edges walks by default while the wide
+        early passes stay matmul-bound."""
         p = self.params
         budget = p.max_check_for_refine_graph
+        mode = getattr(p, "refine_search_mode", "beam")
+        if final:
+            fmode = getattr(p, "final_refine_search_mode", "beam")
+            if fmode != "same":
+                mode = fmode
         # dense refine cuts the current tree into a partition via
         # _partition_tree — KDT shares this path through its kd-cell cut
-        if getattr(p, "refine_search_mode", "beam") == "dense" and \
+        if mode == "dense" and \
                 self._tree is not None:
             # the dense searcher depends on the TREE, not the graph snapshot
             # this factory receives — cache it across the refine passes of
@@ -365,6 +376,48 @@ class BKTIndex(VectorIndex):
 
     # ---- search -----------------------------------------------------------
 
+    def resolve_search_mode(self, mode: str, max_check: int) -> str:
+        """Resolve "auto" to a concrete engine: beam below the
+        AutoModeThreshold budget, dense at or above it — the measured
+        crossover (reports/TPU_PERF.md: beam holds recall at small
+        MaxCheck where the dense scan collapses, dense wins both QPS and
+        recall at large budgets).  A dense-only index (BuildGraph=0) has
+        no walk to fall back to, so auto always resolves to dense there."""
+        if mode != "auto":
+            return mode
+        if not getattr(self.params, "build_graph", 1):
+            return "dense"
+        thr = int(getattr(self.params, "auto_mode_threshold", 1024))
+        return "beam" if max_check < thr else "dense"
+
+    def search_mode_ready(self, mode: str, max_check: int = 0) -> bool:
+        """True when serving `mode` needs no NEW device materialization —
+        the guard a server uses before honoring a wire-level $searchmode
+        override (a lazily built dense pack is roughly a second corpus
+        copy in HBM; a remote client must not be able to force that on an
+        operator who configured beam-only).  The index's own configured
+        mode always reports ready: its engine would be built by the first
+        ordinary search anyway."""
+        default_mc = int(getattr(self.params, "max_check", 8192))
+        mode = self.resolve_search_mode(mode, max_check or default_mc)
+        configured = self.resolve_search_mode(
+            getattr(self.params, "search_mode", "beam"), default_mc)
+        if mode == configured:
+            return True
+        if mode == "beam" and not getattr(self.params, "build_graph", 1):
+            # no graph to walk: the search raises immediately WITHOUT
+            # allocating — honoring the override preserves the documented
+            # failure semantics and costs nothing
+            return True
+        if self._dirty:
+            # a pending mutation invalidates the materialized engines; the
+            # next search REBUILDS whichever engine it needs, so a stale
+            # non-None handle is not "ready" — honoring the override here
+            # would let a wire client trigger exactly the rebuild the
+            # guard exists to prevent
+            return False
+        return (self._dense if mode == "dense" else self._engine) is not None
+
     def _search_batch(self, queries: np.ndarray, k: int,
                       max_check: Optional[int] = None,
                       search_mode: Optional[str] = None
@@ -374,8 +427,9 @@ class BKTIndex(VectorIndex):
         p = self.params
         mc = max_check if max_check is not None else p.max_check
         mode = search_mode or getattr(p, "search_mode", "beam")
-        if mode not in ("beam", "dense"):
+        if mode not in ("beam", "dense", "auto"):
             raise ValueError(f"unknown search mode {mode!r}")
+        mode = self.resolve_search_mode(mode, mc)
         if mode == "dense":
             d, ids = self._get_dense().search(
                 queries, min(k, self._n), max_check=mc,
@@ -644,7 +698,10 @@ class BKTIndex(VectorIndex):
             try:
                 self._graph.refine_once(
                     self._host[:self._n],
-                    self._refine_search_factory(self._graph.graph),
+                    # compaction refine IS the final pass of its rebuild:
+                    # the FinalRefineSearchMode guardrail applies
+                    self._refine_search_factory(self._graph.graph,
+                                                final=True),
                     self._graph.neighborhood_size,
                     int(self.dist_calc_method), self.base)
             finally:

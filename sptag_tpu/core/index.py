@@ -236,9 +236,13 @@ class VectorIndex(abc.ABC):
             self.metadata = metadata
             if with_meta_index and metadata is not None:
                 self.build_meta_mapping()
-        self.build_resumed = ck is not None and ck.resumed
-        if ck is not None:
-            ck.clear()
+            # flag + checkpoint cleanup stay INSIDE the lock: with two
+            # concurrent build() calls, doing these after release let one
+            # build's clear() interleave with the other's stage writes
+            # (ADVICE r3)
+            self.build_resumed = ck is not None and ck.resumed
+            if ck is not None:
+                ck.clear()
         return ErrorCode.Success
 
     def build_meta_mapping(self) -> None:
@@ -318,6 +322,12 @@ class VectorIndex(abc.ABC):
             elif self.metadata is not None:
                 for _ in range(data.shape[0]):
                     self.metadata.add(b"")
+            if with_meta_index and self.metadata is not None \
+                    and self._meta_to_vec is None:
+                # honor with_meta_index on an ALREADY-BUILT index too (it
+                # previously only applied to the first-add-as-build path,
+                # leaving delete_by_metadata dead after admin adds)
+                self.build_meta_mapping()
         return ErrorCode.Success
 
     def delete(self, vectors) -> ErrorCode:

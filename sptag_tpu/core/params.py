@@ -141,6 +141,12 @@ class BKTParams(ParamSet):
             # walk with reference walk semantics) and the dense partition's
             # target cluster size
             _spec("search_mode", str, "dense", "SearchMode"),
+            # SearchMode=auto: per-request engine pick by budget — beam
+            # below this MaxCheck threshold, dense at or above it (the
+            # measured crossover on the 200k corpus is ~1024:
+            # reports/TPU_PERF.md — beam wins recall at small budgets,
+            # dense wins QPS+recall at large ones)
+            _spec("auto_mode_threshold", int, 1024, "AutoModeThreshold"),
             _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
             # 0 = dense-only build (framework extension): skip the RNG
             # graph entirely — the index serves the MXU partition scan
@@ -183,6 +189,17 @@ class BKTParams(ParamSet):
             # "beam" (reference RefineGraph semantics, NeighborhoodGraph.h:
             # 113-143, far slower off-TPU)
             _spec("refine_search_mode", str, "dense", "RefineSearchMode"),
+            # engine for the FINAL refine pass specifically (graph-quality
+            # guardrail, VERDICT r3 item 10): dense-refined graphs score
+            # 0.937-0.940 under the REFERENCE's walk vs 0.990-1.000 for
+            # beam-refined (reports/AB_REFERENCE.md) — our own walk doesn't
+            # care, but indexes saved for reference consumers silently got
+            # the lower-navigability graph.  Default "beam" makes the last
+            # pass (the one that defines the saved edges) walk-refined at
+            # the cost of one beam pass; "same" restores the single-knob
+            # behavior, "dense"/"beam" force an engine
+            _spec("final_refine_search_mode", str, "beam",
+                  "FinalRefineSearchMode"),
             # query-grouped probing for the REFINE searches specifically
             # (queries are corpus rows, maximally probe-local after the
             # partition sort — measured round 2: grouped refine at budget
@@ -213,6 +230,8 @@ class KDTParams(ParamSet):
             # to "beam" for KDT: the kd-seeded walk IS the reference's
             # KDT search; the MXU dense scan is the opt-in fast path
             _spec("search_mode", str, "beam", "SearchMode"),
+            # SearchMode=auto crossover threshold; see the BKT spec
+            _spec("auto_mode_threshold", int, 1024, "AutoModeThreshold"),
             _spec("dense_cluster_size", int, 256, "DenseClusterSize"),
             # 0 = dense-only build; see the BKT spec of the same name
             _spec("build_graph", int, 1, "BuildGraph"),
@@ -223,6 +242,9 @@ class KDTParams(ParamSet):
             # quality (reports/MAXCHECK_SWEEP.md); "beam" restores the
             # reference's RefineGraph-by-walk semantics
             _spec("refine_search_mode", str, "dense", "RefineSearchMode"),
+            # final-pass engine guardrail; see the BKT spec of the same name
+            _spec("final_refine_search_mode", str, "beam",
+                  "FinalRefineSearchMode"),
             # query-grouped probing for the REFINE searches specifically
             # (queries are corpus rows, maximally probe-local after the
             # partition sort — measured round 2: grouped refine at budget
@@ -265,6 +287,13 @@ class FlatParams(ParamSet):
         # XOR+popcount on the VPU, and exact-scores only those on the MXU.
         # Approximate like ApproxTopK; returned distances stay exact.
         _spec("sketch_prefilter", bool, False, "SketchPrefilter"),
-        # shortlist size; 0 = auto: min(max(128, 16k, N/32), 8192)
+        # shortlist size; 0 = auto, CALIBRATED per corpus snapshot: the
+        # index samples rows as self-queries, measures the sketch rank
+        # their exact top-10 land at, and uses the 95th percentile
+        # (floored at max(128, 16k), capped at 8192).  Clustered corpora
+        # calibrate small (~N/48); uniform or low-D data calibrates large
+        # (sign sketches separate poorly there) — when the calibration
+        # would exceed the 8192 cap, recall suffers and the remedy is an
+        # explicit SketchRerank or disabling the prefilter
         _spec("sketch_rerank", int, 0, "SketchRerank"),
     ]

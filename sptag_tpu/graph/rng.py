@@ -80,13 +80,15 @@ class RelativeNeighborhoodGraph:
     # ------------------------------------------------------------------ build
 
     def build(self, data: np.ndarray, metric: int, base: int,
-              search_fn_factory: Optional[Callable[[np.ndarray], SearchFn]]
-              = None, seed: int = 31, checkpoint=None) -> None:
+              search_fn_factory: Optional[Callable[..., SearchFn]] = None,
+              seed: int = 31, checkpoint=None) -> None:
         """Full build: TPT candidates, then refine passes.
 
-        `search_fn_factory(graph)` returns a SearchFn over the *current*
-        graph (the index wires the beam engine in); when None, refine falls
-        back to candidate-only pruning (no re-search).
+        `search_fn_factory(graph, final=bool)` returns a SearchFn over
+        the *current* graph (the index wires the beam engine in; `final`
+        marks the pass that defines the saved edges, for the
+        FinalRefineSearchMode guardrail); when None, refine falls back to
+        candidate-only pruning (no re-search).
 
         `checkpoint` (utils/build_ckpt.BuildCheckpoint): resumable-build
         stage store — each refine pass saves its output graph, and a
@@ -135,8 +137,12 @@ class RelativeNeighborhoodGraph:
             last = it == passes - 1
             width = m if last else width_wide
             with trace.span("build.refine_pass"):
-                self.refine_once(data, search_fn_factory(self.graph),
-                                 width, metric, base,
+                # the factory learns which pass this is: the FINAL pass
+                # defines the saved edges, and the index may route it
+                # through a different engine (FinalRefineSearchMode
+                # guardrail — see algo/bkt._refine_search_factory)
+                fn = search_fn_factory(self.graph, final=last)
+                self.refine_once(data, fn, width, metric, base,
                                  cef=(self.cef if last
                                       else self.cef * self.cef_scale))
             # sampled graph-accuracy log per pass — reference RefineGraph

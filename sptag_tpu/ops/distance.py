@@ -19,10 +19,18 @@ the reference exactly:
   kernels convert lanes to float before the horizontal add).
 
 int8/uint8 inputs use an int32-accumulating MXU dot
-(`preferred_element_type`), which is exact; int16 and float inputs
-accumulate in float32 (int32 would overflow on raw int16 data — a single
-product reaches 2^30 — and float32 is the reference's own int16 SIMD
-convention).
+(`preferred_element_type`), which is exact.  int16 uses an EXACT
+high/low-byte split by default (round-4, VERDICT item 5): a = 256*hi + lo
+decomposes the dot into three int32-exact MXU contractions
+(hi.hi, hi.lo + lo.hi, lo.lo — every partial provably fits int32 below
+_INT16_EXACT_MAX_D dims), combined with ONE float32 rounding for L2 and
+with int32 wraparound (exact, since |dot| <= base^2 < 2^31 on normalized
+rows) for the integer-cosine convention.  This is strictly tighter than
+the reference's own `_mm_madd_epi16` path (product pairs exact in int32,
+then float32 accumulation, DistanceUtils.h:536) — the measured A/B
+consequence of the old per-product-f32 rounding was direction-B int16
+recall 0.934 (reports/AB_REFERENCE.md).  `set_int16_exact(False)` restores
+plain f32 accumulation.  Floats accumulate in float32.
 """
 
 from __future__ import annotations
@@ -59,6 +67,65 @@ def _is_int(dtype) -> bool:
     return jnp.issubdtype(dtype, jnp.integer)
 
 
+# --- exact int16 (high/low byte split) -------------------------------------
+
+_INT16_EXACT = True
+# every partial sum fits int32 below this D: the worst partial is
+# sum(lo*lo) <= D * 255^2, so D <= 2^31 / 65025 ~ 33k; halved for margin
+_INT16_EXACT_MAX_D = 16384
+
+
+def set_int16_exact(on: bool) -> None:
+    global _INT16_EXACT
+    _INT16_EXACT = bool(on)
+
+
+def int16_exact() -> bool:
+    return _INT16_EXACT
+
+
+def _use_int16_exact(dtype, d: int) -> bool:
+    return (_INT16_EXACT and jnp.dtype(dtype) == jnp.int16
+            and d <= _INT16_EXACT_MAX_D)
+
+
+def _int16_split(a: jax.Array):
+    """a = 256*hi + lo with hi in [-128, 127] (arithmetic shift) and lo in
+    [0, 255] — both int32, products of any two parts fit comfortably."""
+    ai = a.astype(jnp.int32)
+    return ai >> 8, ai & 255
+
+
+def _int16_dot_parts(q, x, contract):
+    """Three int32-exact contractions whose weighted sum is the exact
+    int16 dot: dot = 2^16*hh + 2^8*(hi.lo + lo.hi) + ll.  The two mixed
+    terms ride ONE contraction by concatenating along the reduced axis."""
+    qh, ql = _int16_split(q)
+    xh, xl = _int16_split(x)
+    hh = contract(qh, xh)
+    mixed = contract(jnp.concatenate([qh, ql], axis=-1),
+                     jnp.concatenate([xl, xh], axis=-1))
+    ll = contract(ql, xl)
+    return hh, mixed, ll
+
+
+def _int16_parts_f32(hh, mixed, ll) -> jax.Array:
+    """Float32 combine: each partial is exact, so the only rounding is
+    this one weighted sum (vs one rounding PER PRODUCT in the plain f32
+    path)."""
+    return (65536.0 * hh.astype(jnp.float32)
+            + 256.0 * mixed.astype(jnp.float32)
+            + ll.astype(jnp.float32))
+
+
+def _int16_parts_i32(hh, mixed, ll) -> jax.Array:
+    """Int32 wraparound combine: EXACT whenever the true dot fits int32
+    (int32 addition is associative mod 2^32, so intermediate wraps cancel)
+    — guaranteed for the cosine convention, where rows are normalized to
+    length base and Cauchy-Schwarz bounds |dot| <= base^2 < 2^31."""
+    return ((hh << 16) + (mixed << 8) + ll).astype(jnp.int32)
+
+
 def exact_int_dot(dtype) -> bool:
     """True for integer dtypes whose dot products accumulate exactly in
     int32 (int8/uint8: the bound D*255^2 cannot overflow).  int16 products
@@ -77,13 +144,11 @@ def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
     raw int16 L2 data (a single product reaches 2^30).  Floats contract
     in float32 on the MXU.
 
-    Exactness caveat vs the reference on int16: _mm_madd_epi16 computes
-    each int16 product PAIR exactly in int32 before its float horizontal
-    add, while this path rounds each individual product to float32
-    (32767^2 needs 30 mantissa bits, float32 has 24) — distances can
-    deviate by a few ULPs on raw int16 data near ties.  Accepted: the
-    deviation cannot flip a ranking beyond genuine near-ties, and an
-    int32 pair-sum emulation would halve MXU throughput.
+    int16 defaults to the exact high/low split (module docstring): three
+    int32-exact contractions + one f32 rounding, strictly tighter than
+    both plain-f32 accumulation AND the reference's pair-exact
+    `_mm_madd_epi16` + f32 horizontal add.  Falls back to plain f32 when
+    disabled or beyond _INT16_EXACT_MAX_D dims.
     """
     dn = (((1,), (1,)), ((), ()))
     if exact_int_dot(q.dtype):
@@ -91,6 +156,11 @@ def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
             q.astype(jnp.int32), x.astype(jnp.int32), dn,
             preferred_element_type=jnp.int32)
         return out.astype(jnp.float32)
+    if _use_int16_exact(q.dtype, q.shape[-1]):
+        def contract(a, b):
+            return jax.lax.dot_general(a, b, dn,
+                                       preferred_element_type=jnp.int32)
+        return _int16_parts_f32(*_int16_dot_parts(q, x, contract))
     return jax.lax.dot_general(
         q.astype(jnp.float32), x.astype(jnp.float32), dn,
         precision=_FLOAT_PRECISION,
@@ -101,9 +171,15 @@ def row_sqnorms(x: jax.Array) -> jax.Array:
     """(N, D) -> (N,) squared norms, float32 (exact int32 path for ints)."""
     if _is_int(x.dtype):
         xi = x.astype(jnp.int32)
-        # int16^2 * D can overflow int32 for D >~ 2; accumulate in float32
-        # like the reference scalar tail does for L2 (DistanceUtils.h:401-404).
+        # int16^2 * D can overflow int32 for D >~ 2: split the square as
+        # x^2 = 2^16 h^2 + 2^9 h*l + l^2 (each partial int32-exact) and
+        # combine with one f32 rounding; plain f32 otherwise
         if x.dtype == jnp.int16:
+            if _use_int16_exact(x.dtype, x.shape[-1]):
+                h, low = _int16_split(x)
+                return (65536.0 * jnp.sum(h * h, -1).astype(jnp.float32)
+                        + 512.0 * jnp.sum(h * low, -1).astype(jnp.float32)
+                        + jnp.sum(low * low, -1).astype(jnp.float32))
             xf = x.astype(jnp.float32)
             return jnp.sum(xf * xf, axis=-1)
         return jnp.sum(xi * xi, axis=-1).astype(jnp.float32)
@@ -128,7 +204,21 @@ def pairwise_l2(q: jax.Array, x: jax.Array,
 def pairwise_cosine(q: jax.Array, x: jax.Array, base: int) -> jax.Array:
     """(Q, D) x (N, D) -> (Q, N) cosine distances per reference convention:
     ``base^2 - dot`` (int) / ``1 - dot`` (float), both reduce to
-    ``base^2 - dot`` with base=1 for float."""
+    ``base^2 - dot`` with base=1 for float.
+
+    int16 computes ``base^2 - dot`` ENTIRELY in int32 (exact): rows are
+    normalized to length base=32767 so |dot| <= base^2 < 2^31, the
+    wraparound combine is exact, and the small final difference converts
+    to float32 losslessly — the f32-cancellation near base^2 that plagued
+    the old path never happens."""
+    if _use_int16_exact(q.dtype, q.shape[-1]):
+        dn = (((1,), (1,)), ((), ()))
+
+        def contract(a, b):
+            return jax.lax.dot_general(a, b, dn,
+                                       preferred_element_type=jnp.int32)
+        dot = _int16_parts_i32(*_int16_dot_parts(q, x, contract))
+        return (jnp.int32(int(base) * int(base)) - dot).astype(jnp.float32)
     return float(base) * float(base) - pairwise_dot(q, x)
 
 
@@ -161,8 +251,24 @@ def batched_gathered_distance(q: jax.Array, cand: jax.Array,
     metric = int(metric)
     if _is_int(q.dtype):
         if not exact_int_dot(q.dtype):
-            # int16: float32 accumulation (see pairwise_dot — int32
-            # overflows on raw int16 data; f32 is the reference convention)
+            if _use_int16_exact(q.dtype, q.shape[-1]):
+                # exact int16 split (module docstring); cosine combines
+                # fully in int32, L2 pays one f32 rounding per term
+                def contract(a, b):
+                    return jnp.einsum("qd,qcd->qc", a, b,
+                                      preferred_element_type=jnp.int32)
+                parts = _int16_dot_parts(q, cand, contract)
+                if metric == int(DistCalcMethod.Cosine):
+                    return (jnp.int32(int(base) * int(base))
+                            - _int16_parts_i32(*parts)
+                            ).astype(jnp.float32)
+                dot = _int16_parts_f32(*parts)
+                qn = row_sqnorms(q)[:, None]
+                if cand_sqnorm is None:
+                    cand_sqnorm = row_sqnorms(cand)
+                return jnp.maximum(qn + cand_sqnorm - 2.0 * dot, 0.0)
+            # int16 fallback: float32 accumulation (int32 overflows on
+            # raw int16 data beyond the exact-path D guard)
             dot = jnp.einsum("qd,qcd->qc", q.astype(jnp.float32),
                              cand.astype(jnp.float32),
                              precision=_FLOAT_PRECISION,

@@ -386,6 +386,18 @@ class ShardedBKTIndex:
         self.nbp_limit = 3
         self.beam_width = 16
         self.metadata = None
+        # per-shard budget policy (VERDICT r3 item 8): "full" runs every
+        # shard at the whole MaxCheck — total work scales n_dev x the
+        # single-chip budget (the reference Aggregator's fan-out semantics,
+        # AggregatorService.cpp:206-279, where each Server owns an
+        # INDEPENDENT index and must be searched at full budget);
+        # "proportional" gives each shard ceil(MaxCheck / n_dev) (floored)
+        # so the mesh does single-chip total work; "guarded" calibrates
+        # the smallest proportional multiplier whose results overlap the
+        # full-budget results >= the guard threshold, per (MaxCheck, k)
+        self.budget_policy = "full"
+        self.budget_guard_overlap = 0.99
+        self._guarded_cache: dict = {}
 
     @classmethod
     def load(cls, folder: str,
@@ -640,11 +652,14 @@ class ShardedBKTIndex:
 
     def search_dense(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
-                     normalized: bool = False
+                     normalized: bool = False,
+                     budget_policy: Optional[str] = None
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Multi-chip dense mode: every shard probes the top blocks of its
         own partition in one shard_map program with an all-gather top-k
-        merge.  Requires `build(..., dense=True)`."""
+        merge.  Requires `build(..., dense=True)`.  `budget_policy`
+        splits MaxCheck across shards like `search` does (the budget
+        drives each shard's nprobe)."""
         if not hasattr(self, "dense_perm"):
             raise RuntimeError(
                 "dense layout not packed — build with dense=True")
@@ -654,6 +669,25 @@ class ShardedBKTIndex:
         if self.metric == DistCalcMethod.Cosine and not normalized:
             queries = dist_ops.normalize(queries, self.base)
         max_check = max_check if max_check is not None else self.max_check
+        policy = budget_policy or self.budget_policy
+        if policy not in ("full", "proportional", "guarded"):
+            raise ValueError(f"unknown budget policy {policy!r}")
+        k_local_cap = min(k, self.n_local)
+        mc_shard = self._resolve_budget(
+            queries, k, max_check, k_local_cap, policy,
+            lambda qs, mc: self._search_dense_raw(qs, k, mc),
+            mode="dense")
+        if policy != "full":
+            # dense budget maps to nprobe: never drop below 2 probes per
+            # shard — a single probe has no second-best block to rescue
+            # boundary rows, which craters recall on coarse partitions
+            mc_shard = min(max_check,
+                           max(mc_shard, 2 * self.dense_cluster_size))
+        return self._search_dense_raw(queries, k, mc_shard)
+
+    def _search_dense_raw(self, queries: np.ndarray, k: int,
+                          max_check: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
         nprobe = int(np.clip(-(-max_check // self.dense_cluster_size), 1,
                              self.dense_num_clusters))
         n_dev = self.mesh.devices.size
@@ -684,14 +718,76 @@ class ShardedBKTIndex:
         self.pivot_vecs = jax.device_put(pivot_vecs, rows3)
         self.pivot_mask = jax.device_put(pivot_mask, rows)
 
+    # ---- per-shard budget policy (VERDICT r3 item 8) ---------------------
+
+    def set_budget_policy(self, policy: str,
+                          guard_overlap: Optional[float] = None) -> None:
+        """"full" | "proportional" | "guarded" — how the query MaxCheck
+        splits across shards.  Changing the policy clears the guarded
+        calibration cache."""
+        if policy not in ("full", "proportional", "guarded"):
+            raise ValueError(f"unknown budget policy {policy!r}")
+        self.budget_policy = policy
+        if guard_overlap is not None:
+            self.budget_guard_overlap = float(guard_overlap)
+        self._guarded_cache.clear()
+
+    def _proportional_budget(self, max_check: int, k_local: int,
+                             mult: int = 1) -> int:
+        """ceil(MaxCheck / n_dev) * mult, floored so tiny budgets still
+        walk (4*k_local candidates or 64, whichever is larger) and capped
+        at the full budget."""
+        n_dev = self.mesh.devices.size
+        mc = -(-max_check // n_dev) * mult
+        return int(min(max_check, max(mc, 4 * k_local, 64)))
+
+    def _resolve_budget(self, queries: np.ndarray, k: int, max_check: int,
+                        k_local: int, policy: str, search_at,
+                        mode: str = "beam") -> int:
+        """Per-shard budget under the active policy.  "guarded"
+        calibrates ONCE per (mode, max_check, k): the smallest
+        proportional multiplier whose top-k overlaps the full-budget
+        top-k by >= budget_guard_overlap on a sample of the live batch —
+        the multiplier is cached, so steady-state searches pay nothing."""
+        if policy == "full" or self.mesh.devices.size == 1:
+            return max_check
+        if policy == "proportional":
+            return self._proportional_budget(max_check, k_local)
+        key = (mode, int(max_check), int(k))
+        hit = self._guarded_cache.get(key)
+        if hit is not None:
+            return hit
+        sample = queries[:min(32, len(queries))]
+        _, ids_full = search_at(sample, max_check)
+        mult = 1
+        while True:
+            mc = self._proportional_budget(max_check, k_local, mult)
+            if mc >= max_check:
+                self._guarded_cache[key] = max_check
+                return max_check
+            _, ids_m = search_at(sample, mc)
+            overlap = float(np.mean([
+                len(set(ids_m[i]) & set(ids_full[i])) / max(1, k)
+                for i in range(len(sample))]))
+            if overlap >= self.budget_guard_overlap:
+                self._guarded_cache[key] = mc
+                return mc
+            mult *= 2
+
     def search(self, queries: np.ndarray, k: int = 10,
                max_check: Optional[int] = None,
                beam_width: Optional[int] = None,
                pool_size: Optional[int] = None,
-               normalized: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+               normalized: bool = False,
+               budget_policy: Optional[str] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched mesh search; same knob semantics as
         GraphSearchEngine.search, applied per shard.  `max_check` and
-        `beam_width` default to the build params (MaxCheck / BeamWidth)."""
+        `beam_width` default to the build params (MaxCheck / BeamWidth).
+        `budget_policy` overrides the index policy for this call (see
+        set_budget_policy — "full" reproduces the reference Aggregator's
+        n_dev x total work; "proportional"/"guarded" hold total work near
+        the single-chip budget)."""
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
@@ -705,6 +801,20 @@ class ShardedBKTIndex:
         max_check = max_check if max_check is not None else self.max_check
         beam_width = (beam_width if beam_width is not None
                       else self.beam_width)
+        k_local = min(k, self.n_local)     # per-shard beam cap
+        policy = budget_policy or self.budget_policy
+        if policy not in ("full", "proportional", "guarded"):
+            raise ValueError(f"unknown budget policy {policy!r}")
+        mc_shard = self._resolve_budget(
+            queries, k, max_check, k_local, policy,
+            lambda qs, mc: self._search_raw(qs, k, mc, beam_width,
+                                            pool_size))
+        return self._search_raw(queries, k, mc_shard, beam_width,
+                                pool_size)
+
+    def _search_raw(self, queries: np.ndarray, k: int, max_check: int,
+                    beam_width: int, pool_size: Optional[int]
+                    ) -> Tuple[np.ndarray, np.ndarray]:
         n_dev = self.mesh.devices.size
         k_local = min(k, self.n_local)     # per-shard beam cap
         k_final = min(k, self.n, k_local * n_dev)   # global merge cap

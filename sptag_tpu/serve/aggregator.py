@@ -52,12 +52,17 @@ def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
     `per_server` is one result list per replying backend.  K per index =
     the most REAL (non-sentinel) entries any single backend returned for
     that name.  Vector ids are shard-LOCAL, so two servers' equal ids may
-    be different vectors: duplicate detection keys on the metadata
-    payload when one is present (metadata is the cross-shard identity the
-    reference's client-side merge lives with too) and otherwise on
-    (server, id) — replicated no-metadata deployments may therefore
-    return the same vector once per replica.  Ties break on distance
-    then id for determinism."""
+    be different vectors: entry identity is always (server, id), and
+    metadata is used ONLY to collapse replicas — same metadata bytes AND
+    a distance within a small relative tolerance (bit-equality would be
+    the same kernel on the same padding; heterogeneous backends — a
+    reference C++ server next to this one, or differently padded shards
+    with different XLA reduction orders — score the same vector with a
+    few-ULP spread).  Two distinct vectors that merely share a
+    non-unique metadata label differ by far more than the tolerance and
+    are both returned (ADVICE r3: keying on raw metadata alone conflated
+    them).  Ties break on distance then id for determinism."""
+    rel_tol = 1e-5
     groups: dict = {}
     for srv_i, results in enumerate(per_server):
         for r in results:
@@ -72,15 +77,17 @@ def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
                      else [b""] * len(r.ids))
             for vid, dist, meta in zip(r.ids, r.dists, metas):
                 if vid >= 0:
-                    key = meta if (has_meta and meta) else (srv_i, int(vid))
-                    entries.append((float(dist), int(vid), meta, key))
+                    entries.append((float(dist), int(vid), meta, srv_i))
         entries.sort(key=lambda e: (e[0], e[1]))
-        seen = set()
+        kept_dists: dict = {}        # meta -> distances already kept
         best = []
-        for dist, vid, meta, key in entries:
-            if key in seen:
-                continue
-            seen.add(key)
+        for dist, vid, meta, srv_i in entries:
+            if has_meta and meta:
+                prior = kept_dists.setdefault(meta, [])
+                tol = rel_tol * max(abs(dist), 1.0)
+                if any(abs(dist - d0) <= tol for d0 in prior):
+                    continue                  # replica of a kept entry
+                prior.append(dist)
             best.append((dist, vid, meta))
             if len(best) == k:
                 break

@@ -84,12 +84,14 @@ class ParsedQuery:
 
     @property
     def search_mode(self) -> Optional[str]:
-        """Per-request engine pick, "beam" or "dense" (framework
-        extension; see module docstring).  None = the index's SearchMode
-        parameter; unknown values also map to None so a typo degrades to
-        the configured default rather than failing the query."""
+        """Per-request engine pick, "beam", "dense", or "auto" (framework
+        extension; see module docstring).  "auto" resolves per request by
+        budget: beam below the index's AutoModeThreshold, dense at or
+        above it.  None = the index's SearchMode parameter; unknown
+        values also map to None so a typo degrades to the configured
+        default rather than failing the query."""
         raw = (self.options.get("searchmode") or "").lower()
-        return raw if raw in ("beam", "dense") else None
+        return raw if raw in ("beam", "dense", "auto") else None
 
     def extract_vector(self, value_type: VectorValueType,
                        separator: str = DEFAULT_SEPARATOR

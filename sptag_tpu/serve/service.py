@@ -49,6 +49,21 @@ class ServiceSettings:
     # ceiling for the wire-reachable $maxcheck override: unbounded, one
     # request could pin the device with ceil(max_check/B) beam iterations
     max_check_limit: int = 65536
+    # policy for the wire-reachable $searchmode override.  "on" always
+    # honors it; "off" ignores it; "auto" (default) honors it only when
+    # the requested engine is ALREADY materialized on device — a lazy
+    # dense-pack build is roughly a second corpus copy in HBM, and a
+    # remote client must not be able to force that allocation on an
+    # operator who configured beam-only ($maxcheck by contrast has
+    # max_check_limit as its DoS ceiling)
+    allow_search_mode_override: str = "auto"
+    # opt-in remote admin surface (round 4, VERDICT item 7): the
+    # reference's SWIG wrappers give Java/C#/.NET the full in-process
+    # AnnIndex Build/Add/Delete surface (Wrappers/inc/CoreInterface.h:
+    # 14-65); here non-Python languages reach the same capabilities over
+    # the wire via `$admin:<op>` query lines.  Off by default — index
+    # mutation from the network is an operator decision
+    enable_remote_admin: bool = False
 
 
 class ServiceContext:
@@ -74,6 +89,15 @@ class ServiceContext:
                 "QueryConfig", "DefaultMaxResultNumber", "10")),
             vector_separator=reader.get_parameter(
                 "QueryConfig", "DefaultSeparator", DEFAULT_SEPARATOR),
+            allow_search_mode_override={
+                "1": "on", "true": "on", "on": "on",
+                "0": "off", "false": "off", "off": "off",
+            }.get(reader.get_parameter(
+                "Service", "AllowSearchModeOverride", "auto").lower(),
+                "auto"),
+            enable_remote_admin=reader.get_parameter(
+                "Service", "EnableRemoteAdmin", "0").lower() in
+            ("1", "true", "on", "yes"),
         )
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
@@ -102,7 +126,142 @@ class SearchExecutor:
 
     def execute(self, query_text: str) -> RemoteSearchResult:
         parsed = parse_query(query_text)
+        if "admin" in parsed.options:
+            return self._execute_admin(parsed)
         return self._run(parsed)
+
+    # ---- remote admin surface (round 4, VERDICT item 7) -------------------
+
+    @staticmethod
+    def _admin_reply(ok: bool, message: str,
+                     count: int = 0) -> RemoteSearchResult:
+        """Admin ops answer with the SAME RemoteSearchResult body the
+        search path uses (so every existing client can drive them): one
+        result row whose index_name carries a machine-parseable
+        `admin:<ok|error>:<message>` marker and whose single id is the
+        affected-row count."""
+        return RemoteSearchResult(
+            ResultStatus.Success if ok else ResultStatus.FailedExecute,
+            [IndexSearchResult(
+                f"admin:{'ok' if ok else 'error'}:{message}",
+                [int(count)], [0.0], None)])
+
+    def _execute_admin(self, parsed: ParsedQuery) -> RemoteSearchResult:
+        """`$admin:<op>` — the reference's in-process AnnIndex
+        Build/Add/Delete surface (Wrappers/inc/CoreInterface.h:14-65),
+        reachable over the wire so Java/C#/.NET clients can drive the
+        full index lifecycle.  Ops:
+
+        * `$admin:build $indexname:n $datatype:T $dimension:D
+          [$algo:BKT|KDT|FLAT] [$distcalcmethod:L2|Cosine]
+          [$params:Name=Val,Name=Val] #<b64 raw row-major block>`
+        * `$admin:add $indexname:n [$metadata:<b64>] #<b64 rows>`
+        * `$admin:delete $indexname:n #<b64 rows>` (delete-by-content)
+        * `$admin:deletemeta $indexname:n $metadata:<b64>`
+
+        Gated by `[Service] EnableRemoteAdmin` (default off)."""
+        import base64 as b64mod
+
+        from sptag_tpu.core.index import create_instance
+        from sptag_tpu.core.types import ErrorCode
+        from sptag_tpu.core.vectorset import MetadataSet
+
+        if not self.context.settings.enable_remote_admin:
+            return self._admin_reply(False, "disabled")
+        op = parsed.options.get("admin", "").lower()
+        names = parsed.index_names
+        if len(names) != 1:
+            return self._admin_reply(False, "need-one-indexname")
+        name = names[0]
+        try:
+            if op == "build":
+                dt = parsed.data_type
+                if dt is None:
+                    return self._admin_reply(False, "need-datatype")
+                try:
+                    dim = int(parsed.options.get("dimension", ""))
+                except ValueError:
+                    return self._admin_reply(False, "need-dimension")
+                flat = parsed.extract_vector(
+                    dt, self.context.settings.vector_separator)
+                if flat is None or dim <= 0 or flat.size % dim:
+                    return self._admin_reply(False, "bad-vector-block")
+                algo = parsed.options.get("algo", "BKT").upper()
+                index = create_instance(algo, dt)
+                index.set_parameter(
+                    "DistCalcMethod",
+                    parsed.options.get("distcalcmethod", "L2"))
+                for kv in parsed.options.get("params", "").split(","):
+                    if not kv:
+                        continue
+                    pname, _, pval = kv.partition("=")
+                    if not index.set_parameter(pname, pval):
+                        return self._admin_reply(False,
+                                                 f"bad-param-{pname}")
+                index.build(flat.reshape(-1, dim))
+                self.context.add_index(name, index)
+                return self._admin_reply(True, "built", index.num_samples)
+            index = self.context.indexes.get(name)
+            if index is None:
+                return self._admin_reply(False, "no-such-index")
+            if op == "add":
+                rows = parsed.extract_vector(
+                    index.value_type,
+                    self.context.settings.vector_separator)
+                if rows is None or index.feature_dim == 0 \
+                        or rows.size % index.feature_dim:
+                    return self._admin_reply(False, "bad-vector-block")
+                rows = rows.reshape(-1, index.feature_dim)
+                metadata = None
+                raw_meta = parsed.options.get("metadata")
+                if raw_meta is not None:
+                    try:
+                        payload = b64mod.b64decode(raw_meta,
+                                                   validate=False)
+                    except Exception:                    # noqa: BLE001
+                        return self._admin_reply(False, "bad-metadata")
+                    # one metadata payload per row, \x00-separated (a
+                    # single row may omit the separator entirely)
+                    parts = payload.split(b"\x00")
+                    if len(parts) != len(rows):
+                        return self._admin_reply(False,
+                                                 "metadata-count-mismatch")
+                    metadata = MetadataSet(parts)
+                code = index.add(rows, metadata,
+                                 with_meta_index=metadata is not None)
+                ok = code == ErrorCode.Success
+                return self._admin_reply(ok, "added" if ok else str(code),
+                                         len(rows) if ok else 0)
+            if op == "delete":
+                rows = parsed.extract_vector(
+                    index.value_type,
+                    self.context.settings.vector_separator)
+                if rows is None or index.feature_dim == 0 \
+                        or rows.size % index.feature_dim:
+                    return self._admin_reply(False, "bad-vector-block")
+                rows = rows.reshape(-1, index.feature_dim)
+                code = index.delete(rows)
+                ok = code == ErrorCode.Success
+                return self._admin_reply(ok,
+                                         "deleted" if ok else str(code),
+                                         len(rows) if ok else 0)
+            if op == "deletemeta":
+                raw_meta = parsed.options.get("metadata")
+                if raw_meta is None:
+                    return self._admin_reply(False, "need-metadata")
+                try:
+                    payload = b64mod.b64decode(raw_meta, validate=False)
+                except Exception:                        # noqa: BLE001
+                    return self._admin_reply(False, "bad-metadata")
+                code = index.delete_by_metadata(payload)
+                ok = code == ErrorCode.Success
+                return self._admin_reply(ok,
+                                         "deleted" if ok else str(code),
+                                         1 if ok else 0)
+            return self._admin_reply(False, f"unknown-op-{op}")
+        except Exception as e:                           # noqa: BLE001
+            log.exception("admin op %s failed", op)
+            return self._admin_reply(False, f"exception-{type(e).__name__}")
 
     def _sanitize_max_check(self, parsed: ParsedQuery) -> Optional[int]:
         """Clamp the wire-reachable $maxcheck to the service ceiling and
@@ -120,6 +279,33 @@ class SearchExecutor:
         # configured ceiling (a non-power-of-two limit admits at most one
         # extra compiled shape — the limit itself)
         return min(mc, self.context.settings.max_check_limit)
+
+    def _sanitize_search_mode(self, parsed: ParsedQuery,
+                              index: VectorIndex) -> Optional[str]:
+        """Apply the AllowSearchModeOverride policy to the wire-level
+        $searchmode option.  Under "auto" the override is honored only
+        when the engine it resolves to is already materialized — a remote
+        client must not be able to trigger a lazy dense-pack build
+        (roughly a second corpus copy in HBM) on a beam-configured
+        server.  A dropped override degrades to the index's configured
+        SearchMode, mirroring how an unknown $searchmode value parses."""
+        sm = parsed.search_mode
+        if sm is None:
+            return None
+        policy = self.context.settings.allow_search_mode_override
+        if policy == "on":
+            return sm
+        if policy == "off":
+            return None
+        ready = getattr(index, "search_mode_ready", None)
+        if ready is None:
+            return sm                     # modeless index (FLAT): harmless
+        mc = self._sanitize_max_check(parsed)
+        if ready(sm, mc if mc is not None else 0):
+            return sm
+        log.warning("dropping $searchmode:%s — engine not materialized "
+                    "and AllowSearchModeOverride=auto", sm)
+        return None
 
     def _select_indexes(self, parsed: ParsedQuery) -> Dict[str, VectorIndex]:
         names = parsed.index_names
@@ -149,7 +335,7 @@ class SearchExecutor:
                     np.dtype(vec.dtype), copy=False), k=k,
                     with_metadata=parsed.extract_metadata,
                     max_check=self._sanitize_max_check(parsed),
-                    search_mode=parsed.search_mode)
+                    search_mode=self._sanitize_search_mode(parsed, index))
             except Exception:
                 log.exception("search failed on index %s", name)
                 return RemoteSearchResult(ResultStatus.FailedExecute, [])
@@ -167,6 +353,9 @@ class SearchExecutor:
         results: List[Optional[RemoteSearchResult]] = [None] * len(parsed)
         groups: Dict[tuple, List[int]] = {}
         for i, p in enumerate(parsed):
+            if "admin" in p.options:      # mutations never batch/group
+                results[i] = self._execute_admin(p)
+                continue
             sel = tuple(sorted(self._select_indexes(p)))
             key = (sel, p.result_num
                    or self.context.settings.default_max_result,
@@ -197,9 +386,10 @@ class SearchExecutor:
                 if not ok:
                     continue
                 try:
-                    dists, ids = index.search_batch(np.stack(vecs), k,
-                                                    max_check=max_check,
-                                                    search_mode=search_mode)
+                    dists, ids = index.search_batch(
+                        np.stack(vecs), k, max_check=max_check,
+                        search_mode=self._sanitize_search_mode(
+                            parsed[ok[0]], index))
                 except Exception:
                     log.exception("batch search failed on index %s", name)
                     for i in ok:

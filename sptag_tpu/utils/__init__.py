@@ -42,7 +42,8 @@ def enable_compile_cache() -> None:
 
     Build kernels cost 20-40 s EACH to compile on a tunneled TPU backend;
     the persistent cache makes repeat builds (and repeat processes) reuse
-    them.  Directory: $SPTAG_TPU_COMPILE_CACHE, default /tmp/jax_cache;
+    them.  Directory: $SPTAG_TPU_COMPILE_CACHE, default
+    /tmp/jax_cache-<machine fingerprint> (see the salting comment below);
     set it to "" to disable.  Called from the index build/search entry
     points rather than import time so importing the library never
     initializes a backend.
@@ -53,7 +54,28 @@ def enable_compile_cache() -> None:
     _cache_enabled = True
     import os
 
-    path = os.environ.get("SPTAG_TPU_COMPILE_CACHE", "/tmp/jax_cache")
+    path = os.environ.get("SPTAG_TPU_COMPILE_CACHE")
+    if path is None:
+        # default path is SALTED with a machine fingerprint: XLA:CPU AOT
+        # executables are feature-tuned to the compiling machine, and
+        # LOADING an entry compiled under a different feature profile
+        # segfaults the process (observed round 4: a /tmp/jax_cache
+        # carried entries with +prefer-no-scatter/+amx-fp16 the host
+        # lacks; cpu_aot_loader warned, then jax's cache read crashed).
+        # Salting by (jax version, CPU flags hash) makes foreign entries
+        # invisible instead of fatal.
+        import hashlib
+
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = next((ln for ln in f if ln.startswith("flags")), "")
+        except OSError:
+            flags = ""
+        import jax
+
+        salt = hashlib.sha256(
+            (jax.__version__ + flags).encode()).hexdigest()[:12]
+        path = f"/tmp/jax_cache-{salt}"
     if not path:
         return
     import jax

@@ -60,20 +60,40 @@ class BuildCheckpoint:
 
     # orphan GC: an interrupted build whose data/params then change leaves
     # a subfolder no future fingerprint will ever match — prune siblings
-    # untouched for this long (stage files can total hundreds of MB)
+    # untouched for this long (stage files can total hundreds of MB).
+    # Overridable via SPTAG_TPU_BUILD_CKPT_GC_AGE_S (seconds; <= 0
+    # disables GC entirely) so a legitimately suspended build whose job
+    # is requeued after the default window does not silently lose its
+    # stages (ADVICE r3).  GC runs only from clear() — the single point
+    # where THIS build succeeded and its folder is being retired — not
+    # from every constructor, so concurrent shard builds don't each
+    # rescan the root (and a resuming constructor can never reap a
+    # sibling mid-write).
     _GC_AGE_S = 7 * 24 * 3600.0
 
     def __init__(self, root: str, fingerprint: str):
+        self._root = root
         self.folder = os.path.join(root, fingerprint[:16])
         os.makedirs(self.folder, exist_ok=True)
         # True once any stage was served from disk — callers report it so
         # a resumed "cold" build time is never mistaken for a full one
         self.resumed = False
-        self._gc_orphans(root)
+
+    def _gc_age_s(self) -> float:
+        raw = os.environ.get("SPTAG_TPU_BUILD_CKPT_GC_AGE_S")
+        if raw is None:
+            return self._GC_AGE_S
+        try:
+            return float(raw)
+        except ValueError:
+            return self._GC_AGE_S
 
     def _gc_orphans(self, root: str) -> None:
         import time
-        cutoff = time.time() - self._GC_AGE_S
+        age = self._gc_age_s()
+        if age <= 0:
+            return
+        cutoff = time.time() - age
         try:
             entries = os.listdir(root)
         except OSError:
@@ -144,3 +164,4 @@ class BuildCheckpoint:
 
     def clear(self) -> None:
         shutil.rmtree(self.folder, ignore_errors=True)
+        self._gc_orphans(self._root)

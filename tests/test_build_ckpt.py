@@ -181,3 +181,38 @@ def test_corrupt_stage_file_is_ignored(tmp_path):
     with open(path, "wb") as f:
         f.write(b"not an npz")
     assert ck.get_arrays("candidates") is None
+
+
+def test_gc_runs_only_on_clear_and_age_is_configurable(tmp_path,
+                                                       monkeypatch):
+    """Orphan GC (ADVICE r3): constructing a checkpoint must NOT reap
+    stale siblings (a suspended build requeued late keeps its stages);
+    GC runs from clear() — the single retire point — with an
+    env-configurable age, and <= 0 disables it."""
+    import time
+
+    root = str(tmp_path)
+    stale = os.path.join(root, "stalebuild")
+    os.makedirs(stale)
+    old = time.time() - 9 * 24 * 3600
+    os.utime(stale, (old, old))
+
+    # constructor leaves the stale sibling alone
+    ck = BuildCheckpoint(root, "a" * 40)
+    assert os.path.isdir(stale)
+
+    # GC disabled: clear() keeps it too
+    monkeypatch.setenv("SPTAG_TPU_BUILD_CKPT_GC_AGE_S", "0")
+    ck.put_bytes("tree", b"x")
+    ck.clear()
+    assert os.path.isdir(stale)
+
+    # configurable age: one hour -> the 9-day-old sibling is reaped,
+    # a fresh sibling survives
+    fresh = os.path.join(root, "freshbuild")
+    os.makedirs(fresh)
+    monkeypatch.setenv("SPTAG_TPU_BUILD_CKPT_GC_AGE_S", "3600")
+    ck2 = BuildCheckpoint(root, "b" * 40)
+    ck2.clear()
+    assert not os.path.isdir(stale)
+    assert os.path.isdir(fresh)

@@ -193,6 +193,14 @@ def test_flat_sketch_prefilter_mode():
     recall = np.mean([len(set(ids_s[i]) & set(ids_e[i])) / 10
                       for i in range(len(queries))])
     assert recall >= 0.9, recall
+    # explicit SketchRerank: the auto calibration scan is skipped
+    # (its result would never be read)
+    assert sk._sketch[3] is None
+    # flipping back to auto calibrates lazily on the SAME snapshot
+    sk.set_parameter("SketchRerank", "0")
+    sk.search_batch(queries[:4], 10)
+    assert sk._sketch[3] is not None
+    sk.set_parameter("SketchRerank", "512")
     # distances of agreeing ids are EXACT (shortlist is approximate, the
     # scoring is not)
     for i in range(8):
@@ -215,3 +223,50 @@ def test_flat_sketch_prefilter_mode():
     skc.build(data)
     _, idc = skc.search_batch(data[:8], 3)
     assert (idc[:, 0] == np.arange(8)).all()
+
+
+def test_flat_sketch_auto_shortlist_calibrates():
+    """The auto (SketchRerank=0) shortlist is calibrated per snapshot
+    (ADVICE r3: the old fixed N/32 heuristic measured recall@10 ~0.53 on
+    low-D uniform data).  Uniform corpora must calibrate a LARGE R and
+    keep recall vs the exact scan >= 0.95; clustered corpora calibrate a
+    far smaller R (the prefilter stays cheap where it works); and the
+    calibration tracks mutations (a fresh snapshot re-calibrates)."""
+    rng = np.random.default_rng(33)
+
+    # hostile case: uniform Gaussian, low D
+    data = rng.standard_normal((3000, 24)).astype(np.float32)
+    queries = rng.standard_normal((100, 24)).astype(np.float32)
+    exact = create_instance("FLAT", "Float")
+    exact.set_parameter("DistCalcMethod", "L2")
+    exact.build(data)
+    _, ids_e = exact.search_batch(queries, 10)
+    sk = create_instance("FLAT", "Float")
+    sk.set_parameter("DistCalcMethod", "L2")
+    sk.set_parameter("SketchPrefilter", "true")
+    sk.build(data)
+    _, ids_s = sk.search_batch(queries, 10)
+    recall = np.mean([len(set(ids_s[i]) & set(ids_e[i])) / 10
+                      for i in range(len(queries))])
+    assert recall >= 0.95, recall
+    cal_uniform = sk._sketch[3]
+    assert cal_uniform is not None and cal_uniform > 3000 // 32
+
+    # easy case: clustered — calibrated R stays small
+    centers = rng.standard_normal((64, 24)).astype(np.float32) * 6.0
+    cdata = (centers[rng.integers(0, 64, 3000)]
+             + 0.3 * rng.standard_normal((3000, 24)).astype(np.float32))
+    skc = create_instance("FLAT", "Float")
+    skc.set_parameter("DistCalcMethod", "L2")
+    skc.set_parameter("SketchPrefilter", "true")
+    skc.build(cdata)
+    skc.search_batch(cdata[:4], 5)
+    assert skc._sketch[3] < cal_uniform
+
+    # mutation invalidates the snapshot -> re-calibration happens
+    old = sk._sketch[3]
+    sk.add(rng.standard_normal((200, 24)).astype(np.float32))
+    sk.search_batch(queries[:4], 5)
+    assert sk._sketch is not None and sk._sketch[3] is not None
+    assert sk._sketch[0] is sk._device  # keyed to the fresh snapshot
+    del old

@@ -1,0 +1,188 @@
+"""Direction-B A/B harness: build with THIS framework, save in the
+reference folder format, and score the REFERENCE's own compiled searcher
+(tests/fixtures/indexsearcher, built from
+/root/reference/AnnService/src/IndexSearcher/main.cpp:66-228) over the
+saved index.  This is the round-3 continuation protocol
+(reports/AB_REFERENCE.md) as a repeatable script instead of an ad-hoc
+drive — used round 4 to validate the FinalRefineSearchMode guardrail
+(VERDICT item 10) and the exact int16 accumulation (VERDICT item 5).
+
+Prints one JSON line: {"recall": {maxcheck: recall}, ...}.
+
+Usage:
+  python tools/ab_direction_b.py --algo BKT --value-type Float \
+      --metric L2 --n 10000 --d 32 --nq 100 --k 10 --maxcheck 512#2048 \
+      [--set Name=Value ...]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def find_or_build_searcher() -> str:
+    """The compiled reference indexsearcher: reuse /tmp/refbin if a prior
+    session left it, else compile it from /root/reference (the fixtures
+    README g++ recipe — no Boost needed for the core+searcher sources)."""
+    cached = "/tmp/refbin/indexsearcher"
+    if os.path.exists(cached):
+        return cached
+    os.makedirs("/tmp/refbin", exist_ok=True)
+    r = "/root/reference/AnnService"
+    import glob
+
+    srcs = sum((glob.glob(os.path.join(r, p)) for p in (
+        "src/Core/*.cpp", "src/Core/Common/*.cpp", "src/Core/BKT/*.cpp",
+        "src/Core/KDT/*.cpp", "src/Helper/*.cpp",
+        "src/Helper/VectorSetReaders/*.cpp", "src/IndexSearcher/*.cpp")),
+        [])
+    subprocess.run(["g++", "-std=c++14", "-O3", "-march=native",
+                    "-fopenmp", "-DNDEBUG", f"-I{r}", "-o", cached]
+                   + srcs, check=True, timeout=900)
+    return cached
+
+
+def make_corpus(n, d, nq, seed, value_type, metric):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((64, d)).astype(np.float32) * 3.0
+    data = (centers[rng.integers(0, 64, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+    queries = (centers[rng.integers(0, 64, nq)]
+               + rng.standard_normal((nq, d)).astype(np.float32))
+    if value_type == "Float":
+        return data, queries
+    scale = {"Int8": 100.0, "UInt8": 40.0, "Int16": 3000.0}[value_type]
+    dt = {"Int8": np.int8, "UInt8": np.uint8, "Int16": np.int16}[value_type]
+    if value_type == "UInt8":
+        data, queries = data + 4.0, queries + 4.0     # shift into range
+    return ((data * scale / 8).astype(dt), (queries * scale / 8).astype(dt))
+
+
+def exact_truth(stored, queries, k, metric, base):
+    """Truth over the STORED rows under the reference's exact convention
+    (integer ``base^2 - dot`` for int cosine; squared L2 otherwise)."""
+    s = stored.astype(np.int64 if stored.dtype.kind in "iu" else np.float64)
+    q = queries.astype(s.dtype)
+    if metric == "Cosine":
+        sim = q @ s.T
+        idx = np.argpartition(-sim, k, axis=1)[:, :k]
+        row = np.take_along_axis(-sim, idx, axis=1)
+    else:
+        d = ((s ** 2).sum(1)[None, :].astype(np.float64)
+             - 2.0 * (q @ s.T).astype(np.float64)
+             + (q ** 2).sum(1)[:, None].astype(np.float64))
+        idx = np.argpartition(d, k, axis=1)[:, :k]
+        row = np.take_along_axis(d, idx, axis=1)
+    order = np.argsort(row, axis=1, kind="stable")
+    return np.take_along_axis(idx, order, axis=1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", default="BKT")
+    ap.add_argument("--value-type", default="Float")
+    ap.add_argument("--metric", default="L2")
+    ap.add_argument("--n", type=int, default=10000)
+    ap.add_argument("--d", type=int, default=32)
+    ap.add_argument("--nq", type=int, default=100)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--maxcheck", default="512#2048")
+    ap.add_argument("--set", action="append", default=[],
+                    help="extra Name=Value index parameters")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import sptag_tpu as sp
+
+    data, queries = make_corpus(args.n, args.d, args.nq, args.seed,
+                                args.value_type, args.metric)
+
+    index = sp.create_instance(args.algo, args.value_type)
+    index.set_parameter("DistCalcMethod", args.metric)
+    # the round-3 A/B knob set (reports/AB_REFERENCE.md direction-B
+    # protocol) so numbers stay comparable across rounds
+    tree_knob = "BKTNumber" if args.algo == "BKT" else "KDTNumber"
+    defaults = [(tree_knob, "1"), ("BKTKmeansK", "32"),
+                ("TPTNumber", "8"), ("NeighborhoodSize", "32"),
+                ("CEF", "256"), ("MaxCheckForRefineGraph", "512"),
+                ("RefineIterations", "2"), ("MaxCheck", "2048")]
+    if args.algo != "BKT":
+        defaults = [kv for kv in defaults if kv[0] != "BKTKmeansK"]
+    for name, value in defaults:
+        index.set_parameter(name, value)
+    for kv in args.set:
+        name, _, value = kv.partition("=")
+        if not index.set_parameter(name, value):
+            raise SystemExit(f"unknown parameter {name}")
+    index.build(data)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        folder = os.path.join(tmp, "idx")
+        index.save_index(folder)
+
+        # the reference normalizes queries itself for cosine; feed RAW
+        # values.  Truth is over the STORED rows (the save is the corpus
+        # the reference searches).
+        import sptag_tpu.io.format as fmt
+
+        with open(os.path.join(folder, "vectors.bin"), "rb") as f:
+            stored = fmt.read_matrix(f, data.dtype)
+        if args.metric == "Cosine":
+            from sptag_tpu.ops.distance import normalize
+            base = int(index.base)
+            qn = (normalize(queries, base) if base != 1
+                  else queries / np.maximum(
+                      np.linalg.norm(queries.astype(np.float64), axis=1,
+                                     keepdims=True), 1e-9))
+            truth = exact_truth(stored, qn, args.k, "Cosine", base)
+        else:
+            truth = exact_truth(stored, queries, args.k, "L2", 1)
+
+        qfile = os.path.join(tmp, "queries.tsv")
+        with open(qfile, "w") as f:
+            for i, row in enumerate(queries):
+                vals = "|".join(str(v) for v in row.tolist())
+                f.write(f"q{i}\t{vals}\n")
+        tfile = os.path.join(tmp, "truth.txt")
+        with open(tfile, "w") as f:
+            for row in truth:
+                f.write(" ".join(str(int(v)) for v in row) + "\n")
+
+        out = subprocess.run(
+            [find_or_build_searcher(), folder, f"Index.QueryFile={qfile}",
+             f"Index.TruthFile={tfile}", f"Index.K={args.k}",
+             f"Index.MaxCheck={args.maxcheck}",
+             f"Index.NumBatchQuerys={args.nq}"],
+            capture_output=True, text=True, timeout=600, cwd=tmp)
+
+    recalls = {}
+    for line in out.stdout.splitlines():
+        parts = line.split("\t")
+        if len(parts) >= 5 and parts[0].strip().isdigit():
+            try:
+                recalls[int(parts[0])] = float(parts[4])
+            except ValueError:
+                pass
+    print(json.dumps({
+        "algo": args.algo, "value_type": args.value_type,
+        "metric": args.metric, "n": args.n, "d": args.d,
+        "recall": recalls, "params": args.set,
+        "searcher_rc": out.returncode,
+        "stderr_tail": out.stderr.strip()[-200:],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Recording wire server for the CI byte-capture jobs.
+
+Accepts ONE client connection, answers the register handshake
+(connection id 7 — the id the committed fixture was generated with) and
+every SearchRequest with a minimal canned success body, while appending
+every byte the client SENDS to the capture file.  The CI job then diffs
+the capture against tests/fixtures/wrapper_lifecycle.bytes — the
+committed stream the Java/C# LifecycleDrive programs must produce —
+failing the build if either client's wire bytes drift.
+
+Usage: python wrappers/capture_server.py <port_file> <capture_file>
+"""
+
+import socket
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+
+from sptag_tpu.serve import wire  # noqa: E402
+
+CAPTURE_CONNECTION_ID = 7
+
+
+def main() -> int:
+    port_file, capture_file = sys.argv[1], sys.argv[2]
+    srv = socket.create_server(("127.0.0.1", 0))
+    with open(port_file, "w") as f:
+        f.write(str(srv.getsockname()[1]))
+    srv.settimeout(60)
+    conn, _ = srv.accept()
+    conn.settimeout(30)
+    captured = bytearray()
+
+    def read_exact(n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf += chunk
+        return buf
+
+    canned = wire.RemoteSearchResult(
+        wire.ResultStatus.Success,
+        [wire.IndexSearchResult("admin:ok:capture", [1], [0.0], None)],
+    ).pack()
+    try:
+        while True:
+            raw = read_exact(wire.HEADER_SIZE)
+            captured += raw
+            header = wire.PacketHeader.unpack(raw)
+            if header.body_length:
+                captured += read_exact(header.body_length)
+            if header.packet_type == wire.PacketType.RegisterRequest:
+                resp = wire.PacketHeader(
+                    wire.PacketType.RegisterResponse, 0, 0,
+                    CAPTURE_CONNECTION_ID, header.resource_id)
+                conn.sendall(resp.pack())
+            elif header.packet_type == wire.PacketType.SearchRequest:
+                resp = wire.PacketHeader(
+                    wire.PacketType.SearchResponse, 0, len(canned),
+                    CAPTURE_CONNECTION_ID, header.resource_id)
+                conn.sendall(resp.pack() + canned)
+    except (ConnectionError, socket.timeout):
+        pass
+    finally:
+        with open(capture_file, "wb") as f:
+            f.write(captured)
+        conn.close()
+        srv.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

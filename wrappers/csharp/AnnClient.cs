@@ -116,6 +116,88 @@ namespace SPTAG
             }
         }
 
+        // ------------------------------------------------ admin surface
+        // Round-4 extension: the reference's SWIG/CLR wrappers expose the
+        // full in-process AnnIndex Build/Add/Delete surface to .NET
+        // (Wrappers/inc/CLRCoreInterface.h:1-113); here the same
+        // lifecycle rides `$admin:` text-protocol lines over the wire.
+        // The server must opt in with `[Service] EnableRemoteAdmin=1`.
+        // A reply's first result row carries `admin:ok:<msg>` /
+        // `admin:error:<msg>` in IndexName and the affected-row count as
+        // Ids[0].
+
+        /// <summary>Build (or replace) index `name` from a row-major
+        /// block of raw little-endian values; params is
+        /// "Name=Val,Name=Val" or null.</summary>
+        public SearchResult BuildIndex(string name, string dataType,
+                                       int dimension, string? algo,
+                                       string? parameters, byte[] rawBlock)
+        {
+            var sb = new StringBuilder("$admin:build $indexname:")
+                .Append(name).Append(" $datatype:").Append(dataType)
+                .Append(" $dimension:").Append(dimension);
+            if (!string.IsNullOrEmpty(algo))
+            {
+                sb.Append(" $algo:").Append(algo);
+            }
+            if (!string.IsNullOrEmpty(parameters))
+            {
+                sb.Append(" $params:").Append(parameters);
+            }
+            sb.Append(" #").Append(Convert.ToBase64String(rawBlock));
+            return Search(sb.ToString());
+        }
+
+        /// <summary>Append rows; metadata (optional) is one byte[] per
+        /// row.</summary>
+        public SearchResult AddVectors(string name, byte[] rawBlock,
+                                       byte[][]? metadata)
+        {
+            var sb = new StringBuilder("$admin:add $indexname:")
+                .Append(name);
+            if (metadata != null)
+            {
+                using var joined = new MemoryStream();
+                for (int i = 0; i < metadata.Length; ++i)
+                {
+                    if (i > 0)
+                    {
+                        joined.WriteByte(0);           // \x00 separator
+                    }
+                    joined.Write(metadata[i], 0, metadata[i].Length);
+                }
+                sb.Append(" $metadata:").Append(
+                    Convert.ToBase64String(joined.ToArray()));
+            }
+            sb.Append(" #").Append(Convert.ToBase64String(rawBlock));
+            return Search(sb.ToString());
+        }
+
+        /// <summary>Delete-by-content: rows whose stored vector matches
+        /// exactly.</summary>
+        public SearchResult DeleteVectors(string name, byte[] rawBlock)
+        {
+            return Search("$admin:delete $indexname:" + name + " #"
+                          + Convert.ToBase64String(rawBlock));
+        }
+
+        /// <summary>Delete the row whose metadata equals `meta`
+        /// exactly.</summary>
+        public SearchResult DeleteByMetadata(string name, byte[] meta)
+        {
+            return Search("$admin:deletemeta $indexname:" + name
+                          + " $metadata:" + Convert.ToBase64String(meta));
+        }
+
+        /// <summary>float[] rows -> raw little-endian bytes for the
+        /// block params.</summary>
+        public static byte[] FloatsToBytes(float[] values)
+        {
+            var bytes = new byte[values.Length * 4];
+            Buffer.BlockCopy(values, 0, bytes, 0, bytes.Length);
+            return bytes;
+        }
+
         public void Dispose()
         {
             lock (_lock)

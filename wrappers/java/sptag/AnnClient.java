@@ -118,6 +118,86 @@ public final class AnnClient implements AutoCloseable {
         }
     }
 
+    // ---------------------------------------------------- admin surface
+    // Round-4 extension: the reference's SWIG wrappers expose the full
+    // in-process AnnIndex Build/Add/Delete surface to Java
+    // (Wrappers/inc/CoreInterface.h:14-65); here the same lifecycle rides
+    // `$admin:` text-protocol lines over the wire.  The server must opt
+    // in with `[Service] EnableRemoteAdmin=1`.  A reply's first result
+    // row carries `admin:ok:<msg>` / `admin:error:<msg>` in indexName
+    // and the affected-row count as ids[0].
+
+    /** Build (or replace) index `name` from a row-major block of raw
+     *  little-endian values; params is "Name=Val,Name=Val" or null. */
+    public SearchResult buildIndex(String name, String dataType,
+                                   int dimension, String algo,
+                                   String params, byte[] rawBlock)
+            throws IOException {
+        StringBuilder sb = new StringBuilder("$admin:build $indexname:")
+                .append(name).append(" $datatype:").append(dataType)
+                .append(" $dimension:").append(dimension);
+        if (algo != null) {
+            sb.append(" $algo:").append(algo);
+        }
+        if (params != null && !params.isEmpty()) {
+            sb.append(" $params:").append(params);
+        }
+        sb.append(" #").append(
+                java.util.Base64.getEncoder().encodeToString(rawBlock));
+        return search(sb.toString());
+    }
+
+    /** Append rows; metadata (optional) is one byte[] per row. */
+    public SearchResult addVectors(String name, byte[] rawBlock,
+                                   byte[][] metadata) throws IOException {
+        StringBuilder sb = new StringBuilder("$admin:add $indexname:")
+                .append(name);
+        if (metadata != null) {
+            int total = 0;
+            for (byte[] m : metadata) {
+                total += m.length + 1;
+            }
+            ByteBuffer joined = ByteBuffer.allocate(Math.max(total - 1, 0));
+            for (int i = 0; i < metadata.length; ++i) {
+                if (i > 0) {
+                    joined.put((byte) 0);              // \x00 separator
+                }
+                joined.put(metadata[i]);
+            }
+            sb.append(" $metadata:").append(
+                    java.util.Base64.getEncoder()
+                            .encodeToString(joined.array()));
+        }
+        sb.append(" #").append(
+                java.util.Base64.getEncoder().encodeToString(rawBlock));
+        return search(sb.toString());
+    }
+
+    /** Delete-by-content: rows whose stored vector matches exactly. */
+    public SearchResult deleteVectors(String name, byte[] rawBlock)
+            throws IOException {
+        return search("$admin:delete $indexname:" + name + " #"
+                + java.util.Base64.getEncoder().encodeToString(rawBlock));
+    }
+
+    /** Delete the row whose metadata equals `meta` exactly. */
+    public SearchResult deleteByMetadata(String name, byte[] meta)
+            throws IOException {
+        return search("$admin:deletemeta $indexname:" + name
+                + " $metadata:"
+                + java.util.Base64.getEncoder().encodeToString(meta));
+    }
+
+    /** float[] rows -> raw little-endian bytes for the block params. */
+    public static byte[] floatsToBytes(float[] values) {
+        ByteBuffer buf = ByteBuffer.allocate(values.length * 4)
+                .order(ByteOrder.LITTLE_ENDIAN);
+        for (float v : values) {
+            buf.putFloat(v);
+        }
+        return buf.array();
+    }
+
     @Override
     public synchronized void close() throws IOException {
         if (socket != null) {

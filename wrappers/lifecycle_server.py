@@ -1,0 +1,35 @@
+"""Admin-enabled server for the CI wrapper-lifecycle jobs.
+
+Starts a SearchServer with `EnableRemoteAdmin=1` on an ephemeral port,
+writes the port to the given file, and serves until killed.  The Java/C#
+LifecycleDrive programs run their build -> add -> search -> delete ->
+deletemeta script against it in `real` mode.
+
+Usage: python wrappers/lifecycle_server.py <port_file>
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+
+
+async def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sptag_tpu.serve.server import SearchServer
+    from sptag_tpu.serve.service import ServiceContext, ServiceSettings
+
+    ctx = ServiceContext(ServiceSettings(default_max_result=5,
+                                         enable_remote_admin=True))
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    host, port = await server.start("127.0.0.1", 0)
+    with open(sys.argv[1], "w") as f:
+        f.write(str(port))
+    print(f"lifecycle server on {host}:{port}", flush=True)
+    await asyncio.Event().wait()        # serve until killed
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
