@@ -147,12 +147,27 @@ class BKTIndex(VectorIndex):
         max_pivots = min(self._n, pivot_budget(self.params))
         return self._tree.collect_pivots(max_pivots)
 
+    # parameters whose value is BAKED into a materialized engine snapshot:
+    # changing one must invalidate the engine or the setting is a silent
+    # no-op until the next unrelated mutation
+    _ENGINE_PARAMS = frozenset({"beampackedneighbors", "beamscoredtype"})
+
+    def set_parameter(self, name: str, value: str) -> bool:
+        ok = super().set_parameter(name, value)
+        if ok and name.lower() in self._ENGINE_PARAMS:
+            with self._lock:
+                self._engine = None
+        return ok
+
     def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:
         return GraphSearchEngine(self._host[:self._n], graph,
                                  self._pivot_ids(), self._deleted[:self._n],
                                  self.dist_calc_method, self.base,
                                  score_dtype=getattr(
-                                     self.params, "beam_score_dtype", "auto"))
+                                     self.params, "beam_score_dtype", "auto"),
+                                 packed_neighbors=bool(int(getattr(
+                                     self.params, "beam_packed_neighbors",
+                                     0))))
 
     def _get_engine(self) -> GraphSearchEngine:
         if self._dirty or self._engine is None:

@@ -188,7 +188,8 @@ def _sorted_dup_mask(ids: jax.Array):
 def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                         pivot_mask, queries, k: int, L: int, B: int, T: int,
                         metric: int, base: int, nbp_limit: int,
-                        inject: int = 4, data_score=None):
+                        inject: int = 4, data_score=None, nbr_vecs=None,
+                        nbr_sq=None):
     """Shared-pivot seeding (BKT): one dense (Q, P) matmul scores the whole
     pivot set; the top-L pivots initialize every query's beam.  `pivot_mask`
     (W,) int32 is the precomputed packed bitset of the pivot ids.
@@ -226,7 +227,7 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
                  visited, k, L, B, T, metric, base, nbp_limit,
                  spare_ids=spare_ids, spare_d=spare_d, inject=inject,
-                 data_score=data_score)
+                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
 
 
 @functools.partial(
@@ -235,7 +236,8 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
 def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
                                queries, k: int, L: int, B: int, T: int,
                                metric: int, base: int, nbp_limit: int,
-                               data_score=None):
+                               data_score=None, nbr_vecs=None,
+                               nbr_sq=None):
     """Per-query seeding (KDT): `seed_ids` (Q, S) come from a host-side tree
     descent per query (the reference's KDTSearch leaf seeding,
     KDTree.h:178-215); they are gathered and scored as one batched
@@ -267,7 +269,7 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
 
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
                  visited, k, L, B, T, metric, base, nbp_limit,
-                 data_score=data_score)
+                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
 
 
 @functools.partial(
@@ -277,7 +279,8 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
 def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                          pivot_mask, queries3, k: int, L: int, B: int,
                          T: int, metric: int, base: int, nbp_limit: int,
-                         inject: int = 4, data_score=None):
+                         inject: int = 4, data_score=None, nbr_vecs=None,
+                         nbr_sq=None):
     """(M, chunk, D) query chunks under one `lax.map` — a single device
     program for any batch size (one upload, one dispatch, one read; the
     tunneled backend costs ~60 ms per host round trip).  The per-chunk
@@ -287,7 +290,8 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
         return _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids,
                                    pivot_vecs, pivot_mask, q, k, L, B, T,
                                    metric, base, nbp_limit, inject,
-                                   data_score=data_score)
+                                   data_score=data_score,
+                                   nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
     return jax.lax.map(body, queries3)
 
 
@@ -297,26 +301,32 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
 def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
                                 queries3, k: int, L: int, B: int, T: int,
                                 metric: int, base: int, nbp_limit: int,
-                                data_score=None):
+                                data_score=None, nbr_vecs=None,
+                                nbr_sq=None):
     def body(args):
         s, q = args
         return _beam_search_seeded_kernel(data, sqnorm, graph, deleted, s,
                                           q, k, L, B, T, metric, base,
                                           nbp_limit,
-                                          data_score=data_score)
+                                          data_score=data_score,
+                                          nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
     return jax.lax.map(body, (seeds3, queries3))
 
 
 def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
           k: int, L: int, B: int, T: int, metric: int, base: int,
           nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0,
-          data_score=None):
+          data_score=None, nbr_vecs=None, nbr_sq=None):
     """`data_score`: optional low-precision (bf16) shadow of `data` used for
     the in-loop candidate scoring — halves the dominant gather's HBM bytes
     and doubles the MXU rate on TPU.  The loop's distances only ORDER the
     beam; the final pool is re-ranked against the exact f32 rows before the
     top-k, so returned distances (and the included/excluded boundary at k)
-    are computed at full precision."""
+    are computed at full precision.
+
+    `nbr_vecs` (N, m, D) / `nbr_sq` (N, m): optional packed per-node
+    neighbor vectors (BeamPackedNeighbors) — the in-loop gather becomes B
+    block reads per query instead of B*m scattered row reads."""
     Q = queries.shape[0]
     N = data.shape[0]
     rerank = data_score is not None and data_score.dtype != data.dtype
@@ -401,9 +411,22 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
         visited = _mark_bits_sorted(visited, sorted_safe)
 
         # ---- score fresh candidates (one batched contraction) -------------
-        gather_idx = jnp.where(fresh, flat, 0)
-        cvecs = score_src[gather_idx]                            # (Q, C, D)
-        csq = sqnorm[gather_idx]
+        if nbr_vecs is not None:
+            # packed-neighbor layout (BeamPackedNeighbors): each popped
+            # node's m neighbor VECTORS live contiguously, so the gather
+            # is Q*B block reads of (m, D) instead of Q*B*m scattered
+            # rows — block-granular DMA, the same trick that won in the
+            # dense path, at m x corpus HBM.  Ordering matches `flat`
+            # (both derive from graph-row order); masked slots score
+            # garbage and are discarded by the `fresh` mask exactly like
+            # the row-gather path's index-0 placeholders.
+            sel_safe = jnp.maximum(sel_ids, 0)                   # (Q, B)
+            cvecs = nbr_vecs[sel_safe].reshape(Q, flat.shape[1], -1)
+            csq = nbr_sq[sel_safe].reshape(Q, flat.shape[1])
+        else:
+            gather_idx = jnp.where(fresh, flat, 0)
+            cvecs = score_src[gather_idx]                        # (Q, C, D)
+            csq = sqnorm[gather_idx]
         nd = dist_ops.batched_gathered_distance(
             queries_s, cvecs, DistCalcMethod(metric), base, csq)
         nd = jnp.where(fresh, nd, MAX_DIST)
@@ -486,7 +509,8 @@ class GraphSearchEngine:
     def __init__(self, data: np.ndarray, graph: np.ndarray,
                  pivot_ids: np.ndarray, deleted: Optional[np.ndarray],
                  metric: DistCalcMethod, base: int,
-                 score_dtype: str = "auto"):
+                 score_dtype: str = "auto",
+                 packed_neighbors: bool = False):
         n = data.shape[0]
         assert graph.shape[0] == n, (graph.shape, n)
         self.n = n
@@ -523,6 +547,20 @@ class GraphSearchEngine:
         np.bitwise_or.at(mask, pivot_ids >> 5,
                          np.uint32(1) << (pivot_ids.astype(np.uint32) & 31))
         self.pivot_mask = jnp.asarray(mask.view(np.int32))
+        # packed-neighbor layout (BeamPackedNeighbors): materialize each
+        # node's m neighbor VECTORS contiguously so the walk's in-loop
+        # gather is B block reads per query instead of B*m scattered rows
+        # — block-granular DMA at m x corpus HBM (bf16 shadow halves it).
+        # -1 graph slots point at row 0; the walk's `fresh` mask discards
+        # their scores exactly like the row-gather path's placeholders.
+        self.nbr_vecs = None
+        self.nbr_sq = None
+        if packed_neighbors:
+            src = (self.data_score if self.data_score is not None
+                   else self.data)
+            g = jnp.maximum(self.graph, 0)
+            self.nbr_vecs = src[g]
+            self.nbr_sq = self.sqnorm[g]
 
     def set_deleted(self, deleted: np.ndarray) -> None:
         """Swap only the tombstone mask — mutation path for delete-only
@@ -571,7 +609,8 @@ class GraphSearchEngine:
                     self.pivot_ids, self.pivot_vecs, self.pivot_mask,
                     jnp.asarray(q),
                     k_eff, L, B, T, int(self.metric), self.base, limit,
-                    inject=dynamic_pivots, data_score=self.data_score)
+                    inject=dynamic_pivots, data_score=self.data_score,
+                    nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
             else:
                 s = seeds.astype(np.int32, copy=False)
                 if q_pad != nq:
@@ -582,7 +621,8 @@ class GraphSearchEngine:
                     self.data, self.sqnorm, self.graph, self.deleted,
                     jnp.asarray(s), jnp.asarray(q),
                     k_eff, L, B, T, int(self.metric), self.base, limit,
-                    data_score=self.data_score)
+                    data_score=self.data_score,
+                    nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
             out_d[:, :k_eff] = np.asarray(d)[:nq]
             out_i[:, :k_eff] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -600,7 +640,8 @@ class GraphSearchEngine:
                 self.pivot_ids, self.pivot_vecs, self.pivot_mask,
                 jnp.asarray(q.reshape(m, chunk, D)),
                 k_eff, L, B, T, int(self.metric), self.base, limit,
-                inject=dynamic_pivots, data_score=self.data_score)
+                inject=dynamic_pivots, data_score=self.data_score,
+                nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
         else:
             s = seeds.astype(np.int32, copy=False)
             if m * chunk != nq:
@@ -612,7 +653,8 @@ class GraphSearchEngine:
                 jnp.asarray(s.reshape(m, chunk, -1)),
                 jnp.asarray(q.reshape(m, chunk, D)),
                 k_eff, L, B, T, int(self.metric), self.base, limit,
-                data_score=self.data_score)
+                data_score=self.data_score,
+                nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :k_eff] = d[:nq]

@@ -283,6 +283,9 @@ class ServingAdapter:
         # built with dense=True); "beam" the per-shard walk
         if mode not in ("beam", "dense"):
             raise ValueError(f"unknown serving mode: {mode!r}")
+        # $searchmode:auto crossover (same default as the single-chip
+        # AutoModeThreshold param)
+        self.auto_mode_threshold = 1024
         if mode == "dense":
             if not hasattr(sharded, "search_dense"):
                 raise ValueError("index type has no dense mode")
@@ -306,8 +309,26 @@ class ServingAdapter:
         extensions; the reference has no per-request knobs,
         serve/protocol.py docstring).  A `$searchmode:dense` request on an
         adapter whose index was not packed dense raises, surfaced as
-        FailedExecute by the service layer."""
+        FailedExecute by the service layer.  `auto` resolves by budget
+        like the single-chip index (beam below 1024, dense at or above),
+        falling back to the configured mode when the dense pack is
+        absent — a wire value the protocol accepts must never hard-fail
+        a query that the configured mode could serve."""
         mode = search_mode or self.mode
+        if mode == "auto":
+            mc = (max_check if max_check is not None
+                  else getattr(self._impl, "max_check", 2048))
+            want = ("dense" if mc >= self.auto_mode_threshold else "beam")
+            # only resolve to an engine this index can actually serve;
+            # otherwise degrade to the configured mode
+            if want == "dense" and not hasattr(self._impl, "dense_perm"):
+                want = self.mode
+            params = getattr(self._impl, "params", None)
+            has_graph = (int(getattr(params, "build_graph", 1))
+                         if params is not None else 1)
+            if want == "beam" and not has_graph:
+                want = self.mode
+            mode = want
         if mode not in ("beam", "dense"):     # same contract as the ctor
             raise ValueError(f"unknown serving mode: {mode!r}")
         if mode == "dense":
@@ -766,10 +787,14 @@ class ShardedBKTIndex:
                 self._guarded_cache[key] = max_check
                 return max_check
             _, ids_m = search_at(sample, mc)
-            overlap = float(np.mean([
-                len(set(ids_m[i]) & set(ids_full[i])) / max(1, k)
-                for i in range(len(sample))]))
-            if overlap >= self.budget_guard_overlap:
+            # -1 sentinels (padding / tombstoned slots) must not count as
+            # agreement — overlap is over the REAL full-budget ids only
+            overlaps = []
+            for i in range(len(sample)):
+                full = set(int(v) for v in ids_full[i] if v >= 0)
+                got = set(int(v) for v in ids_m[i] if v >= 0)
+                overlaps.append(len(got & full) / max(1, len(full)))
+            if float(np.mean(overlaps)) >= self.budget_guard_overlap:
                 self._guarded_cache[key] = mc
                 return mc
             mult *= 2

@@ -469,6 +469,13 @@ def test_server_over_sharded_mesh_index():
         res_m = client.search(f"$resultnum:3 $extractmetadata:true #{qb}")
         assert res_m.status == wire.ResultStatus.Success
         assert res_m.results[0].metas[0] == b"row007"
+        # a wire value the protocol accepts must never hard-fail a query
+        # the configured mode can serve: $searchmode:auto on a mesh
+        # adapter resolves by budget (and degrades to the configured mode
+        # when the preferred engine is absent — no dense pack here)
+        res_a = client.search(f"$resultnum:3 $searchmode:auto #{qb}")
+        assert res_a.status == wire.ResultStatus.Success
+        assert res_a.results[0].ids[0] == 7
         client.close()
     finally:
         t.stop()

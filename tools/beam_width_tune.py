@@ -69,30 +69,37 @@ def main():
         f"Corpus n={n}, d=128, f32/L2; 2048 queries; recall@{k} vs exact "
         f"truth; platform={dev}; index cached={cached}.",
         "",
-        "| MaxCheck | BeamWidth | T iters | recall@10 | QPS |",
-        "|---|---|---|---|---|",
+        "| MaxCheck | BeamWidth | packed | T iters | recall@10 | QPS |",
+        "|---|---|---|---|---|---|",
     ]
     from sptag_tpu.algo.engine import beam_pool_size, beam_width_for
+    packed_arms = ((0, 1) if os.environ.get("BW_TUNE_PACKED", "1") == "1"
+                   else (0,))
     for max_check in checks:
         index.set_parameter("MaxCheck", str(max_check))
-        for bw in widths:
-            # bw=0 row = the auto ladder (beam_width_for's choice)
-            index.set_parameter("BeamWidth", str(bw if bw else 16))
-            L = beam_pool_size(k, max_check, n)
-            eff_b = beam_width_for(bw if bw else 16, max_check, L)
-            t_iters = -(-max_check // eff_b)
-            index.search_batch(queries, k)             # compile + warm
-            best = float("inf")
-            ids = None
-            for _ in range(3):
-                t0 = time.perf_counter()
-                _, ids = index.search_batch(queries, k)
-                best = min(best, time.perf_counter() - t0)
-            recall = recall_at_k(ids[:, :k], truth, k)
-            lines.append(
-                f"| {max_check} | {'auto' if not bw else bw} ({eff_b}) | "
-                f"{t_iters} | {recall:.4f} | {len(queries) / best:,.0f} |")
-            print(lines[-1], flush=True)
+        for packed in packed_arms:
+            # BeamPackedNeighbors (round 4): block-granular neighbor
+            # gather; set_parameter invalidates the materialized engine
+            index.set_parameter("BeamPackedNeighbors", str(packed))
+            for bw in widths:
+                # bw=0 row = the auto ladder (beam_width_for's choice)
+                index.set_parameter("BeamWidth", str(bw if bw else 16))
+                L = beam_pool_size(k, max_check, n)
+                eff_b = beam_width_for(bw if bw else 16, max_check, L)
+                t_iters = -(-max_check // eff_b)
+                index.search_batch(queries, k)         # compile + warm
+                best = float("inf")
+                ids = None
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    _, ids = index.search_batch(queries, k)
+                    best = min(best, time.perf_counter() - t0)
+                recall = recall_at_k(ids[:, :k], truth, k)
+                lines.append(
+                    f"| {max_check} | {'auto' if not bw else bw} "
+                    f"({eff_b}) | {packed} | {t_iters} | {recall:.4f} | "
+                    f"{len(queries) / best:,.0f} |")
+                print(lines[-1], flush=True)
     with open(out_path, "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"wrote {out_path}")
