@@ -207,7 +207,8 @@ class VectorIndex(abc.ABC):
 
     def build(self, vectors, metadata: Optional[MetadataSet] = None,
               with_meta_index: bool = False,
-              checkpoint_dir: Optional[str] = None) -> ErrorCode:
+              checkpoint_dir: Optional[str] = None,
+              keep_checkpoint: bool = False) -> ErrorCode:
         """Parity: VectorIndex::BuildIndex (reference VectorIndex.cpp:192-208).
 
         `checkpoint_dir` (or env SPTAG_TPU_BUILD_CKPT) enables RESUMABLE
@@ -239,10 +240,17 @@ class VectorIndex(abc.ABC):
             # flag + checkpoint cleanup stay INSIDE the lock: with two
             # concurrent build() calls, doing these after release let one
             # build's clear() interleave with the other's stage writes
-            # (ADVICE r3)
+            # (ADVICE r3).  `keep_checkpoint=True` defers the clear to the
+            # caller — a MULTI-shard build must keep every finished
+            # shard's stages until ALL shards succeed, or a death in
+            # shard s forces shards [0, s) to rebuild from scratch on
+            # resume; the caller clears via the handle stashed on
+            # `last_checkpoint`.
             self.build_resumed = ck is not None and ck.resumed
-            if ck is not None:
+            self.last_checkpoint = ck
+            if ck is not None and not keep_checkpoint:
                 ck.clear()
+                self.last_checkpoint = None
         return ErrorCode.Success
 
     def build_meta_mapping(self) -> None:

@@ -536,8 +536,19 @@ class ShardedBKTIndex:
                               DistCalcMethod.Cosine else "L2")
             for name, value in (params or {}).items():
                 sub.set_parameter(name, str(value))
-            sub.build(block)
+            # keep_checkpoint: a finished shard's stages must survive
+            # until EVERY shard is done — clearing per shard would force
+            # a death in shard s to rebuild shards [0, s) from scratch
+            # on resume (the whole point of a resumable MULTI-shard
+            # build is that only the interrupted shard re-runs)
+            sub.build(block, keep_checkpoint=True)
             shard_indexes.append(sub)
+        # all shards succeeded: retire every shard's checkpoint now
+        for sub in shard_indexes:
+            ck = getattr(sub, "last_checkpoint", None)
+            if ck is not None:
+                ck.clear()
+                sub.last_checkpoint = None
         if save_to is not None:
             import json
 
@@ -576,6 +587,11 @@ class ShardedBKTIndex:
         self = cls._assemble(shard_indexes, n, int(data.shape[1]), metric,
                              mesh, empty_shards, dense)
         self.metadata = metadata
+        # truthy when ANY shard resumed from build checkpoints — the
+        # accurate signal for resume drives (a non-empty checkpoint dir
+        # alone can be stale state from a different config)
+        self.build_resumed = any(getattr(sub, "build_resumed", False)
+                                 for sub in shard_indexes)
         return self
 
     @classmethod

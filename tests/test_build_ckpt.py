@@ -216,3 +216,38 @@ def test_gc_runs_only_on_clear_and_age_is_configurable(tmp_path,
     ck2.clear()
     assert not os.path.isdir(stale)
     assert os.path.isdir(fresh)
+
+
+def test_sharded_build_keeps_checkpoints_until_all_shards_done(
+        tmp_path, monkeypatch):
+    """Multi-shard resume (round 4): a finished shard's checkpoint must
+    survive until EVERY shard succeeds — per-shard clear-on-success made
+    a death in shard s rebuild shards [0, s) from scratch.  Pin:
+    keep_checkpoint=True defers the clear to the caller, the sharded
+    build retires all checkpoints only at the end, and build_resumed
+    aggregates the per-shard signals."""
+    from sptag_tpu.core.types import DistCalcMethod
+    from sptag_tpu.parallel.sharded import ShardedBKTIndex, make_mesh
+
+    monkeypatch.setenv("SPTAG_TPU_BUILD_CKPT", str(tmp_path))
+    data = _mk_data(n=400, d=16, seed=9)
+    params = {"BKTNumber": 1, "BKTKmeansK": 8, "TPTNumber": 2,
+              "TPTLeafSize": 64, "NeighborhoodSize": 8, "CEF": 24,
+              "MaxCheckForRefineGraph": 64, "RefineIterations": 1,
+              "MaxCheck": 128}
+
+    # single-index keep_checkpoint contract
+    idx = _mk_index()
+    assert idx.build(data, keep_checkpoint=True) == sp.ErrorCode.Success
+    ck = idx.last_checkpoint
+    assert ck is not None and os.path.isdir(ck.folder)
+    ck.clear()
+
+    # sharded build: end state has NO leftover checkpoints (all retired
+    # after success) and build_resumed False on a cold build
+    index = ShardedBKTIndex.build(data, DistCalcMethod.L2,
+                                  mesh=make_mesh(), params=params)
+    assert index.build_resumed is False
+    leftovers = [d for d in os.listdir(tmp_path)
+                 if os.path.isdir(os.path.join(tmp_path, d))]
+    assert leftovers == [], leftovers
