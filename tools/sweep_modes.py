@@ -84,7 +84,9 @@ def main():
     ]
     for max_check in (512, 1024, 2048, 4096, 8192):
         index.set_parameter("MaxCheck", str(max_check))
-        for mode in ("beam", "dense"):
+        # "auto" (VERDICT r3 item 4): per-request crossover — the row must
+        # never be worse than the WORSE of beam/dense at the same budget
+        for mode in ("beam", "dense", "auto"):
             index.set_parameter("SearchMode", mode)
             index.search_batch(queries[:batch], k)      # compile/warm
             times = []
