@@ -102,7 +102,13 @@ def main():
     params = {
         "BKTNumber": 1, "BKTKmeansK": 32, "TPTNumber": 4,
         "TPTLeafSize": 1000, "NeighborhoodSize": 32, "CEF": 64,
-        "MaxCheckForRefineGraph": 256, "RefineIterations": 1,
+        # SCALE10M_REFINE=0 selects the candidates-only graph (TPT
+        # all-pairs + RNG prune + connectivity repair, no re-search
+        # passes) — the wall-time-bounded configuration for the 10M CPU
+        # proof; 1 (default) adds one grouped dense refine pass (the
+        # 500k kill/resume drive's quality point)
+        "MaxCheckForRefineGraph": 256,
+        "RefineIterations": int(os.environ.get("SCALE10M_REFINE", "1")),
         "MaxCheck": 2048, "RefineQueryGroup": 32,
         "RefineSearchMode": "dense", "FinalRefineSearchMode": "same",
         "BuildGraph": 1,
