@@ -138,11 +138,15 @@ def beam_width_for(beam_width: int, max_check: int, L: int) -> int:
     """Budget-scaled beam width, shared by the single-chip and sharded
     walks.  At high budgets wider pops cut the SERIAL iteration count
     T = ceil(max_check/B) — the walk's real cost on TPU (roofline shows it
-    overhead-bound at ~3 GB/s, not bandwidth-bound) — with measured-flat
-    recall (B 16 -> 64 at MaxCheck 2048 on the 200k corpus: 0.8977 ->
-    0.8992).  `beam_width` is a FLOOR, never reduced: an explicitly tuned
-    BeamWidth above the auto cap of 64 is honored as-is."""
-    return max(1, min(max(beam_width, min(max_check // 64, 64)), L))
+    overhead-bound at ~3 GB/s, not bandwidth-bound) — with measured
+    recall-safe width: B 16 -> 64 at MaxCheck 2048 was flat (0.8977 ->
+    0.8992, round 3) and the round-4 ladder measured recall RISING to
+    B=256 (200k corpus, MaxCheck 2048: 0.9267 @ B32 -> 0.9285 @ B128 ->
+    0.9339 @ B256), so the auto scale is max_check/32 capped at 128
+    (2048 -> 64 pops/iter, 8192 -> 128).  `beam_width` is a FLOOR, never
+    reduced: an explicitly tuned BeamWidth above the cap (e.g. 256) is
+    honored as-is."""
+    return max(1, min(max(beam_width, min(max_check // 32, 128)), L))
 
 
 def beam_pool_size(k: int, max_check: int, n: int,

@@ -189,9 +189,10 @@ def test_bkt_int8_beam_mode_recall():
 
 def test_beam_width_budget_scaling():
     """B widens with MaxCheck (fewer serial device iterations at high
-    budgets, measured recall-neutral): the floor is the caller's
-    BeamWidth (NEVER reduced, even above the auto cap of 64), the
-    auto-scaled part caps at 64, and L bounds everything."""
+    budgets; the round-4 ladder measured recall RISING to B=256): the
+    floor is the caller's BeamWidth (NEVER reduced, even above the auto
+    cap of 128), the auto-scaled part is MaxCheck/32 capped at 128, and
+    L bounds everything."""
     from sptag_tpu.algo.engine import beam_pool_size, beam_width_for
 
     def beff(beam_width, max_check, n=100_000, k=10):
@@ -199,10 +200,10 @@ def test_beam_width_budget_scaling():
                               beam_pool_size(k, max_check, n))
 
     assert beff(16, 512) == 16          # floor holds at small budgets
-    assert beff(16, 2048) == 32
-    assert beff(16, 8192) == 64         # auto part capped
+    assert beff(16, 2048) == 64
+    assert beff(16, 8192) == 128        # auto part capped
     assert beff(48, 1024) == 48         # explicit floor wins
-    assert beff(128, 2048) == 128       # explicit width above cap honored
+    assert beff(256, 2048) == 256       # explicit width above cap honored
 
 
 def test_grouped_refine_matches_ungrouped():

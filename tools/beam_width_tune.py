@@ -3,8 +3,9 @@ beam/dense throughput gap further?
 
 The walk is overhead-bound, not bandwidth-bound (algo/engine.py module
 docstring): its cost is the SERIAL iteration count T = ceil(MaxCheck/B)
-times a fixed per-iteration cost.  `beam_width_for` auto-scales B up to a
-cap of 64 (measured recall-flat 16 -> 64 on the 200k corpus).  This tool
+times a fixed per-iteration cost.  `beam_width_for` auto-scales B as
+MaxCheck/32 capped at 128 (round 4 — the ladder measured recall RISING
+to B=256 on the 200k corpus, so the cap moved up from 64).  This tool
 sweeps EXPLICIT BeamWidth values past the cap — an explicit value is a
 floor the engine honors as-is — to measure where recall starts paying for
 the extra width.  Counterpart knob in the reference: one node per pop,
