@@ -341,10 +341,15 @@ def timed_sweep(index, queries, k, batch, budget_s, repeats=3):
     dt = time.perf_counter() - t0
     # effective query-group of the THROUGHPUT run, before the smaller
     # latency batches overwrite it (the adaptive cap can demote grouping
-    # at latency batch sizes)
+    # at latency batch sizes).  Read the EXISTING snapshot only:
+    # _get_dense() here would materialize the dense snapshot during BEAM
+    # sweeps — which is how round 4's kdt_dense row silently measured
+    # replicas=1 (the snapshot pre-dated the DenseReplicas=2 set and the
+    # set no-opped pre-invalidation-fix; VERDICT r4 item 3)
     try:
-        index.last_group_effective = \
-            index._get_dense().last_effective_group
+        dense = getattr(index, "_dense", None)
+        index.last_group_effective = (dense.last_effective_group
+                                      if dense is not None else None)
     except Exception:                                   # noqa: BLE001
         index.last_group_effective = None
     # per-batch latency: individually synced calls, as many as the budget

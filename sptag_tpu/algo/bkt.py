@@ -151,12 +151,19 @@ class BKTIndex(VectorIndex):
     # changing one must invalidate the engine or the setting is a silent
     # no-op until the next unrelated mutation
     _ENGINE_PARAMS = frozenset({"beampackedneighbors", "beamscoredtype"})
+    # baked into the materialized DENSE snapshot (replication layout and
+    # cluster partition); DenseQueryGroup/DenseUnionFactor are read live
+    # at each search and need no invalidation
+    _DENSE_PARAMS = frozenset({"densereplicas", "denseclustersize"})
 
     def set_parameter(self, name: str, value: str) -> bool:
         ok = super().set_parameter(name, value)
         if ok and name.lower() in self._ENGINE_PARAMS:
             with self._lock:
                 self._engine = None
+        if ok and name.lower() in self._DENSE_PARAMS:
+            with self._lock:
+                self._dense = None
         return ok
 
     def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:

@@ -317,16 +317,20 @@ class FlatIndex(VectorIndex):
         index lock — a mutation-heavy workload must not stall every
         concurrent search behind it — and the result is stored only if
         the snapshot it was derived from is still current (a concurrent
-        mutation simply triggers a fresh calibration next search)."""
+        mutation simply triggers a fresh calibration next search).
+        A FAILED calibration (<8 live rows, kernel error) is cached as a
+        -1 sentinel so it is attempted at most once per snapshot — the
+        consumer's cal_r<=0 test falls back to the N/32 heuristic without
+        re-paying the exact scan on every search (ADVICE r4)."""
         device, packed, mean, cal_r = self._sketch_snapshot()
         if cal_r is not None:
             return device, packed, mean, cal_r
         data_d, sqnorm_d, invalid_d = device
         cal_r = self._calibrate(data_d, sqnorm_d, invalid_d, packed, mean)
         with self._lock:
-            if self._sketch is not None and self._sketch[0] is device \
-                    and cal_r is not None:
-                self._sketch = (device, packed, mean, cal_r)
+            if self._sketch is not None and self._sketch[0] is device:
+                self._sketch = (device, packed, mean,
+                                cal_r if cal_r is not None else -1)
         return device, packed, mean, cal_r
 
     # ---- search -----------------------------------------------------------
@@ -368,7 +372,8 @@ class FlatIndex(VectorIndex):
             # calibration EXCEEDS the cap gets the cap and the documented
             # advice is an explicit SketchRerank (or no prefilter)
             auto = max(128, 16 * k_eff,
-                       cal_r if cal_r else data_d.shape[0] // 32)
+                       cal_r if (cal_r and cal_r > 0)
+                       else data_d.shape[0] // 32)
             R = explicit_r or min(auto, 8192)
             R = min(max(R, k_eff), data_d.shape[0])
             dists, ids = _flat_sketch_kernel(

@@ -110,9 +110,12 @@ def _int16_dot_parts(q, x, contract):
 
 
 def _int16_parts_f32(hh, mixed, ll) -> jax.Array:
-    """Float32 combine: each partial is exact, so the only rounding is
-    this one weighted sum (vs one rounding PER PRODUCT in the plain f32
-    path)."""
+    """Float32 combine: each partial is exact IN INT32; the int32->f32
+    conversion of a partial itself rounds once |partial| > 2^24 (the
+    ll term exceeds that for D >~ 258), so this path carries one
+    rounding per partial conversion plus the weighted sum — still far
+    tighter than one rounding PER PRODUCT in the plain f32 path, but
+    not exact (ADVICE r4).  Exactness needs the i32 combine below."""
     return (65536.0 * hh.astype(jnp.float32)
             + 256.0 * mixed.astype(jnp.float32)
             + ll.astype(jnp.float32))
@@ -145,10 +148,11 @@ def pairwise_dot(q: jax.Array, x: jax.Array) -> jax.Array:
     in float32 on the MXU.
 
     int16 defaults to the exact high/low split (module docstring): three
-    int32-exact contractions + one f32 rounding, strictly tighter than
-    both plain-f32 accumulation AND the reference's pair-exact
-    `_mm_madd_epi16` + f32 horizontal add.  Falls back to plain f32 when
-    disabled or beyond _INT16_EXACT_MAX_D dims.
+    int32-exact contractions, then one f32 rounding per partial
+    conversion plus the weighted sum (see _int16_parts_f32) — strictly
+    tighter than both plain-f32 accumulation AND the reference's
+    pair-exact `_mm_madd_epi16` + f32 horizontal add.  Falls back to
+    plain f32 when disabled or beyond _INT16_EXACT_MAX_D dims.
     """
     dn = (((1,), (1,)), ((), ()))
     if exact_int_dot(q.dtype):
@@ -207,10 +211,12 @@ def pairwise_cosine(q: jax.Array, x: jax.Array, base: int) -> jax.Array:
     ``base^2 - dot`` with base=1 for float.
 
     int16 computes ``base^2 - dot`` ENTIRELY in int32 (exact): rows are
-    normalized to length base=32767 so |dot| <= base^2 < 2^31, the
-    wraparound combine is exact, and the small final difference converts
-    to float32 losslessly — the f32-cancellation near base^2 that plagued
-    the old path never happens."""
+    normalized to length base=32767 so |dot| <= base^2 < 2^31 and the
+    wraparound combine is exact.  The one rounding left is the FINAL
+    int32->float32 conversion (the difference can reach 2*base^2 ~ 2^31,
+    beyond f32's 2^24 exact-integer range — ADVICE r4), which costs at
+    most 128 ulp-of-int on the largest distances; the f32-cancellation
+    near base^2 that plagued the old path never happens."""
     if _use_int16_exact(q.dtype, q.shape[-1]):
         dn = (((1,), (1,)), ((), ()))
 

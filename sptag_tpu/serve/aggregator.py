@@ -43,7 +43,9 @@ log = logging.getLogger(__name__)
 RECONNECT_INTERVAL_S = 30.0
 
 
-def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
+def merge_top_k(per_server: List[List[wire.IndexSearchResult]],
+                rel_tol: float = 1e-5,
+                replica_groups: Optional[List[Optional[str]]] = None
                 ) -> List[wire.IndexSearchResult]:
     """Re-rank flat-gathered per-server lists into one globally sorted
     top-K list per index name (framework extension; the reference returns
@@ -54,19 +56,39 @@ def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
     that name.  Vector ids are shard-LOCAL, so two servers' equal ids may
     be different vectors: entry identity is always (server, id), and
     metadata is used ONLY to collapse replicas — same metadata bytes AND
-    a distance within a small relative tolerance (bit-equality would be
+    a distance within `rel_tol` relative tolerance (bit-equality would be
     the same kernel on the same padding; heterogeneous backends — a
     reference C++ server next to this one, or differently padded shards
     with different XLA reduction orders — score the same vector with a
-    few-ULP spread).  Two distinct vectors that merely share a
-    non-unique metadata label differ by far more than the tolerance and
-    are both returned (ADVICE r3: keying on raw metadata alone conflated
-    them).  Ties break on distance then id for determinism."""
-    rel_tol = 1e-5
+    few-ULP spread).  `rel_tol=0` demands bit-equality.
+
+    CAVEAT (ADVICE r4): with integer-valued distance conventions (int8/
+    int16 corpora score integer L2/cosine), two DISTINCT vectors sharing
+    a non-unique metadata label can tie at exactly the same distance and
+    would be conflated by the tolerance test alone.  `replica_groups`
+    (one group label per server, None = not a replica of anything)
+    restricts the collapse to servers DECLARED as replicas of each other:
+    when given, entries collapse only if their servers carry the same
+    non-None group label.  Shard topologies (every server a distinct
+    corpus slice) should declare no groups — exact integer ties then
+    survive the merge.  Ties break on distance then id for determinism."""
     groups: dict = {}
     for srv_i, results in enumerate(per_server):
         for r in results:
             groups.setdefault(r.index_name, []).append((srv_i, r))
+
+    def _collapsible(a: int, b: int) -> bool:
+        if a == b:
+            # one server never returns the same vector twice, so two
+            # entries from the same reply are ALWAYS distinct vectors —
+            # a within-reply metadata+distance tie must never collapse
+            return False
+        if replica_groups is None:
+            return True            # legacy: any cross-server pair may
+        ga = replica_groups[a] if a < len(replica_groups) else None
+        gb = replica_groups[b] if b < len(replica_groups) else None
+        return ga is not None and ga == gb
+
     out: List[wire.IndexSearchResult] = []
     for name, rs in groups.items():
         k = max(sum(1 for v in r.ids if v >= 0) for _, r in rs)
@@ -79,15 +101,16 @@ def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
                 if vid >= 0:
                     entries.append((float(dist), int(vid), meta, srv_i))
         entries.sort(key=lambda e: (e[0], e[1]))
-        kept_dists: dict = {}        # meta -> distances already kept
+        kept_dists: dict = {}   # meta -> (distance, server) already kept
         best = []
         for dist, vid, meta, srv_i in entries:
             if has_meta and meta:
                 prior = kept_dists.setdefault(meta, [])
                 tol = rel_tol * max(abs(dist), 1.0)
-                if any(abs(dist - d0) <= tol for d0 in prior):
+                if any(abs(dist - d0) <= tol and _collapsible(srv_i, s0)
+                       for d0, s0 in prior):
                     continue                  # replica of a kept entry
-                prior.append(dist)
+                prior.append((dist, srv_i))
             best.append((dist, vid, meta))
             if len(best) == k:
                 break
@@ -101,6 +124,9 @@ def merge_top_k(per_server: List[List[wire.IndexSearchResult]]
 class RemoteServer:
     address: str
     port: int
+    # MergeTopK collapse scope: servers sharing a non-None ReplicaGroup
+    # label are declared replicas of one another (see merge_top_k)
+    replica_group: Optional[str] = None
     reader: Optional[asyncio.StreamReader] = None
     writer: Optional[asyncio.StreamWriter] = None
     # in-flight requests keyed by resource_id — the asyncio analog of the
@@ -142,11 +168,13 @@ class AggregatorContext:
     def __init__(self, listen_addr: str = "0.0.0.0",
                  listen_port: int = 8100,
                  search_timeout_s: float = 9.0,
-                 merge_top_k: bool = False):
+                 merge_top_k: bool = False,
+                 merge_rel_tol: float = 1e-5):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
         self.merge_top_k = merge_top_k
+        self.merge_rel_tol = merge_rel_tol
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -162,6 +190,8 @@ class AggregatorContext:
             merge_top_k=reader.get_parameter(
                 "Service", "MergeTopK", "false").lower() in
             ("true", "1", "yes"),
+            merge_rel_tol=float(reader.get_parameter(
+                "Service", "MergeRelTol", "1e-5")),
         )
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
@@ -169,7 +199,9 @@ class AggregatorContext:
             addr = reader.get_parameter(section, "Address", "")
             port = reader.get_parameter(section, "Port", "")
             if addr and port:
-                ctx.servers.append(RemoteServer(addr, int(port)))
+                group = reader.get_parameter(section, "ReplicaGroup", "")
+                ctx.servers.append(RemoteServer(
+                    addr, int(port), replica_group=group or None))
         return ctx
 
 
@@ -305,7 +337,19 @@ class AggregatorService:
                 merged.status = status
             merged.results.extend(results)
         if self.context.merge_top_k:
-            merged.results = merge_top_k([r for _, r in replies])
+            # declared-topology mode keys off the CONFIGURED servers, not
+            # the connected subset: if any server declares a ReplicaGroup
+            # the operator chose group-restricted collapse, and a group
+            # member being temporarily disconnected must not revert the
+            # merge to legacy collapse-anything semantics.  Labels are
+            # aligned with reply order (= targets order).
+            declared = any(s.replica_group is not None
+                           for s in self.context.servers)
+            merged.results = merge_top_k(
+                [r for _, r in replies],
+                rel_tol=self.context.merge_rel_tol,
+                replica_groups=([s.replica_group for _, s in targets]
+                                if declared else None))
         return merged
 
     async def _query_one(self, idx: int, server: RemoteServer, body: bytes):

@@ -64,6 +64,13 @@ class ServiceSettings:
     # the wire via `$admin:<op>` query lines.  Off by default — index
     # mutation from the network is an operator decision
     enable_remote_admin: bool = False
+    # DoS ceiling for $admin:build/add payloads (rows per request), the
+    # admin analog of max_check_limit: a build runs synchronously in the
+    # request path, so one oversized block would block all serving for
+    # its whole duration (ADVICE r4).  Raise it for trusted deployments
+    # via [Service] AdminMaxRows.
+    admin_max_rows: int = 1_000_000
+    admin_max_dim: int = 4096
 
 
 class ServiceContext:
@@ -98,6 +105,10 @@ class ServiceContext:
             enable_remote_admin=reader.get_parameter(
                 "Service", "EnableRemoteAdmin", "0").lower() in
             ("1", "true", "on", "yes"),
+            admin_max_rows=int(reader.get_parameter(
+                "Service", "AdminMaxRows", "1000000")),
+            admin_max_dim=int(reader.get_parameter(
+                "Service", "AdminMaxDim", "4096")),
         )
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
@@ -146,6 +157,36 @@ class SearchExecutor:
                 f"admin:{'ok' if ok else 'error'}:{message}",
                 [int(count)], [0.0], None)])
 
+    def _extract_capped(self, parsed: ParsedQuery, value_type,
+                        dim: int):
+        """Shared build/add/delete payload path: pre-decode cap gate,
+        extract, exact post-decode cap check.  Returns (rows, None) on
+        success or (None, error_reply).
+
+        The base64 length upper-bounds the decoded byte count, so an
+        oversized b64 block is rejected at O(1) BEFORE extract_vector
+        materializes the array (the cap must bound the allocation, not
+        just the build).  Text payloads skip the pre-gate — element
+        widths vary too much for a tight length bound (a 2-chars-per-
+        element estimate falsely rejected legal payloads) and the text
+        is already resident in memory; the exact post-decode check
+        bounds the work that matters."""
+        from sptag_tpu.core.types import dtype_of
+
+        cap = self.context.settings.admin_max_rows
+        if dim > 0 and parsed.vector_base64 is not None:
+            est_bytes = (len(parsed.vector_base64) * 3) // 4
+            itemsize = dtype_of(value_type).itemsize
+            if est_bytes // max(1, itemsize * dim) > cap:
+                return None, self._admin_reply(False, "rows-over-limit")
+        rows = parsed.extract_vector(
+            value_type, self.context.settings.vector_separator)
+        if rows is None or dim <= 0 or rows.size % dim:
+            return None, self._admin_reply(False, "bad-vector-block")
+        if rows.size // dim > cap:
+            return None, self._admin_reply(False, "rows-over-limit")
+        return rows.reshape(-1, dim), None
+
     def _execute_admin(self, parsed: ParsedQuery) -> RemoteSearchResult:
         """`$admin:<op>` — the reference's in-process AnnIndex
         Build/Add/Delete surface (Wrappers/inc/CoreInterface.h:14-65),
@@ -159,7 +200,13 @@ class SearchExecutor:
         * `$admin:delete $indexname:n #<b64 rows>` (delete-by-content)
         * `$admin:deletemeta $indexname:n $metadata:<b64>`
 
-        Gated by `[Service] EnableRemoteAdmin` (default off)."""
+        Gated by `[Service] EnableRemoteAdmin` (default off).  Build/add
+        payloads are capped at AdminMaxRows x AdminMaxDim (builds run
+        synchronously in the request path — an uncapped block would
+        block all serving for its duration, ADVICE r4).  `$params`
+        values are split on ','/'=': parameter VALUES containing either
+        character cannot be expressed over this surface (no SPTAG
+        parameter needs them; use the Python/CLI surface otherwise)."""
         import base64 as b64mod
 
         from sptag_tpu.core.index import create_instance
@@ -182,10 +229,11 @@ class SearchExecutor:
                     dim = int(parsed.options.get("dimension", ""))
                 except ValueError:
                     return self._admin_reply(False, "need-dimension")
-                flat = parsed.extract_vector(
-                    dt, self.context.settings.vector_separator)
-                if flat is None or dim <= 0 or flat.size % dim:
-                    return self._admin_reply(False, "bad-vector-block")
+                if dim > self.context.settings.admin_max_dim:
+                    return self._admin_reply(False, "dimension-over-limit")
+                block, err = self._extract_capped(parsed, dt, dim)
+                if err is not None:
+                    return err
                 algo = parsed.options.get("algo", "BKT").upper()
                 index = create_instance(algo, dt)
                 index.set_parameter(
@@ -198,20 +246,17 @@ class SearchExecutor:
                     if not index.set_parameter(pname, pval):
                         return self._admin_reply(False,
                                                  f"bad-param-{pname}")
-                index.build(flat.reshape(-1, dim))
+                index.build(block)
                 self.context.add_index(name, index)
                 return self._admin_reply(True, "built", index.num_samples)
             index = self.context.indexes.get(name)
             if index is None:
                 return self._admin_reply(False, "no-such-index")
             if op == "add":
-                rows = parsed.extract_vector(
-                    index.value_type,
-                    self.context.settings.vector_separator)
-                if rows is None or index.feature_dim == 0 \
-                        or rows.size % index.feature_dim:
-                    return self._admin_reply(False, "bad-vector-block")
-                rows = rows.reshape(-1, index.feature_dim)
+                rows, err = self._extract_capped(
+                    parsed, index.value_type, index.feature_dim)
+                if err is not None:
+                    return err
                 metadata = None
                 raw_meta = parsed.options.get("metadata")
                 if raw_meta is not None:
@@ -233,13 +278,12 @@ class SearchExecutor:
                 return self._admin_reply(ok, "added" if ok else str(code),
                                          len(rows) if ok else 0)
             if op == "delete":
-                rows = parsed.extract_vector(
-                    index.value_type,
-                    self.context.settings.vector_separator)
-                if rows is None or index.feature_dim == 0 \
-                        or rows.size % index.feature_dim:
-                    return self._admin_reply(False, "bad-vector-block")
-                rows = rows.reshape(-1, index.feature_dim)
+                # delete-by-content is a search per row, synchronous in
+                # the request path — same cap as build/add
+                rows, err = self._extract_capped(
+                    parsed, index.value_type, index.feature_dim)
+                if err is not None:
+                    return err
                 code = index.delete(rows)
                 ok = code == ErrorCode.Success
                 return self._admin_reply(ok,

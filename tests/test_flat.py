@@ -270,3 +270,40 @@ def test_flat_sketch_auto_shortlist_calibrates():
     assert sk._sketch is not None and sk._sketch[3] is not None
     assert sk._sketch[0] is sk._device  # keyed to the fresh snapshot
     del old
+
+
+def test_flat_sketch_calibration_failure_cached(monkeypatch):
+    """A failed calibration is cached as a -1 sentinel (ADVICE r4): the
+    O(64*N) scan runs AT MOST once per snapshot, later searches fall to
+    the N/32 heuristic without re-attempting, and a mutation (fresh
+    snapshot) re-arms exactly one new attempt."""
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((3000, 24)).astype(np.float32)
+    idx = create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.set_parameter("SketchPrefilter", "true")
+    idx.build(data)
+
+    calls = {"n": 0}
+    orig = type(idx)._calibrate
+
+    def failing(self, *a, **kw):
+        calls["n"] += 1
+        return None                       # simulate kernel failure
+
+    monkeypatch.setattr(type(idx), "_calibrate", failing)
+    queries = data[:4] + 0.01
+    _, ids1 = idx.search_batch(queries, 5)
+    assert calls["n"] == 1
+    assert idx._sketch[3] == -1           # failure sentinel stored
+    _, ids2 = idx.search_batch(queries, 5)
+    assert calls["n"] == 1                # no re-attempt on same snapshot
+    np.testing.assert_array_equal(ids1, ids2)
+    assert (ids1[:, 0] == np.arange(4)).all()   # heuristic path still sane
+
+    # a mutation re-arms exactly one fresh attempt; a then-working
+    # calibration replaces the sentinel
+    monkeypatch.setattr(type(idx), "_calibrate", orig)
+    idx.add(rng.standard_normal((100, 24)).astype(np.float32))
+    idx.search_batch(queries, 5)
+    assert idx._sketch[3] is not None and idx._sketch[3] > 0

@@ -1036,6 +1036,35 @@ def test_merge_top_k_unit():
     z = merge_top_k([n0, n1])[0]
     assert z.ids == [4] and z.metas is None  # k=1 caps the duplicate
 
+    # ADVICE r4: integer-distance corpora tie DISTINCT vectors at exactly
+    # the same distance.  With declared replica groups, the collapse is
+    # restricted to servers in the same group — an exact tie across two
+    # different SHARDS (no shared group) survives
+    i0 = [wire.IndexSearchResult("q", [0, 1], [100.0, 300.0],
+                                 [b"dup", b"a"])]
+    i1 = [wire.IndexSearchResult("q", [5, 6], [100.0, 900.0],
+                                 [b"dup", b"b"])]
+    # shard topology: distinct groups (None = not a replica of anything)
+    q = merge_top_k([i0, i1], replica_groups=[None, None])[0]
+    assert q.dists == [100.0, 100.0]        # both tied entries kept
+    # replica topology: same group label -> the tie IS a replica, collapse
+    q2 = merge_top_k([i0, i1], replica_groups=["g", "g"])[0]
+    assert q2.dists == [100.0, 300.0]
+    # but two entries from ONE reply are never replicas (a server never
+    # returns the same vector twice), even in replica topology: a
+    # within-reply metadata+distance tie survives
+    j0 = [wire.IndexSearchResult("j", [3, 7], [100.0, 100.0],
+                                 [b"dup", b"dup"])]
+    j1 = [wire.IndexSearchResult("j", [9], [500.0], [b"z"])]
+    j = merge_top_k([j0, j1], replica_groups=["g", "g"])[0]
+    assert j.dists == [100.0, 100.0] and sorted(j.ids) == [3, 7]
+    # rel_tol=0 demands bit-equality: the few-ULP spread no longer merges
+    h0 = [wire.IndexSearchResult("v", [0], [1.0], [b"r"])]
+    h1 = [wire.IndexSearchResult("v", [0, 1], [1.0000001, 9.0],
+                                 [b"r", b"b"])]
+    v0 = merge_top_k([h0, h1], rel_tol=0.0)[0]
+    assert v0.dists == [1.0, 1.0000001]
+
 
 def test_aggregator_merge_top_k_end_to_end():
     """MergeTopK=true: two servers shard one corpus under the SAME index
@@ -1278,3 +1307,48 @@ def test_remote_admin_gated_and_validated():
     r = ex2.execute("$indexname:f " + "|".join(str(float(v))
                                                for v in data[3]))
     assert r.results[0].ids[0] == 3
+
+    # ADVICE r4: payload caps — builds run synchronously in the request
+    # path, so rows/dims are bounded like $maxcheck is
+    ctx3 = ServiceContext(ServiceSettings(enable_remote_admin=True,
+                                          admin_max_rows=50,
+                                          admin_max_dim=4))
+    ex3 = SearchExecutor(ctx3)
+    assert ex3.execute("$admin:build $indexname:x $datatype:Float "
+                       f"$dimension:8 #{b64}"
+                       ).results[0].index_name == \
+        "admin:error:dimension-over-limit"
+    assert ex3.execute("$admin:build $indexname:x $datatype:Float "
+                       f"$dimension:4 #{b64}"
+                       ).results[0].index_name == \
+        "admin:error:rows-over-limit"      # 100*8/4 = 200 rows > 50
+    small = base64.b64encode(data[:10].tobytes()).decode()
+    assert ex3.execute("$admin:build $indexname:s $datatype:Float "
+                       f"$dimension:4 $algo:FLAT #{small}"
+                       ).results[0].index_name == "admin:ok:built"
+    assert ex3.execute(f"$admin:add $indexname:s #{b64}"
+                       ).results[0].index_name == \
+        "admin:error:rows-over-limit"
+    # delete-by-content runs a search per row: same cap applies
+    assert ex3.execute(f"$admin:delete $indexname:s #{b64}"
+                       ).results[0].index_name == \
+        "admin:error:rows-over-limit"
+    # TEXT payloads skip the length pre-gate (element widths vary too
+    # much for a tight bound; a 2-char estimate falsely rejected legal
+    # blocks) but still hit the exact post-decode cap
+    row_txt = "|".join(f"{v:.6f}" for v in data[0, :4])
+    assert ex3.execute(f"$admin:add $indexname:s {row_txt}"
+                       ).results[0].index_name == "admin:ok:added"
+    many_txt = "|".join(f"{v:.6f}" for v in
+                        rng.standard_normal(60 * 4).astype(np.float32))
+    assert ex3.execute(f"$admin:add $indexname:s {many_txt}"
+                       ).results[0].index_name == \
+        "admin:error:rows-over-limit"
+    # ini round-trip of the caps
+    with tempfile.NamedTemporaryFile("w", suffix=".ini",
+                                     delete=False) as f:
+        f.write("[Service]\nAdminMaxRows=7\nAdminMaxDim=3\n")
+        path = f.name
+    s3 = ServiceContext.from_ini(path).settings
+    assert s3.admin_max_rows == 7 and s3.admin_max_dim == 3
+    os.unlink(path)

@@ -127,6 +127,14 @@ def main():
     t2 = time.time()
     _, ids = index.search(queries, 10)
     search_s = time.time() - t2
+    # budget ladder: recall at fixed MaxCheck decays with corpus size
+    # (2048 candidates is ~0.02% coverage at 10M) — measure the graph's
+    # quality envelope, not one rung (VERDICT r4 item 4)
+    ladder_ids = {}
+    for mc in (8192, 16384, 32768):
+        tl = time.time()
+        _, ids_mc = index.search(queries, 10, max_check=mc)
+        ladder_ids[mc] = (ids_mc, round(time.time() - tl, 2))
     # exact truth in 1M-row blocks
     best_d = np.full((64, 10), np.inf, np.float64)
     best_i = np.full((64, 10), -1, np.int64)
@@ -142,13 +150,20 @@ def main():
         sel = np.argpartition(cat_d, 10, axis=1)[:, :10]
         best_d = np.take_along_axis(cat_d, sel, axis=1)
         best_i = np.take_along_axis(cat_i, sel, axis=1)
-    recall = float(np.mean([
-        len(set(int(v) for v in ids[q] if v >= 0)
-            & set(int(v) for v in best_i[q])) / 10 for q in range(64)]))
+    def _recall(got):
+        return float(np.mean([
+            len(set(int(v) for v in got[q] if v >= 0)
+                & set(int(v) for v in best_i[q])) / 10 for q in range(64)]))
+
+    recall = _recall(ids)
+    ladder = {str(mc): {"recall_at_10": round(_recall(v[0]), 4),
+                        "search64_s": v[1]}
+              for mc, v in ladder_ids.items()}
     result = {
         "n": args.n, "d": args.d, "devices": args.devices,
         "build_s": round(build_s, 1), "corpus_s": round(t_data, 1),
         "search64_s": round(search_s, 2), "recall_at_10": round(recall, 4),
+        "ladder": ladder,
         # the build's OWN signal (any shard resumed from checkpoints) —
         # a non-empty checkpoint dir alone can be stale foreign state
         "resumed": bool(getattr(index, "build_resumed", False)),
