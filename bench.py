@@ -553,6 +553,29 @@ def run_bench():
         except Exception:                                # noqa: BLE001
             pass
 
+        # beam headline (VERDICT r4 item 8): the reference-parity graph
+        # walk tracked FIRST-CLASS next to the dense value every round —
+        # its perf lived only in sweep reports before.  Same index, same
+        # queries/truth; its own error key so a beam failure never erases
+        # the dense headline already streamed.
+        if _remaining(budget_s) > 180:
+            try:
+                index.set_parameter("SearchMode", "beam")
+                with trace.span("bench.beam_sweep"):
+                    ids_b, qps_b, _ = timed_sweep(index, queries, k, batch,
+                                                  budget_s, repeats=1)
+                result.update({
+                    "beam_qps": round(qps_b, 1),
+                    "beam_recall_at_10": round(
+                        recall_at_k(ids_b, truth, k), 4),
+                    "beam_vs_baseline": round(qps_b / cpu_qps, 2),
+                })
+            except Exception as e:                       # noqa: BLE001
+                result["beam_error"] = repr(e)[:300]
+            finally:
+                index.set_parameter("SearchMode", "dense")
+            checkpoint()
+
         # secondary metric: int8 cosine end-to-end (BASELINE.md config 4) —
         # exercises the `base^2 - dot` integer convention at index level
         if _remaining(budget_s) > 120:

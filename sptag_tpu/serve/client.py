@@ -7,13 +7,29 @@ resourceID, honor a per-call timeout (the reference uses Socket::
 ResourceManager's timeout thread, inc/Socket/ResourceManager.h:31-184 —
 here a socket timeout plays that role), expose results as
 (ids, dists, metas) per index.
+
+Three client shapes, smallest first:
+
+* `AnnClient` — one socket, one in-flight request (lock-serialized);
+  the simple REPL/tool client.
+* `PipelinedAnnClient` — one socket, MANY in-flight requests: a reader
+  thread dispatches responses to waiters by resource id, so concurrent
+  callers share the connection without serializing on the round trip
+  (the send is locked, the wait is not).  This is the Socket::
+  ResourceManager callback registry recast as events
+  (inc/Socket/ResourceManager.h:31-184); a timed-out request's late
+  reply is read and discarded, leaving the stream aligned.
+* `AnnClientPool` — N pipelined connections, round-robin per request
+  (ClientWrapper.h:26-74: the reference tool dials N sockets and
+  round-robins queries across them from its thread pool).
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import socket
 import threading
-from typing import Optional
+from typing import List, Optional
 
 from sptag_tpu.serve import wire
 
@@ -176,22 +192,253 @@ class AnnClient:
         sock.sendall(header.pack() + body)
 
     def _recv(self, sock: socket.socket):
-        head = self._read_exact(sock, wire.HEADER_SIZE)
+        head = _read_exact(sock, wire.HEADER_SIZE)
         header = wire.PacketHeader.unpack(head)
-        body = self._read_exact(sock, header.body_length) \
+        body = _read_exact(sock, header.body_length) \
             if header.body_length else b""
         return header, body
 
-    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
-        chunks = []
-        remaining = n
-        while remaining:
-            chunk = sock.recv(remaining)
-            if not chunk:
-                raise OSError("connection closed")
-            chunks.append(chunk)
-            remaining -= len(chunk)
-        return b"".join(chunks)
+
+class PipelinedAnnClient:
+    """One socket, many in-flight requests.
+
+    `search()` registers its resource id, sends under the write lock,
+    then waits WITHOUT the lock; a dedicated reader thread dispatches
+    each response to its waiter.  On timeout the waiter deregisters and
+    the reader discards the late reply by resource id — the stream stays
+    aligned and the connection survives (the plain AnnClient must drop
+    it).  Parity: Socket::ResourceManager (reference
+    inc/Socket/ResourceManager.h:31-184)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 9.0):
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()      # guards _pending + _next_rid
+        self._pending: dict = {}            # rid -> [Event, result-slot]
+        self._next_rid = 1
+        self._remote_cid = wire.INVALID_CONNECTION_ID
+        self._reader: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ connection
+
+    def connect(self) -> None:
+        with self._wlock:
+            if self._sock is not None:
+                return
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout_s)
+            # the reader blocks in recv indefinitely; request timeouts are
+            # enforced by the waiters, not the socket
+            sock.settimeout(None)
+            try:
+                header = wire.PacketHeader(wire.PacketType.RegisterRequest)
+                header.body_length = 0
+                sock.sendall(header.pack())
+                head = _read_exact(sock, wire.HEADER_SIZE)
+                rhead = wire.PacketHeader.unpack(head)
+                if rhead.body_length:
+                    _read_exact(sock, rhead.body_length)
+                if rhead.packet_type == wire.PacketType.RegisterResponse:
+                    self._remote_cid = rhead.connection_id
+            except OSError:
+                sock.close()
+                raise
+            self._sock = sock
+            self._reader = threading.Thread(target=self._read_loop,
+                                            args=(sock,), daemon=True)
+            self._reader.start()
+
+    @property
+    def is_connected(self) -> bool:
+        return self._sock is not None
+
+    def close(self) -> None:
+        with self._wlock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            sock.close()
+        self._fail_pending()
+
+    def _fail_pending(self) -> None:
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for ev, slot in pending.values():
+            slot.append(None)               # None = connection failure
+            ev.set()
+
+    # ---------------------------------------------------------------- reader
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                head = _read_exact(sock, wire.HEADER_SIZE)
+                header = wire.PacketHeader.unpack(head)
+                body = _read_exact(sock, header.body_length) \
+                    if header.body_length else b""
+                if header.packet_type != wire.PacketType.SearchResponse:
+                    continue                # heartbeat responses etc.
+                with self._plock:
+                    entry = self._pending.pop(header.resource_id, None)
+                if entry is not None:       # else: late reply, discarded
+                    entry[1].append(body)
+                    entry[0].set()
+        except OSError:
+            pass
+        finally:
+            # reader death = connection death (either close() already ran
+            # or the peer reset): fail every waiter now rather than letting
+            # each ride out its full timeout
+            with self._wlock:
+                if self._sock is sock:
+                    self._sock = None
+                    sock.close()
+            self._fail_pending()
+
+    # ---------------------------------------------------------------- search
+
+    def search(self, query: str,
+               timeout_s: Optional[float] = None) -> wire.RemoteSearchResult:
+        if self._sock is None:
+            try:
+                self.connect()
+            except OSError:
+                return wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedNetwork, [])
+        ev = threading.Event()
+        slot: list = []
+        with self._plock:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._pending[rid] = (ev, slot)
+        body = wire.RemoteQuery(query).pack()
+        header = wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), self._remote_cid, rid)
+        try:
+            with self._wlock:
+                sock = self._sock
+                if sock is None:
+                    raise OSError("not connected")
+                sock.sendall(header.pack() + body)
+        except OSError:
+            with self._plock:
+                self._pending.pop(rid, None)
+            self.close()
+            return wire.RemoteSearchResult(
+                wire.ResultStatus.FailedNetwork, [])
+        if not ev.wait(timeout_s if timeout_s is not None
+                       else self.timeout_s):
+            # deregister; if the reader dispatched between wait() expiring
+            # and the pop, the slot holds the result — use it
+            with self._plock:
+                self._pending.pop(rid, None)
+            if not slot:
+                return wire.RemoteSearchResult(wire.ResultStatus.Timeout, [])
+        payload = slot[0]
+        if payload is None:                 # connection failed mid-flight
+            return wire.RemoteSearchResult(
+                wire.ResultStatus.FailedNetwork, [])
+        result = wire.RemoteSearchResult.unpack(payload)
+        return result if result is not None else \
+            wire.RemoteSearchResult(wire.ResultStatus.FailedNetwork, [])
+
+
+class AnnClientPool:
+    """Round-robin pool of N pipelined connections to one server
+    (reference ClientWrapper.h:26-74: the client tool dials
+    `Connections` sockets and its thread pool round-robins requests
+    over them).  Each underlying connection additionally pipelines, so
+    total in-flight capacity is bounded by the server, not the pool.
+
+    `search()` is synchronous from the caller's thread; `search_async()`
+    returns a Future from the pool's executor (the reference's async
+    send + callback, ClientWrapper.h:40-49)."""
+
+    def __init__(self, host: str, port: int, connections: int = 4,
+                 timeout_s: float = 9.0, max_workers: Optional[int] = None):
+        if connections < 1:
+            raise ValueError("connections must be >= 1")
+        self.timeout_s = timeout_s
+        self._clients: List[PipelinedAnnClient] = [
+            PipelinedAnnClient(host, port, timeout_s)
+            for _ in range(connections)]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._closed = False
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers or 4 * connections,
+            thread_name_prefix="annpool")
+
+    def connect(self) -> None:
+        errors = []
+        for c in self._clients:
+            try:
+                c.connect()
+            except OSError as e:
+                errors.append(e)
+        if len(errors) == len(self._clients):
+            raise errors[0]                 # nothing usable
+
+    @property
+    def num_connected(self) -> int:
+        return sum(1 for c in self._clients if c.is_connected)
+
+    def _pick(self) -> PipelinedAnnClient:
+        with self._rr_lock:
+            start = self._rr
+            self._rr = (self._rr + 1) % len(self._clients)
+        # prefer a live connection; fall back to the round-robin pick
+        # (whose search() will re-dial)
+        for off in range(len(self._clients)):
+            c = self._clients[(start + off) % len(self._clients)]
+            if c.is_connected:
+                return c
+        return self._clients[start]
+
+    def search(self, query: str,
+               timeout_s: Optional[float] = None) -> wire.RemoteSearchResult:
+        # a closed pool must not serve: PipelinedAnnClient.search would
+        # silently RE-DIAL the dropped socket, leaking a fresh connection
+        # + reader thread from a pool the caller already tore down
+        if self._closed:
+            return wire.RemoteSearchResult(
+                wire.ResultStatus.FailedNetwork, [])
+        return self._pick().search(query, timeout_s)
+
+    def search_async(self, query: str,
+                     timeout_s: Optional[float] = None
+                     ) -> "concurrent.futures.Future[wire.RemoteSearchResult]":
+        return self._executor.submit(self.search, query, timeout_s)
+
+    def close(self) -> None:
+        self._closed = True
+        # cancel queued (not-yet-started) search_async tasks — without
+        # this they would run AFTER close and re-dial
+        self._executor.shutdown(wait=False, cancel_futures=True)
+        for c in self._clients:
+            c.close()
+
+    def __enter__(self) -> "AnnClientPool":
+        self.connect()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(remaining)
+        if not chunk:
+            raise OSError("connection closed")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
 
 
 def main(argv=None) -> int:
@@ -202,8 +449,15 @@ def main(argv=None) -> int:
     parser.add_argument("-s", "--server", default="127.0.0.1")
     parser.add_argument("-p", "--port", type=int, default=8000)
     parser.add_argument("-t", "--timeout", type=float, default=9.0)
+    parser.add_argument("-c", "--connections", type=int, default=1,
+                        help="socket pool size (reference ClientWrapper "
+                             "dials N connections and round-robins)")
     args = parser.parse_args(argv)
-    client = AnnClient(args.server, args.port, args.timeout)
+    if args.connections > 1:
+        client = AnnClientPool(args.server, args.port, args.connections,
+                               args.timeout)
+    else:
+        client = AnnClient(args.server, args.port, args.timeout)
     client.connect()
     print("connected; enter queries (empty line quits)")
     import sys

@@ -71,6 +71,12 @@ class ServiceSettings:
     # via [Service] AdminMaxRows.
     admin_max_rows: int = 1_000_000
     admin_max_dim: int = 4096
+    # root directory for $admin:save / $admin:load paths; empty (default)
+    # DISABLES the persist ops.  Paths are resolved strictly under this
+    # root (escapes rejected) — the ops exist for the in-process AnnIndex
+    # facades (wrappers/) whose host server is a local child, not for
+    # exposing filesystem writes to remote networks.
+    admin_persist_root: str = ""
 
 
 class ServiceContext:
@@ -109,6 +115,8 @@ class ServiceContext:
                 "Service", "AdminMaxRows", "1000000")),
             admin_max_dim=int(reader.get_parameter(
                 "Service", "AdminMaxDim", "4096")),
+            admin_persist_root=reader.get_parameter(
+                "Service", "AdminPersistRoot", ""),
         )
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
@@ -157,6 +165,52 @@ class SearchExecutor:
                 f"admin:{'ok' if ok else 'error'}:{message}",
                 [int(count)], [0.0], None)])
 
+    def _decode_metadata(self, parsed: ParsedQuery, n_rows: int):
+        """Optional `$metadata:<b64>` — one payload per row,
+        \\x00-separated (a single row may omit the separator entirely).
+        Returns (MetadataSet-or-None, error-reply-or-None)."""
+        import base64 as b64mod
+
+        from sptag_tpu.core.vectorset import MetadataSet
+
+        raw_meta = parsed.options.get("metadata")
+        if raw_meta is None:
+            return None, None
+        try:
+            payload = b64mod.b64decode(raw_meta, validate=False)
+        except Exception:                                # noqa: BLE001
+            return None, self._admin_reply(False, "bad-metadata")
+        parts = payload.split(b"\x00")
+        if len(parts) != n_rows:
+            return None, self._admin_reply(False,
+                                           "metadata-count-mismatch")
+        return MetadataSet(parts), None
+
+    def _persist_path(self, parsed: ParsedQuery) -> Optional[str]:
+        """Resolve `$path:<b64 relative path>` strictly under
+        AdminPersistRoot; None when the ops are disabled (empty root),
+        the path is missing/undecodable, or it escapes the root."""
+        import base64 as b64mod
+        import os
+
+        root = self.context.settings.admin_persist_root
+        if not root:
+            return None
+        raw = parsed.options.get("path")
+        if raw is None:
+            return None
+        try:
+            rel = b64mod.b64decode(raw, validate=False).decode("utf-8")
+        except Exception:                                # noqa: BLE001
+            return None
+        if not rel or rel.startswith(("/", "\\")) or ".." in rel.split("/"):
+            return None
+        root_abs = os.path.abspath(root)
+        full = os.path.abspath(os.path.join(root_abs, rel))
+        if full != root_abs and not full.startswith(root_abs + os.sep):
+            return None
+        return full
+
     def _extract_capped(self, parsed: ParsedQuery, value_type,
                         dim: int):
         """Shared build/add/delete payload path: pre-decode cap gate,
@@ -175,7 +229,12 @@ class SearchExecutor:
 
         cap = self.context.settings.admin_max_rows
         if dim > 0 and parsed.vector_base64 is not None:
-            est_bytes = (len(parsed.vector_base64) * 3) // 4
+            b64 = parsed.vector_base64
+            # exact decoded length: subtract '=' padding so a payload of
+            # exactly `cap` rows is never over-counted by the 3/4 estimate
+            pad = 2 if b64.endswith("==") else (1 if b64.endswith("=")
+                                                else 0)
+            est_bytes = (len(b64) * 3) // 4 - pad
             itemsize = dtype_of(value_type).itemsize
             if est_bytes // max(1, itemsize * dim) > cap:
                 return None, self._admin_reply(False, "rows-over-limit")
@@ -199,6 +258,12 @@ class SearchExecutor:
         * `$admin:add $indexname:n [$metadata:<b64>] #<b64 rows>`
         * `$admin:delete $indexname:n #<b64 rows>` (delete-by-content)
         * `$admin:deletemeta $indexname:n $metadata:<b64>`
+        * `$admin:setparam $indexname:n $params:Name=Val[,Name=Val]`
+          (reference SetSearchParam — live parameter changes post-build)
+        * `$admin:save $indexname:n $path:<b64 rel path>` /
+          `$admin:load $indexname:n $path:<b64 rel path>` — persist ops,
+          enabled only when `[Service] AdminPersistRoot` names a
+          directory; paths resolve strictly under it
 
         Gated by `[Service] EnableRemoteAdmin` (default off).  Build/add
         payloads are capped at AdminMaxRows x AdminMaxDim (builds run
@@ -211,7 +276,6 @@ class SearchExecutor:
 
         from sptag_tpu.core.index import create_instance
         from sptag_tpu.core.types import ErrorCode
-        from sptag_tpu.core.vectorset import MetadataSet
 
         if not self.context.settings.enable_remote_admin:
             return self._admin_reply(False, "disabled")
@@ -246,32 +310,57 @@ class SearchExecutor:
                     if not index.set_parameter(pname, pval):
                         return self._admin_reply(False,
                                                  f"bad-param-{pname}")
-                index.build(block)
+                metadata, merr = self._decode_metadata(parsed, len(block))
+                if merr is not None:
+                    return merr
+                index.build(block, metadata,
+                            with_meta_index=metadata is not None
+                            and parsed.options.get("withmetaindex", "")
+                            .lower() in ("1", "true", "yes"))
                 self.context.add_index(name, index)
                 return self._admin_reply(True, "built", index.num_samples)
+            if op == "load":
+                folder = self._persist_path(parsed)
+                if folder is None:
+                    return self._admin_reply(False, "bad-path")
+                loaded = load_index(folder)
+                self.context.add_index(name, loaded)
+                return self._admin_reply(True, "loaded",
+                                         loaded.num_samples)
             index = self.context.indexes.get(name)
             if index is None:
                 return self._admin_reply(False, "no-such-index")
+            if op == "setparam":
+                # all-or-nothing: a failure mid-list rolls back the
+                # already-applied names, so an error reply never hides a
+                # half-applied config on the live index
+                pairs = [kv.partition("=") for kv in
+                         parsed.options.get("params", "").split(",") if kv]
+                undo = [(p, index.get_parameter(p)) for p, _, _ in pairs]
+                applied = 0
+                for pname, _, pval in pairs:
+                    if not index.set_parameter(pname, pval):
+                        for uname, uval in undo[:applied]:
+                            if uval is not None:
+                                index.set_parameter(uname, uval)
+                        return self._admin_reply(False,
+                                                 f"bad-param-{pname}")
+                    applied += 1
+                return self._admin_reply(True, "set", applied)
+            if op == "save":
+                folder = self._persist_path(parsed)
+                if folder is None:
+                    return self._admin_reply(False, "bad-path")
+                index.save_index(folder)
+                return self._admin_reply(True, "saved", index.num_samples)
             if op == "add":
                 rows, err = self._extract_capped(
                     parsed, index.value_type, index.feature_dim)
                 if err is not None:
                     return err
-                metadata = None
-                raw_meta = parsed.options.get("metadata")
-                if raw_meta is not None:
-                    try:
-                        payload = b64mod.b64decode(raw_meta,
-                                                   validate=False)
-                    except Exception:                    # noqa: BLE001
-                        return self._admin_reply(False, "bad-metadata")
-                    # one metadata payload per row, \x00-separated (a
-                    # single row may omit the separator entirely)
-                    parts = payload.split(b"\x00")
-                    if len(parts) != len(rows):
-                        return self._admin_reply(False,
-                                                 "metadata-count-mismatch")
-                    metadata = MetadataSet(parts)
+                metadata, merr = self._decode_metadata(parsed, len(rows))
+                if merr is not None:
+                    return merr
                 code = index.add(rows, metadata,
                                  with_meta_index=metadata is not None)
                 ok = code == ErrorCode.Success

@@ -1,0 +1,333 @@
+// In-process AnnIndex facade: the reference's SWIG C# AnnIndex
+// (Wrappers/inc/CoreInterface.h:14-65, CsharpCore.i) and the C++/CLI
+// managed wrapper (CLRCoreInterface.h:1-113) run the whole index inside
+// the host process.  This framework's index core is Python/JAX, so the
+// facade OWNS a private local Python host child (wrappers/index_host.py:
+// loopback-only, admin surface enabled, persist ops sandboxed to a temp
+// directory this class creates) and drives the identical lifecycle —
+// SetBuildParam / Build(WithMetaData) / Search / Add / Delete /
+// DeleteByMetaData / SetSearchParam / Save / Load — through the AnnClient
+// wire client.  Callers never touch wire bytes or the child process.
+//
+// NOTE: no .NET SDK exists in the build image; the CI wrappers-execute
+// job compiles and RUNS AnnIndexDrive against a real child.
+
+using System;
+using System.Collections.Generic;
+using System.Diagnostics;
+using System.IO;
+using System.Text;
+using System.Threading;
+
+namespace SPTAG
+{
+    public sealed class AnnIndex : IDisposable
+    {
+        private readonly Process _host;
+        private readonly AnnClient _client;
+        private readonly string _workDir;
+        private readonly string _algoType;
+        private readonly string _valueType;
+        private readonly int _dimension;
+        private const string IndexName = "idx";
+        private readonly Dictionary<string, string> _buildParams =
+            new Dictionary<string, string>();
+        private bool _built;
+
+        /// <summary>Spawn the private index host and connect.</summary>
+        public AnnIndex(string python, string repoRoot, string algoType,
+                        string valueType, int dimension)
+        {
+            _algoType = algoType;
+            _valueType = valueType;
+            _dimension = dimension;
+            _workDir = Path.Combine(Path.GetTempPath(),
+                                    "annindex_" + Guid.NewGuid().ToString("N"));
+            Directory.CreateDirectory(_workDir);
+            string portFile = Path.Combine(_workDir, "port");
+            string hostLog = Path.Combine(_workDir, "host.log");
+            var psi = new ProcessStartInfo
+            {
+                FileName = python,
+                UseShellExecute = false,
+                RedirectStandardOutput = true,
+                RedirectStandardError = true,
+            };
+            psi.ArgumentList.Add(Path.Combine(repoRoot, "wrappers",
+                                              "index_host.py"));
+            psi.ArgumentList.Add(portFile);
+            psi.ArgumentList.Add(Path.Combine(_workDir, "persist"));
+            _host = Process.Start(psi)
+                ?? throw new IOException("failed to start index host");
+            // drain the child's output continuously into a log file — an
+            // undrained pipe fills (~64KB) and DEADLOCKS the child once
+            // JAX/XLA warnings or server logs exceed it
+            var logWriter = new StreamWriter(hostLog) { AutoFlush = true };
+            _host.OutputDataReceived += (_, e) =>
+            {
+                if (e.Data != null) { lock (logWriter) logWriter.WriteLine(e.Data); }
+            };
+            _host.ErrorDataReceived += (_, e) =>
+            {
+                if (e.Data != null) { lock (logWriter) logWriter.WriteLine(e.Data); }
+            };
+            _host.BeginOutputReadLine();
+            _host.BeginErrorReadLine();
+            // anything that throws after the spawn must destroy the child
+            // — index_host.py otherwise serves forever as an orphan
+            try
+            {
+                int port = -1;
+                // JAX import in the child takes tens of seconds cold
+                for (int i = 0; i < 600 && port < 0; ++i)
+                {
+                    Thread.Sleep(200);
+                    if (_host.HasExited)
+                    {
+                        throw new IOException(
+                            "index host died: " + SafeRead(hostLog));
+                    }
+                    if (File.Exists(portFile))
+                    {
+                        string text = File.ReadAllText(portFile).Trim();
+                        if (text.Length > 0)
+                        {
+                            port = int.Parse(text);
+                        }
+                    }
+                }
+                if (port < 0)
+                {
+                    throw new IOException(
+                        "index host never published its port");
+                }
+                _client = new AnnClient("127.0.0.1", port, 120_000);
+                _client.Connect();
+            }
+            catch
+            {
+                try { _host.Kill(entireProcessTree: true); }
+                catch (InvalidOperationException) { }
+                throw;
+            }
+        }
+
+        private static string SafeRead(string path)
+        {
+            try
+            {
+                return File.ReadAllText(path);
+            }
+            catch (IOException)
+            {
+                return "(log unavailable)";
+            }
+        }
+
+        /// <summary>Applied at the next Build; values must not contain
+        /// ',' or '=' (the admin $params split).</summary>
+        public void SetBuildParam(string name, string value)
+        {
+            _buildParams[name] = value;
+        }
+
+        /// <summary>Live parameter change: queued pre-build, immediate
+        /// ($admin:setparam, reference SetSearchParam) post-build.</summary>
+        public bool SetSearchParam(string name, string value)
+        {
+            if (!_built)
+            {
+                _buildParams[name] = value;
+                return true;
+            }
+            return Ok(_client.Search("$admin:setparam $indexname:"
+                                     + IndexName + " $params:" + name + "="
+                                     + value));
+        }
+
+        public bool Build(float[] data, int num)
+        {
+            return BuildRaw(AnnClient.FloatsToBytes(data), num, null, false);
+        }
+
+        public bool BuildWithMetaData(float[] data, byte[][] metas, int num,
+                                      bool withMetaIndex)
+        {
+            return BuildRaw(AnnClient.FloatsToBytes(data), num, metas,
+                            withMetaIndex);
+        }
+
+        /// <summary>Raw little-endian row-major block — the ByteArray
+        /// overload of the reference Build/BuildWithMetaData.</summary>
+        public bool BuildRaw(byte[] block, int num, byte[][]? metas,
+                             bool withMetaIndex)
+        {
+            CheckRows(block.Length, num);
+            var line = new StringBuilder("$admin:build $indexname:")
+                .Append(IndexName)
+                .Append(" $datatype:").Append(_valueType)
+                .Append(" $dimension:").Append(_dimension)
+                .Append(" $algo:").Append(_algoType);
+            var paramStr = new StringBuilder();
+            foreach (var kv in _buildParams)
+            {
+                if (paramStr.Length > 0)
+                {
+                    paramStr.Append(',');
+                }
+                paramStr.Append(kv.Key).Append('=').Append(kv.Value);
+            }
+            if (paramStr.Length > 0)
+            {
+                line.Append(" $params:").Append(paramStr);
+            }
+            if (metas != null)
+            {
+                line.Append(" $metadata:").Append(JoinMetas(metas));
+                if (withMetaIndex)
+                {
+                    line.Append(" $withmetaindex:1");
+                }
+            }
+            line.Append(" #").Append(Convert.ToBase64String(block));
+            bool okBuild = Ok(_client.Search(line.ToString()));
+            _built = _built || okBuild;
+            return okBuild;
+        }
+
+        public AnnClient.SearchResult Search(float[] query, int k)
+        {
+            return SearchRaw(AnnClient.FloatsToBytes(query), k, false);
+        }
+
+        public AnnClient.SearchResult SearchWithMetaData(float[] query, int k)
+        {
+            return SearchRaw(AnnClient.FloatsToBytes(query), k, true);
+        }
+
+        public AnnClient.SearchResult SearchRaw(byte[] queryBytes, int k,
+                                                bool withMeta)
+        {
+            string line = "$indexname:" + IndexName + " $resultnum:" + k
+                + (withMeta ? " $extractmetadata:true" : "") + " #"
+                + Convert.ToBase64String(queryBytes);
+            return _client.Search(line);
+        }
+
+        public bool Add(float[] data, int num)
+        {
+            CheckRows(data.Length * 4, num);
+            return Ok(_client.AddVectors(IndexName,
+                                         AnnClient.FloatsToBytes(data),
+                                         null));
+        }
+
+        public bool AddWithMetaData(float[] data, byte[][] metas, int num)
+        {
+            CheckRows(data.Length * 4, num);
+            return Ok(_client.AddVectors(IndexName,
+                                         AnnClient.FloatsToBytes(data),
+                                         metas));
+        }
+
+        public bool Delete(float[] data, int num)
+        {
+            CheckRows(data.Length * 4, num);
+            return Ok(_client.DeleteVectors(IndexName,
+                                            AnnClient.FloatsToBytes(data)));
+        }
+
+        public bool DeleteByMetaData(byte[] meta)
+        {
+            return Ok(_client.DeleteByMetadata(IndexName, meta));
+        }
+
+        /// <summary>Persist under the facade's private sandbox.</summary>
+        public bool Save(string name)
+        {
+            return Ok(_client.Search("$admin:save $indexname:" + IndexName
+                + " $path:" + Convert.ToBase64String(
+                    Encoding.UTF8.GetBytes(name))));
+        }
+
+        /// <summary>Re-load a Save()d folder into this facade (reference
+        /// static Load, collapsed onto the owning host).</summary>
+        public bool Load(string name)
+        {
+            bool okLoad = Ok(_client.Search("$admin:load $indexname:"
+                + IndexName + " $path:" + Convert.ToBase64String(
+                    Encoding.UTF8.GetBytes(name))));
+            _built = _built || okLoad;
+            return okLoad;
+        }
+
+        public bool ReadyToServe()
+        {
+            return _built && !_host.HasExited;
+        }
+
+        private int RowBytes()
+        {
+            int item = _valueType == "Float" ? 4
+                : _valueType == "Int16" ? 2 : 1;
+            return _dimension * item;
+        }
+
+        private void CheckRows(int blockBytes, int num)
+        {
+            if (num * RowBytes() != blockBytes)
+            {
+                throw new ArgumentException(
+                    "block is " + blockBytes + " bytes, expected " + num
+                    + " rows x " + RowBytes());
+            }
+        }
+
+        private static string JoinMetas(byte[][] metas)
+        {
+            int total = 0;
+            foreach (byte[] m in metas)
+            {
+                total += m.Length + 1;
+            }
+            var joined = new byte[Math.Max(total - 1, 0)];
+            int off = 0;
+            for (int i = 0; i < metas.Length; ++i)
+            {
+                if (i > 0)
+                {
+                    joined[off++] = 0;
+                }
+                Buffer.BlockCopy(metas[i], 0, joined, off, metas[i].Length);
+                off += metas[i].Length;
+            }
+            return Convert.ToBase64String(joined);
+        }
+
+        private static bool Ok(AnnClient.SearchResult r)
+        {
+            return r.Status == 0 && r.Results.Count > 0
+                && r.Results[0].IndexName.StartsWith("admin:ok:",
+                                                     StringComparison.Ordinal);
+        }
+
+        public void Dispose()
+        {
+            try
+            {
+                _client.Dispose();
+            }
+            finally
+            {
+                try
+                {
+                    _host.Kill(entireProcessTree: true);
+                }
+                catch (InvalidOperationException)
+                {
+                    // already exited
+                }
+            }
+        }
+    }
+}

@@ -13,12 +13,18 @@
 using System;
 using System.Text;
 
-namespace Sptag
+namespace SPTAG
 {
     public static class LifecycleDrive
     {
         public static int Main(string[] args)
         {
+            // single console entry point: "annindex <python> <repoRoot>"
+            // dispatches to the in-process facade drive (AnnIndexDrive)
+            if (args.Length > 0 && args[0] == "annindex")
+            {
+                return AnnIndexDrive.Run(args[1], args[2]);
+            }
             string host = args[0];
             int port = int.Parse(args[1]);
             bool real = args.Length > 2 && args[2] == "real";

@@ -1,0 +1,44 @@
+"""Local index-host server for the in-process AnnIndex facades.
+
+The reference's SWIG wrappers run the whole index inside the Java/C#
+process (Wrappers/inc/CoreInterface.h:14-65, JavaCore.i, CsharpCore.i).
+Here the index core is Python/JAX — so each language's `AnnIndex` facade
+OWNS a local child running this script and drives the full lifecycle
+(Build/Add/Search/Delete/SetSearchParam/Save/Load) over the loopback
+wire.  The child is private to the facade: admin surface enabled, persist
+ops sandboxed to the directory the facade chose, serving 127.0.0.1 only.
+
+Usage: python wrappers/index_host.py <port_file> [persist_root]
+
+Writes the chosen ephemeral port to <port_file> and serves until killed.
+"""
+
+import asyncio
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+
+
+async def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sptag_tpu.serve.server import SearchServer
+    from sptag_tpu.serve.service import ServiceContext, ServiceSettings
+
+    persist_root = sys.argv[2] if len(sys.argv) > 2 else ""
+    ctx = ServiceContext(ServiceSettings(
+        default_max_result=10,
+        enable_remote_admin=True,
+        admin_persist_root=persist_root,
+    ))
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    host, port = await server.start("127.0.0.1", 0)
+    with open(sys.argv[1], "w") as f:
+        f.write(str(port))
+    print(f"index host on {host}:{port}", flush=True)
+    await asyncio.Event().wait()        # serve until killed
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,261 @@
+package sptag;
+
+import java.io.IOException;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.util.Base64;
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * In-process AnnIndex facade: the reference's SWIG Java AnnIndex
+ * (Wrappers/inc/CoreInterface.h:14-65, JavaCore.i) runs the whole index
+ * inside the JVM process.  This framework's index core is Python/JAX, so
+ * the facade OWNS a private local Python host child
+ * (wrappers/index_host.py: loopback-only, admin surface enabled, persist
+ * ops sandboxed to a temp directory this class creates) and drives the
+ * identical lifecycle — SetBuildParam / Build(WithMetaData) / Search /
+ * Add / Delete / DeleteByMetaData / SetSearchParam / Save / Load —
+ * through the {@link AnnClient} wire client.  Callers never touch wire
+ * bytes or the child process.
+ *
+ * NOTE: no JDK exists in the build image; the CI `wrappers-execute` job
+ * compiles and RUNS {@link AnnIndexDrive} against a real child.
+ */
+public final class AnnIndex implements AutoCloseable {
+
+    private final Process host;
+    private final AnnClient client;
+    private final Path workDir;
+    private final String algoType;
+    private final String valueType;
+    private final int dimension;
+    private final String indexName = "idx";
+    private final Map<String, String> buildParams = new LinkedHashMap<>();
+    private boolean built = false;
+
+    /**
+     * Spawn the private index host and connect.
+     *
+     * @param python    python executable (e.g. "python3")
+     * @param repoRoot  checkout root containing wrappers/index_host.py
+     * @param algoType  "BKT" | "KDT" | "FLAT"
+     * @param valueType "Float" | "Int8" | "UInt8" | "Int16"
+     */
+    public AnnIndex(String python, String repoRoot, String algoType,
+                    String valueType, int dimension)
+            throws IOException, InterruptedException {
+        this.algoType = algoType;
+        this.valueType = valueType;
+        this.dimension = dimension;
+        this.workDir = Files.createTempDirectory("annindex");
+        Path portFile = workDir.resolve("port");
+        this.host = new ProcessBuilder(
+                python, repoRoot + "/wrappers/index_host.py",
+                portFile.toString(), workDir.resolve("persist").toString())
+                .redirectErrorStream(true)
+                .redirectOutput(workDir.resolve("host.log").toFile())
+                .start();
+        // anything that throws after the spawn must destroy the child —
+        // index_host.py otherwise serves forever as an orphan
+        try {
+            int port = -1;
+            // JAX import in the child takes tens of seconds cold
+            for (int i = 0; i < 600 && port < 0; ++i) {
+                Thread.sleep(200);
+                if (!host.isAlive()) {
+                    throw new IOException("index host died: "
+                            + Files.readString(workDir.resolve("host.log")));
+                }
+                if (Files.exists(portFile)) {
+                    String text = Files.readString(portFile).trim();
+                    if (!text.isEmpty()) {
+                        port = Integer.parseInt(text);
+                    }
+                }
+            }
+            if (port < 0) {
+                throw new IOException(
+                        "index host never published its port");
+            }
+            this.client = new AnnClient("127.0.0.1", port, 120_000);
+            this.client.connect();
+        } catch (IOException | InterruptedException | RuntimeException e) {
+            host.destroyForcibly();
+            throw e;
+        }
+    }
+
+    /** Applied at the next {@link #build}; values must not contain
+     *  ',' or '=' (the admin $params split). */
+    public void setBuildParam(String name, String value) {
+        buildParams.put(name, value);
+    }
+
+    /** Live parameter change: before build it is queued with the build
+     *  params; after build it applies immediately ($admin:setparam,
+     *  reference SetSearchParam). */
+    public boolean setSearchParam(String name, String value)
+            throws IOException {
+        if (!built) {
+            buildParams.put(name, value);
+            return true;
+        }
+        return ok(client.search("$admin:setparam $indexname:" + indexName
+                + " $params:" + name + "=" + value));
+    }
+
+    public boolean build(float[] data, int num) throws IOException {
+        return buildRaw(AnnClient.floatsToBytes(data), num, null, false);
+    }
+
+    public boolean buildWithMetaData(float[] data, byte[][] metas, int num,
+                                     boolean withMetaIndex)
+            throws IOException {
+        return buildRaw(AnnClient.floatsToBytes(data), num, metas,
+                        withMetaIndex);
+    }
+
+    /** Raw little-endian row-major block of `valueType` values — the
+     *  ByteArray overload of the reference Build/BuildWithMetaData
+     *  (metadata rides the $admin:build line, one payload per row). */
+    public boolean buildRaw(byte[] block, int num, byte[][] metas,
+                            boolean withMetaIndex) throws IOException {
+        checkRows(block.length, num);
+        StringBuilder line = new StringBuilder("$admin:build $indexname:")
+                .append(indexName)
+                .append(" $datatype:").append(valueType)
+                .append(" $dimension:").append(dimension)
+                .append(" $algo:").append(algoType);
+        StringBuilder params = new StringBuilder();
+        for (Map.Entry<String, String> e : buildParams.entrySet()) {
+            if (params.length() > 0) {
+                params.append(',');
+            }
+            params.append(e.getKey()).append('=').append(e.getValue());
+        }
+        if (params.length() > 0) {
+            line.append(" $params:").append(params);
+        }
+        if (metas != null) {
+            line.append(" $metadata:").append(joinMetas(metas));
+            if (withMetaIndex) {
+                line.append(" $withmetaindex:1");
+            }
+        }
+        line.append(" #").append(
+                Base64.getEncoder().encodeToString(block));
+        boolean okBuild = ok(client.search(line.toString()));
+        built = built || okBuild;
+        return okBuild;
+    }
+
+    /** \x00-joined, base64 — the $metadata wire convention. */
+    private static String joinMetas(byte[][] metas) {
+        int total = 0;
+        for (byte[] m : metas) {
+            total += m.length + 1;
+        }
+        java.nio.ByteBuffer joined =
+                java.nio.ByteBuffer.allocate(Math.max(total - 1, 0));
+        for (int i = 0; i < metas.length; ++i) {
+            if (i > 0) {
+                joined.put((byte) 0);
+            }
+            joined.put(metas[i]);
+        }
+        return Base64.getEncoder().encodeToString(joined.array());
+    }
+
+    public AnnClient.SearchResult search(float[] query, int k)
+            throws IOException {
+        return searchRaw(AnnClient.floatsToBytes(query), k, false);
+    }
+
+    public AnnClient.SearchResult searchWithMetaData(float[] query, int k)
+            throws IOException {
+        return searchRaw(AnnClient.floatsToBytes(query), k, true);
+    }
+
+    public AnnClient.SearchResult searchRaw(byte[] queryBytes, int k,
+                                            boolean withMeta)
+            throws IOException {
+        String line = "$indexname:" + indexName + " $resultnum:" + k
+                + (withMeta ? " $extractmetadata:true" : "") + " #"
+                + Base64.getEncoder().encodeToString(queryBytes);
+        return client.search(line);
+    }
+
+    public boolean add(float[] data, int num) throws IOException {
+        checkRows(data.length * 4, num);
+        return ok(client.addVectors(indexName,
+                AnnClient.floatsToBytes(data), null));
+    }
+
+    public boolean addWithMetaData(float[] data, byte[][] metas, int num)
+            throws IOException {
+        checkRows(data.length * 4, num);
+        return ok(client.addVectors(indexName,
+                AnnClient.floatsToBytes(data), metas));
+    }
+
+    public boolean delete(float[] data, int num) throws IOException {
+        checkRows(data.length * 4, num);
+        return ok(client.deleteVectors(indexName,
+                AnnClient.floatsToBytes(data)));
+    }
+
+    public boolean deleteByMetaData(byte[] meta) throws IOException {
+        return ok(client.deleteByMetadata(indexName, meta));
+    }
+
+    /** Persist under the facade's private sandbox; `name` is a relative
+     *  folder name (reference Save takes a path). */
+    public boolean save(String name) throws IOException {
+        return ok(client.search("$admin:save $indexname:" + indexName
+                + " $path:" + Base64.getEncoder()
+                        .encodeToString(name.getBytes())));
+    }
+
+    /** Re-load a {@link #save}d folder into this facade (reference
+     *  static Load, collapsed onto the owning host). */
+    public boolean load(String name) throws IOException {
+        boolean okLoad = ok(client.search("$admin:load $indexname:"
+                + indexName + " $path:" + Base64.getEncoder()
+                        .encodeToString(name.getBytes())));
+        built = built || okLoad;
+        return okLoad;
+    }
+
+    public boolean readyToServe() {
+        return built && host.isAlive();
+    }
+
+    private int rowBytes() {
+        int item = valueType.equals("Float") ? 4
+                : valueType.equals("Int16") ? 2 : 1;
+        return dimension * item;
+    }
+
+    private void checkRows(int blockBytes, int num) {
+        if (num * rowBytes() != blockBytes) {
+            throw new IllegalArgumentException(
+                    "block is " + blockBytes + " bytes, expected " + num
+                    + " rows x " + rowBytes());
+        }
+    }
+
+    private static boolean ok(AnnClient.SearchResult r) {
+        return r.status == 0 && !r.results.isEmpty()
+                && r.results.get(0).indexName.startsWith("admin:ok:");
+    }
+
+    @Override
+    public void close() throws IOException {
+        try {
+            client.close();
+        } finally {
+            host.destroyForcibly();
+        }
+    }
+}
