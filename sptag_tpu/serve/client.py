@@ -221,6 +221,11 @@ class PipelinedAnnClient:
         self._next_rid = 1
         self._remote_cid = wire.INVALID_CONNECTION_ID
         self._reader: Optional[threading.Thread] = None
+        # terminal state: terminate() forbids the auto-re-dial in
+        # search() — a pool tearing down must not have an in-flight
+        # search resurrect the connection (close() alone stays
+        # re-dialable for transient-error recovery)
+        self._terminated = False
 
     # ------------------------------------------------------------ connection
 
@@ -228,11 +233,13 @@ class PipelinedAnnClient:
         with self._wlock:
             if self._sock is not None:
                 return
+            if self._terminated:
+                raise OSError("client terminated")
             sock = socket.create_connection((self.host, self.port),
                                             timeout=self.timeout_s)
-            # the reader blocks in recv indefinitely; request timeouts are
-            # enforced by the waiters, not the socket
-            sock.settimeout(None)
+            # handshake under the normal timeout (a peer that accepts TCP
+            # but never answers must not hang connect forever)...
+            sock.settimeout(self.timeout_s)
             try:
                 header = wire.PacketHeader(wire.PacketType.RegisterRequest)
                 header.body_length = 0
@@ -246,6 +253,9 @@ class PipelinedAnnClient:
             except OSError:
                 sock.close()
                 raise
+            # ...then blocking mode for the reader thread: request
+            # timeouts are enforced by the waiters, not the socket
+            sock.settimeout(None)
             self._sock = sock
             self._reader = threading.Thread(target=self._read_loop,
                                             args=(sock,), daemon=True)
@@ -261,6 +271,14 @@ class PipelinedAnnClient:
         if sock is not None:
             sock.close()
         self._fail_pending()
+
+    def terminate(self) -> None:
+        """close() plus a terminal flag: search() fails instead of
+        re-dialing.  Pool teardown uses this so an in-flight search that
+        raced past the pool's closed check cannot resurrect the
+        connection (socket + reader-thread leak)."""
+        self._terminated = True
+        self.close()
 
     def _fail_pending(self) -> None:
         with self._plock:
@@ -419,7 +437,7 @@ class AnnClientPool:
         # this they would run AFTER close and re-dial
         self._executor.shutdown(wait=False, cancel_futures=True)
         for c in self._clients:
-            c.close()
+            c.terminate()        # in-flight searches cannot re-dial
 
     def __enter__(self) -> "AnnClientPool":
         self.connect()

@@ -144,6 +144,14 @@ def pipeline(stages, done) -> None:
         # verdict item 4 follow-up: where does recall pay for width?
         plan.append(("beam_width", [py, "tools/beam_width_tune.py",
                                     "200000"], 3600, None))
+    if "7" in stages:
+        # round-5 item 2: strong-graph beam headline on chip — loads the
+        # CPU-pre-built index when present (else builds on chip, far
+        # faster than the CPU pre-build), then measures beam QPS/recall
+        # at MaxCheck 2048/8192 on the real chip
+        plan.append(("strong_beam",
+                     [py, "tools/strong_beam_build.py", "200000"], 5400,
+                     {"STRONG_BEAM_PLATFORM": "tpu"}))
     if "4" in stages:
         plan.append(("dense_tune", [py, "tools/dense_tune.py", "200000"],
                      3600, None))
@@ -172,7 +180,7 @@ def main() -> None:
     stages = args.stages.split(",")
     done = set()
     want = {"1": "bench", "2": "baseline_configs", "4": "dense_tune",
-            "5": "scale_rows", "6": "beam_width"}
+            "5": "scale_rows", "6": "beam_width", "7": "strong_beam"}
     total = len([s for s in stages if s in want]) + \
         (2 if "3" in stages else 0)
     while True:

@@ -11,10 +11,14 @@ failing the build if either client's wire bytes drift.
 Usage: python wrappers/capture_server.py <port_file> <capture_file>
 """
 
+import os
 import socket
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+# repo root (abspath: a relative invocation on Python 3.10 would
+# otherwise insert 'wrappers' and break the sptag_tpu import)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 from sptag_tpu.serve import wire  # noqa: E402
 

@@ -157,20 +157,27 @@ namespace SPTAG
                 .Append(name);
             if (metadata != null)
             {
-                using var joined = new MemoryStream();
-                for (int i = 0; i < metadata.Length; ++i)
-                {
-                    if (i > 0)
-                    {
-                        joined.WriteByte(0);           // \x00 separator
-                    }
-                    joined.Write(metadata[i], 0, metadata[i].Length);
-                }
-                sb.Append(" $metadata:").Append(
-                    Convert.ToBase64String(joined.ToArray()));
+                sb.Append(" $metadata:").Append(EncodeMetas(metadata));
             }
             sb.Append(" #").Append(Convert.ToBase64String(rawBlock));
             return Search(sb.ToString());
+        }
+
+        /// <summary>One payload per row, \x00-joined, base64 — the
+        /// $metadata wire convention shared by the add and build admin
+        /// ops.</summary>
+        public static string EncodeMetas(byte[][] metadata)
+        {
+            using var joined = new MemoryStream();
+            for (int i = 0; i < metadata.Length; ++i)
+            {
+                if (i > 0)
+                {
+                    joined.WriteByte(0);               // \x00 separator
+                }
+                joined.Write(metadata[i], 0, metadata[i].Length);
+            }
+            return Convert.ToBase64String(joined.ToArray());
         }
 
         /// <summary>Delete-by-content: rows whose stored vector matches

@@ -183,7 +183,8 @@ namespace SPTAG
             }
             if (metas != null)
             {
-                line.Append(" $metadata:").Append(JoinMetas(metas));
+                line.Append(" $metadata:").Append(
+                    AnnClient.EncodeMetas(metas));
                 if (withMetaIndex)
                 {
                     line.Append(" $withmetaindex:1");
@@ -281,27 +282,6 @@ namespace SPTAG
                     "block is " + blockBytes + " bytes, expected " + num
                     + " rows x " + RowBytes());
             }
-        }
-
-        private static string JoinMetas(byte[][] metas)
-        {
-            int total = 0;
-            foreach (byte[] m in metas)
-            {
-                total += m.Length + 1;
-            }
-            var joined = new byte[Math.Max(total - 1, 0)];
-            int off = 0;
-            for (int i = 0; i < metas.Length; ++i)
-            {
-                if (i > 0)
-                {
-                    joined[off++] = 0;
-                }
-                Buffer.BlockCopy(metas[i], 0, joined, off, metas[i].Length);
-                off += metas[i].Length;
-            }
-            return Convert.ToBase64String(joined);
         }
 
         private static bool Ok(AnnClient.SearchResult r)

@@ -153,24 +153,28 @@ public final class AnnClient implements AutoCloseable {
         StringBuilder sb = new StringBuilder("$admin:add $indexname:")
                 .append(name);
         if (metadata != null) {
-            int total = 0;
-            for (byte[] m : metadata) {
-                total += m.length + 1;
-            }
-            ByteBuffer joined = ByteBuffer.allocate(Math.max(total - 1, 0));
-            for (int i = 0; i < metadata.length; ++i) {
-                if (i > 0) {
-                    joined.put((byte) 0);              // \x00 separator
-                }
-                joined.put(metadata[i]);
-            }
-            sb.append(" $metadata:").append(
-                    java.util.Base64.getEncoder()
-                            .encodeToString(joined.array()));
+            sb.append(" $metadata:").append(encodeMetas(metadata));
         }
         sb.append(" #").append(
                 java.util.Base64.getEncoder().encodeToString(rawBlock));
         return search(sb.toString());
+    }
+
+    /** One payload per row, \x00-joined, base64 — the `$metadata` wire
+     *  convention shared by the add and build admin ops. */
+    public static String encodeMetas(byte[][] metadata) {
+        int total = 0;
+        for (byte[] m : metadata) {
+            total += m.length + 1;
+        }
+        ByteBuffer joined = ByteBuffer.allocate(Math.max(total - 1, 0));
+        for (int i = 0; i < metadata.length; ++i) {
+            if (i > 0) {
+                joined.put((byte) 0);                  // \x00 separator
+            }
+            joined.put(metadata[i]);
+        }
+        return java.util.Base64.getEncoder().encodeToString(joined.array());
     }
 
     /** Delete-by-content: rows whose stored vector matches exactly. */

@@ -138,7 +138,7 @@ public final class AnnIndex implements AutoCloseable {
             line.append(" $params:").append(params);
         }
         if (metas != null) {
-            line.append(" $metadata:").append(joinMetas(metas));
+            line.append(" $metadata:").append(AnnClient.encodeMetas(metas));
             if (withMetaIndex) {
                 line.append(" $withmetaindex:1");
             }
@@ -148,23 +148,6 @@ public final class AnnIndex implements AutoCloseable {
         boolean okBuild = ok(client.search(line.toString()));
         built = built || okBuild;
         return okBuild;
-    }
-
-    /** \x00-joined, base64 — the $metadata wire convention. */
-    private static String joinMetas(byte[][] metas) {
-        int total = 0;
-        for (byte[] m : metas) {
-            total += m.length + 1;
-        }
-        java.nio.ByteBuffer joined =
-                java.nio.ByteBuffer.allocate(Math.max(total - 1, 0));
-        for (int i = 0; i < metas.length; ++i) {
-            if (i > 0) {
-                joined.put((byte) 0);
-            }
-            joined.put(metas[i]);
-        }
-        return Base64.getEncoder().encodeToString(joined.array());
     }
 
     public AnnClient.SearchResult search(float[] query, int k)

@@ -9,9 +9,13 @@ Usage: python wrappers/lifecycle_server.py <port_file>
 """
 
 import asyncio
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])   # repo root
+# repo root (abspath: a relative invocation on Python 3.10 would
+# otherwise insert 'wrappers' and break the sptag_tpu import)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 async def main() -> None:
