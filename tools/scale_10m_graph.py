@@ -23,6 +23,7 @@ scale), RefineIterations=1, small TPT fanout.
 
 import argparse
 import json
+import logging
 import os
 import signal
 import sys
@@ -58,6 +59,13 @@ def main():
     ap.add_argument("--ckpt", default=os.path.join(REPO, ".bench_cache",
                                                    "scale10m_ckpt"))
     args = ap.parse_args()
+
+    # INFO: the per-pass sampled graph-accuracy lines (graph/rng.py
+    # "RNG refine pass i/n width=w acc=a") are the build-quality log the
+    # refined run exists to produce — without this they are dropped
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(message)s")
 
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
