@@ -1,0 +1,100 @@
+"""Beam-width budget-efficiency study (VERDICT r4 item 2 analysis).
+
+The reference walk is strictly serial best-first: pop ONE node, expand,
+push (BKTIndex.cpp:105-157) — maximal budget efficiency (every scored
+candidate was the best known frontier node at its time), minimal wall
+speed.  The TPU walk pops B nodes per iteration so the whole batch rides
+one compiled loop of T = ceil(MaxCheck/B) steps; wider B cuts the SERIAL
+iteration count (the chip's real cost — the loop is overhead-bound, not
+bandwidth-bound) but spends budget on pops that serial ordering would
+have refined away.
+
+This tool measures that trade on one graph: recall@10 and wall time at
+fixed MaxCheck across B in {1, 8, 32, 128} (B=1 approximates the
+reference's ordering with batch parallelism over queries).  The gap
+between B=1 and wide-B recall at equal MaxCheck IS the width tax; the
+wall-time column is why the wide beam exists.
+
+Monkeypatches `engine.beam_width_for` (which deliberately FLOORS the
+width at the autoscale) to honor the requested B exactly.
+
+Usage: python tools/beam_serial_floor.py [n] [queries]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import sptag_tpu as sp
+    from sptag_tpu.algo import engine as eng
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    nq = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    d = 64
+    rng = np.random.default_rng(3)
+    centers = rng.standard_normal((256, d)).astype(np.float32) * 4.0
+    data = (centers[rng.integers(0, 256, n)]
+            + rng.standard_normal((n, d)).astype(np.float32))
+    queries = data[rng.integers(0, n, nq)] + 0.05 * rng.standard_normal(
+        (nq, d)).astype(np.float32)
+
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    for name, value in [("BKTNumber", "1"), ("BKTKmeansK", "16"),
+                        ("TPTNumber", "8"), ("TPTLeafSize", "500"),
+                        ("NeighborhoodSize", "32"), ("CEF", "256"),
+                        ("MaxCheckForRefineGraph", "512"),
+                        ("RefineIterations", "2"),
+                        ("FinalRefineSearchMode", "same"),
+                        ("SearchMode", "beam")]:
+        assert index.set_parameter(name, value), name
+    t0 = time.time()
+    index.build(data)
+    print(f"[floor] build {time.time() - t0:.0f}s", flush=True)
+
+    exact = ((queries ** 2).sum(1)[:, None] + (data ** 2).sum(1)[None, :]
+             - 2.0 * queries @ data.T)
+    truth = np.argsort(exact, axis=1)[:, :10]
+
+    def recall(ids):
+        return float(np.mean([
+            len(set(int(v) for v in ids[q] if v >= 0)
+                & set(int(v) for v in truth[q])) / 10 for q in range(nq)]))
+
+    orig = eng.beam_width_for
+    rows = []
+    try:
+        for mc in (512, 2048):
+            for B in (1, 8, 32, 128):
+                eng.beam_width_for = \
+                    lambda bw, m, L, _B=B: max(1, min(_B, L))
+                # warm compile at this (B, T) shape
+                index.search_batch(queries, 10, max_check=mc)
+                t0 = time.time()
+                _, ids = index.search_batch(queries, 10, max_check=mc)
+                dt = time.time() - t0
+                rows.append({"max_check": mc, "B": B,
+                             "recall_at_10": round(recall(ids), 4),
+                             "wall_s": round(dt, 2),
+                             "qps": round(nq / dt, 1)})
+                print(f"[floor] mc={mc} B={B}: recall "
+                      f"{rows[-1]['recall_at_10']} wall "
+                      f"{rows[-1]['wall_s']}s", flush=True)
+    finally:
+        eng.beam_width_for = orig
+    print(json.dumps({"n": n, "queries": nq, "rows": rows}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
