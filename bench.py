@@ -268,6 +268,22 @@ def strong_cache_folder(n):
                         f"bkt_f32_strong_n{n}_v{CACHE_VERSION}_p{fp}")
 
 
+def cache_folder(tag):
+    """THE cache-folder formula — shared by build_or_load and
+    tools/prebuild_bench_cache.py so the two can never desynchronize."""
+    return os.path.join(
+        CACHE_DIR, f"{tag}_v{CACHE_VERSION}_p{_params_fingerprint()}")
+
+
+def cache_ready(tag):
+    """True when `tag`'s cached index is complete on disk (save_index's
+    rename-swap makes indexloader.ini the completeness sentinel) — the
+    one readiness predicate shared by build_or_load, the prebuild tool,
+    and tpu_watch's warm-stage gate."""
+    return os.path.exists(os.path.join(cache_folder(tag),
+                                       "indexloader.ini"))
+
+
 def build_or_load(tag, builder, budget_s):
     """Disk-cached index build; returns (index, build_s, cached).
 
@@ -278,11 +294,8 @@ def build_or_load(tag, builder, budget_s):
     of the deployed system, not a benchmark artifact."""
     import sptag_tpu as sp
 
-    folder = os.path.join(
-        CACHE_DIR, f"{tag}_v{CACHE_VERSION}_p{_params_fingerprint()}")
-    if os.environ.get("BENCH_COLD_BUILD") != "1" and \
-            os.path.isdir(os.path.join(folder)) and \
-            os.path.exists(os.path.join(folder, "indexloader.ini")):
+    folder = cache_folder(tag)
+    if os.environ.get("BENCH_COLD_BUILD") != "1" and cache_ready(tag):
         t0 = time.perf_counter()
         index = sp.load_index(folder)
         return index, time.perf_counter() - t0, True
@@ -339,6 +352,64 @@ def _bkt_params(index, n):
     for name, value in ([("BKTNumber", "1"), ("BKTKmeansK", "32")]
                         + _GRAPH_PARAMS):
         index.set_parameter(name, value)
+
+
+# The three disk-cached bench indexes as standalone builders, shared with
+# tools/prebuild_bench_cache.py: the CPU pre-build and the measured bench
+# must construct IDENTICAL indexes, and the cache fingerprint only covers
+# _GRAPH_PARAMS — a drifted copy of these closures would poison the cache
+# without invalidating it (round-5 review finding).  Each regenerates its
+# (seeded, deterministic) corpus so it is self-contained.
+
+def build_headline_f32(n=200_000, data=None):
+    import sptag_tpu as sp
+
+    if data is None:
+        data, _ = make_dataset(n=n, nq=4096)
+    index = sp.create_instance("BKT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    _bkt_params(index, n)
+    index.build(data)
+    return index
+
+
+def build_headline_i8(n8=50_000, data=None):
+    import sptag_tpu as sp
+
+    if data is None:
+        data, _ = make_dataset(n=n8, nq=2048, dtype=np.int8)
+    idx8 = sp.create_instance("BKT", "Int8")
+    idx8.set_parameter("DistCalcMethod", "Cosine")
+    _bkt_params(idx8, n8)
+    idx8.build(data)
+    return idx8
+
+
+def headline_build_specs(n=200_000):
+    """(tag, builder) for every disk-cached bench index at corpus size
+    `n`, tags and sub-corpus sizing (min(n, 50k) for int8/KDT) formatted
+    exactly as run_bench's call sites format them — the single list
+    tools/prebuild_bench_cache.py iterates and tools/tpu_watch.py gates
+    its warm bench stage on, so tag drift is impossible at any `n`."""
+    n8 = min(n, 50_000)
+    return [
+        (f"bkt_f32_n{n}", lambda: build_headline_f32(n)),
+        (f"bkt_i8_n{n8}", lambda: build_headline_i8(n8)),
+        (f"kdt_f32_cos_d100_n{n8}", lambda: build_headline_kdt(n8)),
+    ]
+
+
+def build_headline_kdt(nk=50_000, data=None):
+    import sptag_tpu as sp
+
+    if data is None:
+        data, _ = make_dataset(n=nk, d=100, nq=200)
+    idxk = sp.create_instance("KDT", "Float")
+    idxk.set_parameter("DistCalcMethod", "Cosine")
+    for name, value in ([("KDTNumber", "2")] + _GRAPH_PARAMS):
+        idxk.set_parameter(name, value)
+    idxk.build(data)
+    return idxk
 
 
 def timed_sweep(index, queries, k, batch, budget_s, repeats=3):
@@ -509,16 +580,10 @@ def run_bench():
         # full ground truth from the same code path (disk-cached)
         truth = l2_truth(data, queries, k)
 
-        def build():
-            index = sp.create_instance("BKT", "Float")
-            index.set_parameter("DistCalcMethod", "L2")
-            _bkt_params(index, n)
-            index.build(data)
-            return index
-
         with trace.span("bench.build_or_load"):
-            index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build,
-                                                   budget_s)
+            index, build_s, cached = build_or_load(
+                f"bkt_f32_n{n}", lambda: build_headline_f32(n, data),
+                budget_s)
         # f32 headline runs UNGROUPED: on this corpus (256 loose centers)
         # grouped probing at union_factor 2 measured recall 0.824 vs 0.967
         # ungrouped — probe sharing is too weak.  int8 below opts in (its
@@ -633,16 +698,10 @@ def run_bench():
             data8, queries8 = make_dataset(n=n8, nq=2048, dtype=np.int8)
             truth8 = cosine_truth(data8, queries8, k)
 
-            def build8():
-                idx8 = sp.create_instance("BKT", "Int8")
-                idx8.set_parameter("DistCalcMethod", "Cosine")
-                _bkt_params(idx8, n8)
-                idx8.build(data8)
-                return idx8
-
             try:
                 idx8, build8_s, cached8 = build_or_load(
-                    f"bkt_i8_n{n8}", build8, budget_s)
+                    f"bkt_i8_n{n8}", lambda: build_headline_i8(n8, data8),
+                    budget_s)
                 idx8.set_parameter("DenseQueryGroup", "32")
                 idx8.set_parameter("DenseUnionFactor", "4")
                 ids8, qps8, _ = timed_sweep(idx8, queries8, k, batch,
@@ -668,17 +727,9 @@ def run_bench():
                 datak, queriesk = make_dataset(n=nk, d=100, nq=200)
                 truthk = cosine_truth(datak, queriesk, k)
 
-                def buildk():
-                    idxk = sp.create_instance("KDT", "Float")
-                    idxk.set_parameter("DistCalcMethod", "Cosine")
-                    for name, value in ([("KDTNumber", "2")]
-                                        + _GRAPH_PARAMS):
-                        idxk.set_parameter(name, value)
-                    idxk.build(datak)
-                    return idxk
-
                 idxk, buildk_s, cachedk = build_or_load(
-                    f"kdt_f32_cos_d100_n{nk}", buildk, budget_s)
+                    f"kdt_f32_cos_d100_n{nk}",
+                    lambda: build_headline_kdt(nk, datak), budget_s)
                 idsk, qpsk, _ = timed_sweep(idxk, queriesk, k, batch,
                                             budget_s, repeats=1)
                 result.update({

@@ -473,12 +473,16 @@ class VectorIndex(abc.ABC):
             # other's staging directory mid-write
             existing = os.path.exists(
                 os.path.join(folder, "indexloader.ini"))
-            target = folder
-            if existing:
-                # unique staging/backup names: a predictable ".saving"
-                # could collide with (and rmtree) unrelated user data
-                token = f"{os.getpid()}-{threading.get_ident()}"
-                target = folder.rstrip("/\\") + f".saving-{token}"
+            # ALWAYS stage (round 5): a fresh save used to write straight
+            # into `folder`, indexloader.ini first — a crash mid-save left
+            # a folder that passes the "indexloader.ini exists"
+            # completeness check with truncated data files.  Staging +
+            # rename makes indexloader.ini a true completeness sentinel
+            # for fresh and overwrite saves alike.
+            # unique staging/backup names: a predictable ".saving"
+            # could collide with (and rmtree) unrelated user data
+            token = f"{os.getpid()}-{threading.get_ident()}"
+            target = folder.rstrip("/\\") + f".saving-{token}"
             os.makedirs(target, exist_ok=True)
             if self.need_refine:
                 self._refine_impl()
@@ -500,6 +504,32 @@ class VectorIndex(abc.ABC):
                     shutil.rmtree(backup)
                 except OSError:
                     pass
+            elif not os.path.exists(folder):
+                try:
+                    os.rename(target, folder)
+                except OSError:
+                    # a concurrent saver won the fresh-create race (the
+                    # rename target now exists): their complete index is
+                    # in place — discard our staging and report success
+                    if not os.path.exists(
+                            os.path.join(folder, "indexloader.ini")):
+                        raise
+                    try:
+                        shutil.rmtree(target)
+                    except OSError:
+                        pass
+            else:
+                # pre-created non-index folder (may hold unrelated user
+                # files — reference semantics write into it, never wipe
+                # it): move the staged files in one by one with
+                # indexloader.ini LAST, so the sentinel never exists
+                # before the data it vouches for
+                names = [nm for nm in os.listdir(target)
+                         if nm != "indexloader.ini"]
+                for nm in names + ["indexloader.ini"]:
+                    os.replace(os.path.join(target, nm),
+                               os.path.join(folder, nm))
+                shutil.rmtree(target, ignore_errors=True)
         return ErrorCode.Success
 
     # ---- in-memory blob persistence (embedding-host path) -----------------

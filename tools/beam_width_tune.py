@@ -40,8 +40,7 @@ def main():
 
     enable_compile_cache()
 
-    import sptag_tpu as sp
-    from bench import (make_dataset, _bkt_params, l2_truth, build_or_load,
+    from bench import (make_dataset, l2_truth, build_or_load,
                        recall_at_k)
 
     k = 10
@@ -53,14 +52,10 @@ def main():
     data, queries = make_dataset(n=n, nq=nq)
     truth = l2_truth(data, queries, k)
 
-    def build():
-        index = sp.create_instance("BKT", "Float")
-        index.set_parameter("DistCalcMethod", "L2")
-        _bkt_params(index, n)
-        index.build(data)
-        return index
+    from bench import build_headline_f32
 
-    index, build_s, cached = build_or_load(f"bkt_f32_n{n}", build, 1e9)
+    index, build_s, cached = build_or_load(
+        f"bkt_f32_n{n}", lambda: build_headline_f32(n, data), 1e9)
     index.set_parameter("SearchMode", "beam")
     dev = jax.devices()[0].platform
 

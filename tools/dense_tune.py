@@ -30,7 +30,6 @@ def main():
 
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     import bench
-    import sptag_tpu as sp
     from sptag_tpu.utils import enable_compile_cache
 
     enable_compile_cache()
@@ -39,15 +38,9 @@ def main():
     data, queries = bench.make_dataset(n=n, nq=4096)
     truth = bench.l2_truth(data, queries, k)
 
-    def build():
-        idx = sp.create_instance("BKT", "Float")
-        idx.set_parameter("DistCalcMethod", "L2")
-        bench._bkt_params(idx, n)
-        idx.build(data)
-        return idx
-
-    index, build_s, cached = bench.build_or_load(f"bkt_f32_n{n}", build,
-                                                 budget_s=1e9)
+    index, build_s, cached = bench.build_or_load(
+        f"bkt_f32_n{n}", lambda: bench.build_headline_f32(n, data),
+        budget_s=1e9)
     rows = []
     # (group, union_factor, nq_in_flight): grouped configs first at the
     # bench's 4096, then batch-depth on the best-known ungrouped config

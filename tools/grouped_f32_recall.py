@@ -37,22 +37,15 @@ def main():
         # from initializing (and hanging on a down tunnel) — pin the list
         jax.config.update("jax_platforms", "cpu")
     import bench
-    import sptag_tpu as sp
     from sptag_tpu.utils import enable_compile_cache
 
     enable_compile_cache()
     data, queries = bench.make_dataset(n=n, nq=nq)
     truth = bench.l2_truth(data, queries, 10)
 
-    def build():
-        idx = sp.create_instance("BKT", "Float")
-        idx.set_parameter("DistCalcMethod", "L2")
-        bench._bkt_params(idx, n)
-        idx.build(data)
-        return idx
-
-    index, build_s, cached = bench.build_or_load(f"bkt_f32_n{n}", build,
-                                                 budget_s=1e9)
+    index, build_s, cached = bench.build_or_load(
+        f"bkt_f32_n{n}", lambda: bench.build_headline_f32(n, data),
+        budget_s=1e9)
     print(json.dumps({"n": n, "nq": nq, "build_s": round(build_s, 1),
                       "cached": cached}), flush=True)
 

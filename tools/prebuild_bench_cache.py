@@ -1,0 +1,90 @@
+"""Pre-build the bench's disk-cached indexes on the CPU backend.
+
+The chip cold build is compile-dominated (~78 XLA shapes at 20-40 s each
+through the tunnel — reports/BUILD_TIME.md), and round-5's observed tunnel
+windows (~35 min) are shorter than one cold build.  Building the SAME
+indexes here (CPU backend, local fast compiles) into `bench.build_or_load`'s
+cache folders lets a recovered tunnel window spend its minutes on
+measurement: the chip run then only compiles the search-side shapes.
+
+The builders are bench.py's own (`build_headline_*`) so the cache keys AND
+build semantics match by construction.  An exclusive flock serializes
+concurrent invocations (tools/tpu_watch.py runs this as its stage 0; a
+manual run may already hold the lock) and the resumable-build checkpoint
+root is shared with bench so a build interrupted anywhere — including a
+chip build killed by a tunnel death — resumes instead of restarting.
+Safe to re-run; skips folders that already exist.
+"""
+
+import fcntl
+import os
+import shutil
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Force the CPU backend: the session env pins JAX_PLATFORMS=axon (the
+# tunnel), and a CPU pre-build is this tool's whole point.  Env alone is
+# not enough — sitecustomize imports jax at interpreter start, so the
+# config must be re-pinned post-import (tests/conftest.py does the same);
+# a dead tunnel otherwise hangs jax.devices() in the axon plugin's
+# connect/backoff loop.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import bench  # noqa: E402
+
+
+def prebuild(tag, builder):
+    if bench.cache_ready(tag):
+        print(f"[prebuild] {tag}: cached already", flush=True)
+        return
+    t0 = time.time()
+    index = builder()
+    index.save_index(bench.cache_folder(tag))
+    print(f"[prebuild] {tag}: built+saved in {time.time()-t0:.0f}s",
+          flush=True)
+
+
+def main() -> None:
+    os.makedirs(bench.CACHE_DIR, exist_ok=True)
+    # force, matching build_or_load (which overrides the env to this same
+    # path): an inherited SPTAG_TPU_BUILD_CKPT pointing elsewhere would
+    # hide the chip build's checkpoints and silently break cross-resume
+    os.environ["SPTAG_TPU_BUILD_CKPT"] = os.path.join(
+        bench.CACHE_DIR, "build_ckpt")
+    lock = open(os.path.join(bench.CACHE_DIR, "prebuild.lock"), "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)      # blocks behind a running instance
+    try:
+        # sweep staging/backup orphans from killed saves (the staged
+        # save_index leaves ~100 MB `.saving-*`/`.old-*` siblings when a
+        # process dies mid-save — routine here: machine resets, stage
+        # deadlines).  Age-gated so a save in flight right now is never
+        # touched; under the flock so sweeps can't race each other.
+        now = time.time()
+        for name in os.listdir(bench.CACHE_DIR):
+            if ".saving-" not in name and ".old-" not in name:
+                continue
+            path = os.path.join(bench.CACHE_DIR, name)
+            try:
+                if now - os.path.getmtime(path) > 3600:
+                    shutil.rmtree(path, ignore_errors=True)
+                    print(f"[prebuild] swept stale {name}", flush=True)
+            except OSError:
+                pass
+        # specs ordered long-pole (200k f32) first so a partial run still
+        # covers the headline index
+        for tag, builder in bench.headline_build_specs():
+            prebuild(tag, builder)
+        print("[prebuild] done", flush=True)
+    finally:
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
+if __name__ == "__main__":
+    main()

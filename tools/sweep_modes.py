@@ -36,6 +36,7 @@ def main():
 
     import sptag_tpu as sp
     from bench import (make_dataset, _bkt_params, l2_truth, build_or_load,
+                       build_headline_f32,
                        recall_at_k)
 
     k = 10
@@ -55,11 +56,16 @@ def main():
     refine = int(os.environ.get("SWEEP_REFINE_BUDGET", "0"))
 
     def build():
+        # refine==0 writes the SHARED bkt_f32_n{n} tag — must be the
+        # bench's own builder so the cache cannot drift (bench.py comment
+        # above build_headline_f32); the refine override builds under its
+        # own suffixed tag and layers the one extra param on top
+        if not refine:
+            return build_headline_f32(n, data)
         index = sp.create_instance("BKT", "Float")
         index.set_parameter("DistCalcMethod", "L2")
         _bkt_params(index, n)
-        if refine:
-            index.set_parameter("MaxCheckForRefineGraph", str(refine))
+        index.set_parameter("MaxCheckForRefineGraph", str(refine))
         index.build(data)
         return index
 
