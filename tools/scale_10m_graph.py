@@ -108,8 +108,16 @@ def main():
           flush=True)
 
     params = {
-        "BKTNumber": 1, "BKTKmeansK": 32, "TPTNumber": 4,
-        "TPTLeafSize": 1000, "NeighborhoodSize": 32, "CEF": 64,
+        "BKTNumber": 1, "BKTKmeansK": 32,
+        # round-5 measured: at 10M the refined run with speed knobs
+        # (TPT 4, CEF 64, refine budget 256) came out WORSE than
+        # candidates-only (0.469 vs 0.589 @2048) — the starved refine
+        # (nprobe=1 per search) replaces TPT candidate edges with
+        # near-random results.  Candidate-graph quality (TPT count, CEF)
+        # is the honest lever at this scale; both overridable.
+        "TPTNumber": int(os.environ.get("SCALE10M_TPT", "4")),
+        "TPTLeafSize": 1000, "NeighborhoodSize": 32,
+        "CEF": int(os.environ.get("SCALE10M_CEF", "64")),
         # SCALE10M_REFINE=0 selects the candidates-only graph (TPT
         # all-pairs + RNG prune + connectivity repair, no re-search
         # passes) — the wall-time-bounded configuration for the 10M CPU
