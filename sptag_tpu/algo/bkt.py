@@ -141,7 +141,8 @@ class BKTIndex(VectorIndex):
             tpt_leaf_size=p.tpt_leaf_size,
             neighborhood_scale=p.neighborhood_scale, cef_scale=p.cef_scale,
             refine_iterations=p.refine_iterations, cef=p.cef,
-            tpt_top_dims=p.tpt_top_dims, tpt_samples=p.samples)
+            tpt_top_dims=p.tpt_top_dims, tpt_samples=p.samples,
+            refine_accuracy_guard=bool(p.refine_accuracy_guard))
 
     def _pivot_ids(self) -> np.ndarray:
         max_pivots = min(self._n, pivot_budget(self.params))
@@ -318,10 +319,19 @@ class BKTIndex(VectorIndex):
             return
         try:
             with trace.span("build.rng_graph"):
+                p = self.params
+                fmode = getattr(p, "final_refine_search_mode", "beam")
+                # the final pass may run a DIFFERENT engine to optimize
+                # walk navigability (FinalRefineSearchMode guardrail) —
+                # sampled precision@m cannot judge that pass, so the
+                # accuracy guard must not roll it back
+                same_engine = fmode == "same" or \
+                    fmode == getattr(p, "refine_search_mode", "beam")
                 self._graph.build(self._host[:self._n],
                                   int(self.dist_calc_method), self.base,
                                   self._refine_search_factory,
-                                  checkpoint=checkpoint)
+                                  checkpoint=checkpoint,
+                                  guard_final=same_engine)
         finally:
             # free the mid-build device snapshot even when the build dies
             self._refine_dense_cache = None
@@ -363,6 +373,26 @@ class BKTIndex(VectorIndex):
             else:
                 searcher = self._build_dense_searcher(replicas=1)
                 self._refine_dense_cache = (key, searcher)
+                # starvation check at the SOURCE (round 5, measured at
+                # 10M: budget 256 over ~5,700 clusters probes nprobe=1 —
+                # one cluster — and the refine pass replaced TPT edges
+                # with near-random results, recall 0.589 -> 0.469;
+                # reports/SCALE.md).  Warn when the refine budget covers
+                # fewer than two probes of the partition it searches.
+                # the search closure below runs max_check=max(budget, 2k)
+                # with k=cef+1, so judge the EFFECTIVE budget (the final
+                # pass's cef — non-final passes run wider still)
+                eff = max(budget, 2 * (p.cef + 1))
+                nprobe_est = max(1, -(-eff // searcher.cluster_size))
+                if searcher.num_clusters >= 8 and nprobe_est < 2:
+                    log.warning(
+                        "dense refine budget MaxCheckForRefineGraph=%d "
+                        "(effective %d) probes only %d of %d clusters "
+                        "(cluster size %d) — refine at this coverage can "
+                        "DEGRADE the graph (reports/SCALE.md round-5); "
+                        "raise the budget or set RefineIterations=0",
+                        budget, eff, nprobe_est, searcher.num_clusters,
+                        searcher.cluster_size)
 
             # grouped probing helps refine especially — its queries ARE
             # corpus rows, maximally probe-local after the partition sort.

@@ -92,6 +92,11 @@ _GRAPH_SPECS = [
     _spec("cef", int, 1000, "CEF"),
     _spec("add_cef", int, 500, "AddCEF"),
     _spec("max_check_for_refine_graph", int, 8192, "MaxCheckForRefineGraph"),
+    # TPU-side addition (no reference counterpart): roll back a refine
+    # pass that lowers sampled graph accuracy by > 0.02 — measured at 10M
+    # (reports/SCALE.md round-5): a budget-starved refine pass replaces
+    # TPT candidate edges with near-random search results
+    _spec("refine_accuracy_guard", int, 1, "RefineAccuracyGuard"),
 ]
 
 _COMMON_TAIL_SPECS = [

@@ -63,7 +63,8 @@ class RelativeNeighborhoodGraph:
                  tpt_leaf_size: int = 2000, neighborhood_scale: int = 2,
                  cef_scale: int = 2, refine_iterations: int = 2,
                  cef: int = 1000, tpt_top_dims: int = 5,
-                 tpt_samples: int = 1000):
+                 tpt_samples: int = 1000,
+                 refine_accuracy_guard: bool = True):
         self.neighborhood_size = neighborhood_size
         self.tpt_number = tpt_number
         self.tpt_leaf_size = tpt_leaf_size
@@ -73,6 +74,7 @@ class RelativeNeighborhoodGraph:
         self.cef = cef
         self.tpt_top_dims = tpt_top_dims
         self.tpt_samples = tpt_samples
+        self.refine_accuracy_guard = refine_accuracy_guard
         # (N, row_width) int32 neighbor ids, -1 padded.  Width is
         # neighborhood_size after the final refine; candidate-width before.
         self.graph = np.zeros((0, neighborhood_size), np.int32)
@@ -81,7 +83,8 @@ class RelativeNeighborhoodGraph:
 
     def build(self, data: np.ndarray, metric: int, base: int,
               search_fn_factory: Optional[Callable[..., SearchFn]] = None,
-              seed: int = 31, checkpoint=None) -> None:
+              seed: int = 31, checkpoint=None,
+              guard_final: bool = True) -> None:
         """Full build: TPT candidates, then refine passes.
 
         `search_fn_factory(graph, final=bool)` returns a SearchFn over
@@ -133,9 +136,38 @@ class RelativeNeighborhoodGraph:
                     width_wide if passes > 0 else m, metric, base)
             log.info("RNG initial prune width=%d",
                      width_wide if passes > 0 else m)
+        # Accuracy guard (round 5, measured at 10M: a refine pass whose
+        # search budget is starved — nprobe=1 over the shard's partition —
+        # REPLACES good TPT candidate edges with near-random results,
+        # taking recall@2048 from 0.589 to 0.469; reports/SCALE.md).  The
+        # estimator's sample is seeded, so pre/post is a PAIRED
+        # comparison on the same 100 nodes.  A pass that both drops the
+        # paired estimate and lands below a catastrophic absolute floor
+        # (see the rollback condition below) is rolled back and the
+        # remaining passes skipped — they would redo the same damage.
+        # skip the guard's (samples, N) truth sweep entirely when rollback
+        # is structurally impossible: with guard_final=False (engine-
+        # switch final pass) and a single pass, no pass could ever roll
+        # back
+        guard = self.refine_accuracy_guard and passes > 0 and \
+            (guard_final or passes > 1)
+        acc_truth = pre_acc = None
+        if guard and start < passes:
+            # truth once per build (the (100, N) sweep dominates the
+            # estimate); width=m for EVERY guard estimate so pre/post is
+            # a paired comparison of the same quantity — the raw metric's
+            # value depends on stored row width, and rows are m wide
+            # after the final pass but m*scale before it
+            acc_truth = self.accuracy_truth(data, metric, base, width=m)
+            pre_acc = self.accuracy_estimation(data, metric, base,
+                                               width=m, truth=acc_truth)
         for it in range(start, passes):
             last = it == passes - 1
             width = m if last else width_wide
+            # alias, not copy: refine_once is double-buffered (builds
+            # new_graph and reassigns) so the pre-pass array is never
+            # mutated; the rollback branch copies when it truncates
+            before = self.graph if guard else None
             with trace.span("build.refine_pass"):
                 # the factory learns which pass this is: the FINAL pass
                 # defines the saved edges, and the index may route it
@@ -147,12 +179,46 @@ class RelativeNeighborhoodGraph:
                                       else self.cef * self.cef_scale))
             # sampled graph-accuracy log per pass — reference RefineGraph
             # prints GraphAccuracyEstimation after every iteration
-            # (NeighborhoodGraph.h:123,134).  Guarded: the estimate costs
-            # a (100, N) distance pass, skip it when nobody listens
-            if log.isEnabledFor(logging.INFO):
+            # (NeighborhoodGraph.h:123,134).  With the guard on it is
+            # also the rollback signal; without, the estimate costs a
+            # (100, N) distance pass, so skip it when nobody listens
+            if guard or log.isEnabledFor(logging.INFO):
+                acc = self.accuracy_estimation(data, metric, base,
+                                               width=(m if guard else None),
+                                               truth=acc_truth)
                 log.info("RNG refine pass %d/%d width=%d acc=%.4f",
-                         it + 1, passes, width,
-                         self.accuracy_estimation(data, metric, base))
+                         it + 1, passes, width, acc)
+                # Rollback needs BOTH a drop and a catastrophic absolute
+                # floor: RNG refine over a richer pool legitimately
+                # LOWERS precision@m (it prunes occluded near neighbors
+                # for diverse far edges — measured 0.90 -> 0.69 on a
+                # healthy 4k default build), so a relative threshold
+                # alone would roll back good passes.  The 10M failure
+                # mode this guards (budget-starved searches replacing TPT
+                # edges with noise) lands far below any legitimate refine
+                # outcome observed (0.22-0.24 vs >= 0.5 on every healthy
+                # build).  An engine-switch final pass
+                # (FinalRefineSearchMode != RefineSearchMode) is measured
+                # but never rolled back: it optimizes walk NAVIGABILITY,
+                # which precision@m does not measure (the caller signals
+                # this via guard_final=False).
+                if guard and acc < pre_acc - 0.02 and acc < 0.35 and \
+                        (guard_final or not last):
+                    log.warning(
+                        "RNG refine pass %d/%d DEGRADED sampled graph "
+                        "accuracy %.4f -> %.4f (starved search budget? "
+                        "MaxCheckForRefineGraph raises it) — pass rolled "
+                        "back, remaining passes skipped; set "
+                        "RefineAccuracyGuard=0 to keep degrading passes",
+                        it + 1, passes, pre_acc, acc)
+                    # the restored graph may still be at candidate width
+                    # (the final pass normally narrows to m); rows are in
+                    # RNG-keep order (ascending distance among kept), so
+                    # truncation keeps the top-m RNG picks
+                    self.graph = (before[:, :m].copy()
+                                  if before.shape[1] > m else before)
+                    break
+                pre_acc = acc
             if checkpoint is not None and not last:
                 # the final pass is not checkpointed: the full build's own
                 # save (or the bench cache) captures the finished graph
@@ -384,38 +450,63 @@ class RelativeNeighborhoodGraph:
 
     # ------------------------------------------------------- quality estimate
 
-    def accuracy_estimation(self, data: np.ndarray, metric: int, base: int,
-                            samples: int = 100,
-                            seed: int = 0) -> float:
-        """Sampled fraction of stored neighbors that are true nearest
-        neighbors (parity: GraphAccuracyEstimation,
-        RelativeNeighborhoodGraph.h:73-112)."""
+    def accuracy_truth(self, data: np.ndarray, metric: int, base: int,
+                       samples: int = 100, seed: int = 0,
+                       width: Optional[int] = None):
+        """(pick, truth) for `accuracy_estimation` — the exact-NN half of
+        the estimate, independent of the stored graph.  Computed once per
+        build and reused across refine passes (the (samples, N) distance
+        sweep is the expensive part; only the stored-row lookup changes
+        between passes)."""
         from sptag_tpu.ops import distance as dist_ops
 
         n = data.shape[0]
-        if n == 0 or self.graph.shape[0] == 0:
-            return 0.0
         rng = np.random.default_rng(seed)
         pick = rng.choice(n, min(samples, n), replace=False)
         q = jnp.asarray(data[pick])
         d = np.array(dist_ops.pairwise_distance(
             q, jnp.asarray(data), metric))
         d[np.arange(len(pick)), pick] = MAX_DIST
-        m = min(self.graph.shape[1], max(n - 1, 1))
+        m = min(width or self.graph.shape[1], max(n - 1, 1))
         # argpartition: O(N) per row vs argsort's O(N log N) — this runs
-        # on the build hot path once per refine pass when INFO logging is
-        # enabled
+        # on the build hot path once per refine pass when INFO logging or
+        # the accuracy guard is enabled
         part = np.argpartition(d, m - 1, axis=1)[:, :m]
         rows = np.take_along_axis(d, part, axis=1)
         order = np.argsort(rows, axis=1)
-        truth = np.take_along_axis(part, order, axis=1)
+        return pick, np.take_along_axis(part, order, axis=1)
+
+    def accuracy_estimation(self, data: np.ndarray, metric: int, base: int,
+                            samples: int = 100,
+                            seed: int = 0,
+                            width: Optional[int] = None,
+                            truth=None) -> float:
+        """Sampled fraction of stored neighbors that are true nearest
+        neighbors (parity: GraphAccuracyEstimation,
+        RelativeNeighborhoodGraph.h:73-112).
+
+        `width` restricts the scoring to each node's first `width` stored
+        neighbors — the accuracy guard compares pre/post refine at
+        matched width because the metric's value depends on row width
+        (precision@64 and precision@32 are different quantities).
+        `truth` short-circuits the exact-NN sweep with a cached
+        `accuracy_truth` result."""
+        n = data.shape[0]
+        if n == 0 or self.graph.shape[0] == 0:
+            return 0.0
+        if truth is None:
+            truth = self.accuracy_truth(data, metric, base, samples, seed,
+                                        width=width)
+        pick, true_ids = truth
         hits = 0
         total = 0
         for row, node in enumerate(pick):
-            stored = set(int(x) for x in self.graph[node] if x >= 0)
+            stored_row = self.graph[node] if width is None \
+                else self.graph[node][:width]
+            stored = set(int(x) for x in stored_row if x >= 0)
             if not stored:
                 continue
-            hits += len(stored & set(truth[row][:len(stored)].tolist()))
+            hits += len(stored & set(true_ids[row][:len(stored)].tolist()))
             total += len(stored)
         return hits / max(total, 1)
 

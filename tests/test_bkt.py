@@ -320,3 +320,27 @@ def test_beam_packed_neighbors_matches_row_gather():
                                    err_msg=f"{vt}/{sd}")
         assert idx_pack._get_engine().nbr_vecs is not None
         assert idx_row._get_engine().nbr_vecs is None
+
+
+def test_starved_refine_budget_warns(caplog):
+    """Round-5 guardrail (reports/SCALE.md): a dense refine whose budget
+    probes <2 clusters of its partition must say so — at 10M that
+    configuration silently replaced TPT edges with near-random results."""
+    import logging
+
+    data = np.random.default_rng(5).standard_normal(
+        (2000, 24)).astype(np.float32)
+    idx = sp.create_instance("BKT", "Float")
+    for name, value in [("DistCalcMethod", "L2"),
+                        ("RefineIterations", "1"),
+                        ("RefineSearchMode", "dense"),
+                        ("FinalRefineSearchMode", "same"),
+                        # CEF low too: the effective budget the warning
+                        # judges is max(budget, 2*(CEF+1))
+                        ("CEF", "16"),
+                        ("MaxCheckForRefineGraph", "8")]:
+        assert idx.set_parameter(name, value)
+    with caplog.at_level(logging.WARNING, logger="sptag_tpu.algo.bkt"):
+        idx.build(data)
+    assert any("probes only" in r.message for r in caplog.records), \
+        [r.message for r in caplog.records]

@@ -137,3 +137,38 @@ def test_full_build_accuracy():
     assert g.graph.shape == (400, 8)
     acc = g.accuracy_estimation(data, metric=0, base=1, samples=50)
     assert acc > 0.5, acc
+
+
+def test_refine_accuracy_guard_rolls_back_degrading_pass(caplog):
+    """Round-5 guardrail (measured at 10M, reports/SCALE.md): a refine
+    pass whose search returns garbage must be rolled back instead of
+    replacing the TPT candidate edges."""
+    import logging
+
+    data = _corpus(n=400)
+    bad = np.random.default_rng(3)
+
+    def bad_factory(graph, final):
+        # budget-starved refine stand-in: near-random neighbor ids
+        def fn(queries, k):
+            ids = bad.integers(0, data.shape[0], (queries.shape[0], k))
+            d = bad.random((queries.shape[0], k)).astype(np.float32)
+            return d, ids
+        return fn
+
+    kw = dict(neighborhood_size=8, tpt_number=6, tpt_leaf_size=64,
+              neighborhood_scale=2, refine_iterations=1, cef=32,
+              tpt_samples=100)
+    g_on = RelativeNeighborhoodGraph(refine_accuracy_guard=True, **kw)
+    with caplog.at_level(logging.WARNING, logger="sptag_tpu.graph.rng"):
+        g_on.build(data, metric=0, base=1, search_fn_factory=bad_factory,
+                   seed=5)
+    assert any("DEGRADED" in r.message for r in caplog.records)
+    assert g_on.graph.shape == (400, 8)        # rollback re-narrowed to m
+
+    g_off = RelativeNeighborhoodGraph(refine_accuracy_guard=False, **kw)
+    g_off.build(data, metric=0, base=1, search_fn_factory=bad_factory,
+                seed=5)
+    acc_on = g_on.accuracy_estimation(data, metric=0, base=1, samples=50)
+    acc_off = g_off.accuracy_estimation(data, metric=0, base=1, samples=50)
+    assert acc_on > acc_off + 0.02, (acc_on, acc_off)
