@@ -49,13 +49,30 @@ from sptag_tpu.trees.bktree import BKTree
 log = logging.getLogger(__name__)
 
 
-def pivot_budget(params) -> int:
+def pivot_budget(params, n: int = 0) -> int:
     """Shared-pivot set size budget (before the corpus-size clamp).
 
     THE single source of truth: the sharded/multihost builds pad their
     per-shard pivot arrays to exactly this value and would silently
-    truncate pivots if a private copy of the formula diverged."""
-    return max(64, params.initial_dynamic_pivots * 32)
+    truncate pivots if a private copy of the formula diverged.
+
+    Scales with corpus size (round 5, measured at 250k/10M): the beam
+    walk's recall ceiling is SEED COVERAGE, not budget — a fixed
+    1,600-pivot pool over a corpus with more natural clusters than that
+    leaves whole clusters unreachable (250k x 2048-cluster corpus:
+    recall flat at 0.45 from MaxCheck 8192 to 32768 with nbp/injection
+    knobs irrelevant; 8x the pivots took it to 0.80 at identical graph).
+    The reference sidesteps this by descending the tree PER QUERY
+    (InitSearchTrees seeds NumberOfInitialDynamicPivots leaves wherever
+    the query lands, BKTree.h:279-320); the shared-pool design must make
+    the pool dense enough to land near every query instead.  n/24 keeps
+    the (Q, P) seed matmul trivial on the MXU (P <= 16,384 at d=128 is
+    ~8 MB of pivot vectors); the cap bounds the device-side sort."""
+    base = max(64, params.initial_dynamic_pivots * 32)
+    div = int(getattr(params, "seed_pivot_auto_scale", 24))
+    if n and div > 0:
+        base = max(base, min(n // div, 16384))
+    return base
 
 
 @register_algo
@@ -145,7 +162,7 @@ class BKTIndex(VectorIndex):
             refine_accuracy_guard=bool(p.refine_accuracy_guard))
 
     def _pivot_ids(self) -> np.ndarray:
-        max_pivots = min(self._n, pivot_budget(self.params))
+        max_pivots = min(self._n, pivot_budget(self.params, self._n))
         return self._tree.collect_pivots(max_pivots)
 
     # parameters whose value is BAKED into a materialized engine snapshot:

@@ -97,6 +97,13 @@ _GRAPH_SPECS = [
     # (reports/SCALE.md round-5): a budget-starved refine pass replaces
     # TPT candidate edges with near-random search results
     _spec("refine_accuracy_guard", int, 1, "RefineAccuracyGuard"),
+    # TPU-side addition: the shared seed-pivot pool scales as n/THIS
+    # (capped 16,384) — seed coverage, not search budget, is the beam
+    # walk's recall ceiling at scale (measured 250k: 0.45 -> 0.78 recall
+    # from this alone; reports/SCALE.md round-5).  0 disables the
+    # auto-scale and restores the NumberOfInitialDynamicPivots*32 pool
+    # for operators trading recall for seed-matmul cost.
+    _spec("seed_pivot_auto_scale", int, 24, "SeedPivotAutoScale"),
 ]
 
 _COMMON_TAIL_SPECS = [

@@ -145,7 +145,10 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
         # == the parameter-derived pivot budget (pivot_budget — the same
         # function BKTIndex._pivot_ids clamps by)
         m_width = sub.params.neighborhood_size
-        max_p = pivot_budget(sub.params)
+        # n_local (the ceil-division nominal, identical on every process)
+        # keeps the geometry data-independent while the budget scales
+        # with shard size
+        max_p = pivot_budget(sub.params, n_local)
         packed = pack_shard_block(sub, n_local, dim, m_width, max_p, words)
         if empty_shard:
             packed["deleted"][:] = True    # placeholder row never returned
@@ -189,7 +192,7 @@ def build_process_sharded(data_for_shard, n: int, dim: int,
 
     dt = per_device[next(iter(per_device))]["data"].dtype
     m_width = sample_params.params.neighborhood_size
-    max_p = pivot_budget(sample_params.params)
+    max_p = pivot_budget(sample_params.params, self.n_local)
     self.data = assemble("data", (dim,), dt, False)
     self.sqnorm = assemble("sqnorm", (), np.float32, False)
     self.graph = assemble("graph", (m_width,), np.int32, False)

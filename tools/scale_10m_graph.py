@@ -130,8 +130,19 @@ def main():
         "BuildGraph": 1,
     }
     t1 = time.time()
+    # SCALE10M_DENSE=1 (default) packs the per-shard MXU tree-partition
+    # layout too, so the quality ladder below can measure BOTH modes:
+    # beam (the reference-parity walk) and dense (the TPU flagship —
+    # measured at 250k it responds to budget all the way up where the
+    # walk's recall is seed-coverage-bound; reports/SCALE.md round-5).
+    # RSS caveat: the dense pack allocates a padded second corpus copy
+    # AFTER the build's resume checkpoints retire (~4 GB host-side at
+    # 10M x d96) — on a memory-tight box set SCALE10M_DENSE=0 or a
+    # mid-pack OOM costs the whole unresumable build.
+    want_dense = os.environ.get("SCALE10M_DENSE", "1") == "1"
     index = ShardedBKTIndex.build(data, DistCalcMethod.L2,
-                                  mesh=make_mesh(), params=params)
+                                  mesh=make_mesh(), params=params,
+                                  dense=want_dense)
     build_s = time.time() - t1
     print(f"[scale10m] sharded graph build {build_s:.0f}s", flush=True)
 
@@ -151,6 +162,12 @@ def main():
         tl = time.time()
         _, ids_mc = index.search(queries, 10, max_check=mc)
         ladder_ids[mc] = (ids_mc, round(time.time() - tl, 2))
+    dense_ladder_ids = {}
+    if want_dense:
+        for mc in (8192, 16384, 32768):
+            tl = time.time()
+            _, ids_mc = index.search_dense(queries, 10, max_check=mc)
+            dense_ladder_ids[mc] = (ids_mc, round(time.time() - tl, 2))
     # exact truth in 1M-row blocks
     best_d = np.full((64, 10), np.inf, np.float64)
     best_i = np.full((64, 10), -1, np.int64)
@@ -175,11 +192,14 @@ def main():
     ladder = {str(mc): {"recall_at_10": round(_recall(v[0]), 4),
                         "search64_s": v[1]}
               for mc, v in ladder_ids.items()}
+    dense_ladder = {str(mc): {"recall_at_10": round(_recall(v[0]), 4),
+                              "search64_s": v[1]}
+                    for mc, v in dense_ladder_ids.items()}
     result = {
         "n": args.n, "d": args.d, "devices": args.devices,
         "build_s": round(build_s, 1), "corpus_s": round(t_data, 1),
         "search64_s": round(search_s, 2), "recall_at_10": round(recall, 4),
-        "ladder": ladder,
+        "ladder": ladder, "dense_ladder": dense_ladder,
         # the build's OWN signal (any shard resumed from checkpoints) —
         # a non-empty checkpoint dir alone can be stale foreign state
         "resumed": bool(getattr(index, "build_resumed", False)),
