@@ -734,6 +734,12 @@ def run_bench():
                     beam_graph = "strong"
                 except Exception:                        # noqa: BLE001
                     beam_index, beam_graph = index, "bench"
+            # save the CONFIGURED values to restore after the stage: the
+            # headline index runs MaxCheck=2048 (_GRAPH_PARAMS), so a
+            # hardcoded 8192 restore would leave it with a different
+            # search budget than it entered with (ADVICE r5)
+            saved_mode = index.params.search_mode
+            saved_max_check = index.params.max_check
             try:
                 beam_index.set_parameter("SearchMode", "beam")
                 # pin the walk budget to 2048: the default 8192 quadruples
@@ -766,8 +772,8 @@ def run_bench():
                 result["beam_error"] = repr(e)[:300]
             finally:
                 if beam_index is index:
-                    index.set_parameter("SearchMode", "dense")
-                    index.set_parameter("MaxCheck", "8192")
+                    index.set_parameter("SearchMode", str(saved_mode))
+                    index.set_parameter("MaxCheck", str(saved_max_check))
                 else:
                     del beam_index          # free the second corpus copy
             checkpoint()

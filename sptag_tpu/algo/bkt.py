@@ -159,7 +159,8 @@ class BKTIndex(VectorIndex):
             neighborhood_scale=p.neighborhood_scale, cef_scale=p.cef_scale,
             refine_iterations=p.refine_iterations, cef=p.cef,
             tpt_top_dims=p.tpt_top_dims, tpt_samples=p.samples,
-            refine_accuracy_guard=bool(p.refine_accuracy_guard))
+            refine_accuracy_guard=bool(p.refine_accuracy_guard),
+            refine_accuracy_floor=float(p.refine_accuracy_floor))
 
     def _pivot_ids(self) -> np.ndarray:
         max_pivots = min(self._n, pivot_budget(self.params, self._n))

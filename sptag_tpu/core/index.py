@@ -18,6 +18,7 @@ mutexes around shared rows.
 from __future__ import annotations
 
 import abc
+import errno
 import os
 import shutil
 import threading
@@ -495,7 +496,30 @@ class VectorIndex(abc.ABC):
             self._save_index_data(target)
             if existing:
                 backup = folder.rstrip("/\\") + f".old-{token}"
-                os.rename(folder, backup)     # previous checkpoint intact
+                try:
+                    os.rename(folder, backup)  # previous checkpoint intact
+                except OSError as e:
+                    if e.errno not in (errno.EXDEV, errno.EBUSY):
+                        raise
+                    # `folder` is a mountpoint (container volume): it can
+                    # be neither renamed (EBUSY) nor atomically swapped
+                    # from the staging sibling's filesystem (EXDEV) —
+                    # degrade to the per-file move with indexloader.ini
+                    # LAST, the same ordering the pre-created-folder
+                    # branch uses (ADVICE r5).  The OLD sentinel must go
+                    # FIRST: with it in place, a crash mid-loop would
+                    # leave mixed old/new data files behind a valid-
+                    # looking indexloader.ini (silent corruption); with
+                    # it gone, the window reads as incomplete and load
+                    # fails loudly instead
+                    os.unlink(os.path.join(folder, "indexloader.ini"))
+                    names = [nm for nm in os.listdir(target)
+                             if nm != "indexloader.ini"]
+                    for nm in names + ["indexloader.ini"]:
+                        _replace_file(os.path.join(target, nm),
+                                      os.path.join(folder, nm))
+                    shutil.rmtree(target, ignore_errors=True)
+                    return ErrorCode.Success
                 os.rename(target, folder)     # the swap
                 # best-effort: the save has SUCCEEDED once the swap lands;
                 # a cleanup failure (symlinked folder, open handles) must
@@ -527,8 +551,8 @@ class VectorIndex(abc.ABC):
                 names = [nm for nm in os.listdir(target)
                          if nm != "indexloader.ini"]
                 for nm in names + ["indexloader.ini"]:
-                    os.replace(os.path.join(target, nm),
-                               os.path.join(folder, nm))
+                    _replace_file(os.path.join(target, nm),
+                                  os.path.join(folder, nm))
                 shutil.rmtree(target, ignore_errors=True)
         return ErrorCode.Success
 
@@ -612,6 +636,40 @@ class VectorIndex(abc.ABC):
             if reader.get_parameter("MetaData", "MetaDataToVectorIndex",
                                     "") == "true":
                 self.build_meta_mapping()
+
+
+def _replace_file(src: str, dst: str) -> None:
+    """`os.replace` with a cross-filesystem fallback: when the destination
+    folder is a mountpoint on a different filesystem than the staging
+    sibling (a container volume is the common case), rename raises EXDEV —
+    fall back to copy2 + fsync + unlink so the data is durably at `dst`
+    before the staged copy disappears.  The copy window is not atomic,
+    but the caller's ordering (indexloader.ini LAST) preserves the
+    completeness-sentinel property either way (ADVICE r5)."""
+    try:
+        os.replace(src, dst)
+        return
+    except OSError as e:
+        if e.errno != errno.EXDEV:
+            raise
+    tmp = dst + ".xdev-tmp"
+    shutil.copy2(src, tmp)
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst)       # same filesystem as dst: atomic
+    # fsync the destination DIRECTORY before dropping the only other
+    # copy: the rename above is a directory-entry update that may still
+    # sit in the page cache, and src vanishing first would lose the file
+    # from both locations on power loss
+    dfd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    os.unlink(src)
 
 
 def _recover_interrupted_save(folder: str) -> None:

@@ -97,6 +97,13 @@ _GRAPH_SPECS = [
     # (reports/SCALE.md round-5): a budget-starved refine pass replaces
     # TPT candidate edges with near-random search results
     _spec("refine_accuracy_guard", int, 1, "RefineAccuracyGuard"),
+    # catastrophic absolute floor for the guard's rollback: a pass must
+    # BOTH drop the paired estimate by > 0.02 AND land below this to roll
+    # back (graph/rng.py).  0.35 separates every observed healthy refine
+    # (>= 0.5) from the budget-starved 10M failure mode (0.22-0.24);
+    # datasets whose legitimate post-refine precision@m sits lower tune
+    # this down instead of disabling the guard outright (ADVICE r5)
+    _spec("refine_accuracy_floor", float, 0.35, "RefineAccuracyFloor"),
     # TPU-side addition: the shared seed-pivot pool scales as n/THIS
     # (capped 16,384) — seed coverage, not search budget, is the beam
     # walk's recall ceiling at scale (measured 250k: 0.45 -> 0.78 recall

@@ -64,7 +64,8 @@ class RelativeNeighborhoodGraph:
                  cef_scale: int = 2, refine_iterations: int = 2,
                  cef: int = 1000, tpt_top_dims: int = 5,
                  tpt_samples: int = 1000,
-                 refine_accuracy_guard: bool = True):
+                 refine_accuracy_guard: bool = True,
+                 refine_accuracy_floor: float = 0.35):
         self.neighborhood_size = neighborhood_size
         self.tpt_number = tpt_number
         self.tpt_leaf_size = tpt_leaf_size
@@ -75,6 +76,11 @@ class RelativeNeighborhoodGraph:
         self.tpt_top_dims = tpt_top_dims
         self.tpt_samples = tpt_samples
         self.refine_accuracy_guard = refine_accuracy_guard
+        # absolute rollback floor (RefineAccuracyFloor): see the rollback
+        # condition in refine() — tunable per dataset, since a corpus
+        # whose legitimate post-refine precision@m sits below the default
+        # would otherwise have good passes rolled back
+        self.refine_accuracy_floor = refine_accuracy_floor
         # (N, row_width) int32 neighbor ids, -1 padded.  Width is
         # neighborhood_size after the final refine; candidate-width before.
         self.graph = np.zeros((0, neighborhood_size), np.int32)
@@ -202,15 +208,18 @@ class RelativeNeighborhoodGraph:
                 # but never rolled back: it optimizes walk NAVIGABILITY,
                 # which precision@m does not measure (the caller signals
                 # this via guard_final=False).
-                if guard and acc < pre_acc - 0.02 and acc < 0.35 and \
+                if guard and acc < pre_acc - 0.02 and \
+                        acc < self.refine_accuracy_floor and \
                         (guard_final or not last):
                     log.warning(
                         "RNG refine pass %d/%d DEGRADED sampled graph "
                         "accuracy %.4f -> %.4f (starved search budget? "
                         "MaxCheckForRefineGraph raises it) — pass rolled "
-                        "back, remaining passes skipped; set "
+                        "back, remaining passes skipped; lower "
+                        "RefineAccuracyFloor (now %.2f) or set "
                         "RefineAccuracyGuard=0 to keep degrading passes",
-                        it + 1, passes, pre_acc, acc)
+                        it + 1, passes, pre_acc, acc,
+                        self.refine_accuracy_floor)
                     # the restored graph may still be at candidate width
                     # (the final pass normally narrows to m); rows are in
                     # RNG-keep order (ascending distance among kept), so

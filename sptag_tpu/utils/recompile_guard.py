@@ -1,0 +1,146 @@
+"""Recompile guard — runtime counterpart of graftlint's retrace lint.
+
+graftlint (tools/graftlint) catches recompile HAZARDS statically; this
+module counts what XLA actually compiled, via `jax.monitoring`'s
+`/jax/core/compile/backend_compile_duration` event — emitted once per
+backend compilation, including cache-miss recompiles that jit's Python
+layer never sees.  Tests wrap a warmed-up search in `track_compiles()`
+and assert zero events: the exact "no recompilation in the query loop"
+invariant TPU-KNN (arXiv:2206.14286) requires for peak-FLOP/s serving,
+enforced in tier-1 (tests/test_recompile.py) instead of discovered as a
+bench regression rounds later.
+
+Every observed compile is also fed into utils/trace.py
+(`trace.record("xla.backend_compile[<label>]", dt)`), so `trace.report()`
+shows compile cost next to host spans — bench.py's trace dump picks it
+up with no extra wiring.
+
+Listener registration is process-global and installed once, lazily (the
+module import does NOT import jax — importing the library must never
+initialize a backend).  Guards nest: each active guard counts every
+compile in its window.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, List, Optional
+
+from sptag_tpu.utils import trace
+
+#: the monitoring event jax emits once per XLA backend compilation
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: the trace-span family compile durations are recorded under
+TRACE_SPAN = "xla.backend_compile"
+
+_lock = threading.Lock()
+_active: List["CompileLog"] = []
+_installed = False
+
+
+class RecompileError(AssertionError):
+    """A guard observed more XLA compilations than its window allows."""
+
+
+class CompileLog:
+    """Counter for one `track_compiles` window."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.count = 0
+        self.total_s = 0.0
+        self.durations: List[float] = []
+        self._log_lock = threading.Lock()
+
+    def _record(self, duration_s: float) -> None:
+        with self._log_lock:
+            self.count += 1
+            self.total_s += duration_s
+            self.durations.append(duration_s)
+
+    def assert_compiles(self, at_most: int,
+                        context: str = "") -> None:
+        """Raise RecompileError if more than `at_most` compilations were
+        observed in this window."""
+        if self.count > at_most:
+            where = f" during {context}" if context else ""
+            raise RecompileError(
+                f"[{self.label}] {self.count} XLA compilation(s){where}, "
+                f"expected at most {at_most} — a shape/dtype/static-arg "
+                "is varying per call (see graftlint GL2xx and "
+                "serve.service._sanitize_max_check for the quantization "
+                "pattern)")
+
+    def __repr__(self) -> str:
+        return (f"CompileLog({self.label!r}, count={self.count}, "
+                f"total_s={round(self.total_s, 3)})")
+
+
+def _on_event_duration(event: str, duration_s: float, **kwargs) -> None:
+    if event != COMPILE_EVENT:
+        return
+    with _lock:
+        logs = list(_active)
+    for log in logs:
+        log._record(duration_s)
+        trace.record(f"{TRACE_SPAN}[{log.label}]", duration_s)
+    if not logs:
+        trace.record(TRACE_SPAN, duration_s)
+
+
+def _ensure_listener() -> None:
+    """Install the process-global monitoring listener (idempotent)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _installed = True
+
+
+@contextlib.contextmanager
+def track_compiles(label: str = "guard") -> Iterator[CompileLog]:
+    """Count XLA backend compilations within the block.
+
+        with track_compiles("beam.warm") as log:
+            index.search_batch(queries, 10)
+        log.assert_compiles(at_most=0)
+    """
+    _ensure_listener()
+    log = CompileLog(label)
+    with _lock:
+        _active.append(log)
+    try:
+        yield log
+    finally:
+        with _lock:
+            _active.remove(log)
+
+
+@contextlib.contextmanager
+def no_recompiles(label: str = "steady-state",
+                  at_most: int = 0) -> Iterator[CompileLog]:
+    """`track_compiles` that raises RecompileError on exit when the block
+    compiled more than `at_most` programs — the assertion form for tests
+    and for wrapping a production serve loop after warmup.  Raises only
+    on clean exits: an exception inside the block propagates unmasked."""
+    with track_compiles(label) as log:
+        yield log
+    log.assert_compiles(at_most)
+
+
+def warmup_then_guard(fn, *args, label: str = "steady-state",
+                      repeats: int = 1, **kwargs):
+    """Convenience: run `fn` once (warmup — compiles are expected), then
+    `repeats` more times under a zero-compile guard.  Returns the last
+    result.  The pattern every steady-state test wants as one call."""
+    result = fn(*args, **kwargs)
+    with no_recompiles(label):
+        for _ in range(repeats):
+            result = fn(*args, **kwargs)
+    return result

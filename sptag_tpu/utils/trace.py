@@ -44,11 +44,19 @@ def span(name: str) -> Iterator[None]:
         dt = time.perf_counter() - t0
         if ann is not None:
             ann.__exit__(None, None, None)
-        with _lock:
-            rec = _spans.setdefault(name, [0, 0.0, 0.0])
-            rec[0] += 1
-            rec[1] += dt
-            rec[2] = max(rec[2], dt)
+        record(name, dt)
+
+
+def record(name: str, seconds: float) -> None:
+    """Record one externally-measured duration into the span registry —
+    the entry point for instrumentation that observes durations instead
+    of wrapping code (utils/recompile_guard.py feeds XLA backend-compile
+    times here so `report()` shows compile cost next to host spans)."""
+    with _lock:
+        rec = _spans.setdefault(name, [0, 0.0, 0.0])
+        rec[0] += 1
+        rec[1] += seconds
+        rec[2] = max(rec[2], seconds)
 
 
 def report() -> Dict[str, Dict[str, float]]:

@@ -3,6 +3,7 @@
 Labelset.h:47-52, MetadataSet.cpp:22-35)."""
 
 import io
+import os
 import struct
 
 import numpy as np
@@ -160,3 +161,86 @@ def test_load_recovers_interrupted_swap(tmp_path):
     shutil.rmtree(folder)
     loaded = sp.load_index(folder)                  # falls back to .old-*
     assert loaded.num_samples == 200
+
+
+def test_save_into_cross_filesystem_folder(tmp_path, monkeypatch):
+    """A pre-created destination on a DIFFERENT filesystem than the
+    staging sibling (container volume mountpoint): os.replace raises
+    EXDEV and the save must fall back to copy2+fsync+unlink, still
+    writing indexloader.ini last (ADVICE r5)."""
+    import errno
+
+    import sptag_tpu as sp
+    from sptag_tpu.core import index as core_index
+
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((120, 12)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+
+    folder = tmp_path / "volume"
+    folder.mkdir()                      # pre-created non-index folder
+
+    real_replace = os.replace
+    order = []
+
+    def exdev_replace(src, dst):
+        if ".saving-" in src:           # staging -> destination crossing
+            raise OSError(errno.EXDEV, "Invalid cross-device link")
+        order.append(os.path.basename(dst))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(core_index.os, "replace", exdev_replace)
+    assert idx.save_index(str(folder)) == sp.ErrorCode.Success
+    monkeypatch.undo()
+
+    # the completeness sentinel landed LAST even on the fallback path
+    assert order[-1] == "indexloader.ini"
+    assert not any(n.endswith(".xdev-tmp") for n in os.listdir(folder))
+    loaded = sp.load_index(str(folder))
+    assert loaded.num_samples == 120
+    _, ids = loaded.search_batch(data[:4], 1)
+    assert (ids[:, 0] == np.arange(4)).all()
+
+
+def test_overwrite_save_onto_mountpoint_falls_back(tmp_path, monkeypatch):
+    """Second save onto a folder that can be neither renamed (EBUSY
+    mountpoint) nor reached by rename from the staging sibling (EXDEV):
+    the existing-index branch must degrade to the per-file move instead
+    of crashing (code-review follow-up to the EXDEV satellite)."""
+    import errno
+
+    import sptag_tpu as sp
+    from sptag_tpu.core import index as core_index
+
+    rng = np.random.default_rng(12)
+    data = rng.standard_normal((80, 10)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+    folder = str(tmp_path / "vol")
+    assert idx.save_index(folder) == sp.ErrorCode.Success   # first save
+
+    real_rename, real_replace = os.rename, os.replace
+
+    def ebusy_rename(src, dst):
+        if src.rstrip("/") == folder:
+            raise OSError(errno.EBUSY, "Device or resource busy")
+        return real_rename(src, dst)
+
+    def exdev_replace(src, dst):
+        if ".saving-" in src:
+            raise OSError(errno.EXDEV, "Invalid cross-device link")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(core_index.os, "rename", ebusy_rename)
+    monkeypatch.setattr(core_index.os, "replace", exdev_replace)
+    idx.add(rng.standard_normal((7, 10)).astype(np.float32))
+    assert idx.save_index(folder) == sp.ErrorCode.Success   # overwrite
+    monkeypatch.undo()
+
+    loaded = sp.load_index(folder)
+    assert loaded.num_samples == 87
+    _, ids = loaded.search_batch(data[:3], 1)
+    assert (ids[:, 0] == np.arange(3)).all()

@@ -79,3 +79,30 @@ def test_memory_estimators_reference_formula():
     a = sp.estimated_hbm_usage(1000, 128, "Float")
     b = sp.estimated_hbm_usage(2000, 128, "Float")
     assert 0 < a < b
+
+
+def test_refine_accuracy_floor_parameter():
+    """RefineAccuracyFloor (ADVICE r5): the guard's rollback floor is a
+    tunable parameter next to RefineAccuracyGuard, not a hardcoded 0.35,
+    and it flows from the registry into the RNG graph builder."""
+    p = BKTParams()
+    assert p.get_param("RefineAccuracyFloor") == "0.35"
+    assert p.set_param("RefineAccuracyFloor", "0.2")
+    assert p.refine_accuracy_floor == 0.2
+    # present in both graph-index registries
+    assert KDTParams().get_param("RefineAccuracyFloor") == "0.35"
+    # config round trip
+    text = p.save_config()
+    assert "RefineAccuracyFloor=0.2" in text
+    q = BKTParams()
+    q.load_config(dict(line.split("=", 1)
+                       for line in text.strip().splitlines()))
+    assert q.refine_accuracy_floor == 0.2
+    # reaches the graph builder (algo/bkt._new_graph -> rng ctor)
+    import sptag_tpu as sp
+
+    idx = sp.create_instance("BKT", "Float")
+    assert idx.set_parameter("RefineAccuracyFloor", "0.15")
+    g = idx._new_graph()
+    assert g.refine_accuracy_floor == 0.15
+    assert g.refine_accuracy_guard

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# The whole local gate: graftlint (static) + tier-1 pytest (runtime).
+# Mirrors what the driver runs; see docs/DESIGN.md §7.
+#
+#   tools/ci_check.sh                # lint + tier-1
+#   tools/ci_check.sh --lint-only    # fast pre-commit check
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO"
+
+echo "== graftlint =="
+python -m tools.graftlint sptag_tpu/
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 pytest (CPU backend) =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,29 @@
+"""graftlint — TPU/JAX static-analysis suite for sptag_tpu.
+
+Five checker families, each its own module with documented rule ids:
+
+* GL1xx  hostsync       host<->device syncs on the jitted paths
+* GL2xx  retrace        recompile-per-value / per-shape hazards
+* GL3xx  concurrency    unlocked shared mutation, late-binding captures
+* GL4xx  errorpath      swallowed exceptions at the ErrorCode boundaries
+* GL5xx  dtype_parity   integer distance paths upcasting before the dot
+
+Run `python -m tools.graftlint sptag_tpu/` from the repo root; accepted
+findings live in `baseline.toml` (every entry justified).  The runtime
+complement — asserting ZERO recompiles after warmup — is
+`sptag_tpu/utils/recompile_guard.py`.
+"""
+
+from tools.graftlint.core import Finding, Project  # noqa: F401
+
+__all__ = ["Finding", "Project", "lint_project", "lint_sources",
+           "ALL_RULES"]
+
+
+def __getattr__(name):
+    # runner imports the checker modules, which import this package —
+    # lazy re-export avoids the cycle at import time
+    if name in ("lint_project", "lint_sources", "ALL_RULES", "main"):
+        from tools.graftlint import runner
+        return getattr(runner, name)
+    raise AttributeError(name)

@@ -1,0 +1,8 @@
+"""`python -m tools.graftlint [paths...]` — see runner.main."""
+
+import sys
+
+from tools.graftlint.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,133 @@
+"""Baseline (accepted-findings) file: load, validate, match.
+
+`baseline.toml` holds findings that were reviewed and ACCEPTED — each
+entry must carry a one-line justification, so the suppression is a
+documented decision, not a mute button.  Matching is on (rule, path,
+symbol[, contains]) rather than line number: unrelated edits that shift
+lines must not invalidate a suppression, while moving the flagged code to
+a different function (a real change) must.
+
+The file is a small TOML subset — array-of-tables `[[suppress]]` entries
+with string values — parsed here without a TOML dependency (this
+python has neither tomllib (3.11+) nor tomli, and the container's
+package set is frozen):
+
+    [[suppress]]
+    rule = "GL203"
+    path = "sptag_tpu/algo/engine.py"
+    symbol = "_beam_search_kernel"          # optional; "" = any
+    contains = "per shape"                  # optional message substring
+    justification = "intentional shape specialization; one compile per P"
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from tools.graftlint.core import Finding
+
+
+@dataclasses.dataclass
+class Suppression:
+    rule: str
+    path: str
+    symbol: str = ""
+    contains: str = ""
+    justification: str = ""
+    lineno: int = 0          # in the baseline file, for diagnostics
+    hits: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        if f.rule != self.rule or f.path != self.path:
+            return False
+        if self.symbol and f.symbol != self.symbol:
+            return False
+        if self.contains and self.contains not in f.message:
+            return False
+        return True
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def parse_baseline(text: str, origin: str = "baseline.toml"
+                   ) -> List[Suppression]:
+    entries: List[Suppression] = []
+    current: Optional[Suppression] = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            current = Suppression("", "", lineno=lineno)
+            entries.append(current)
+            continue
+        if line.startswith("["):
+            raise BaselineError(
+                f"{origin}:{lineno}: unsupported table {line!r} "
+                "(only [[suppress]] entries)")
+        key, sep, value = (p.strip() for p in line.partition("="))
+        if not sep:
+            raise BaselineError(
+                f"{origin}:{lineno}: expected `key = \"value\"`")
+        if current is None:
+            raise BaselineError(
+                f"{origin}:{lineno}: key outside a [[suppress]] entry")
+        # find the first UNESCAPED closing quote (an inline comment may
+        # follow it; escaped quotes inside the string are skipped)
+        if not value.startswith('"'):
+            raise BaselineError(
+                f"{origin}:{lineno}: value must be a double-quoted string")
+        closing = None
+        i = 1
+        while i < len(value):
+            if value[i] == '"' and value[i - 1] != "\\":
+                closing = i
+                break
+            i += 1
+        if closing is None:
+            raise BaselineError(
+                f"{origin}:{lineno}: unterminated string value")
+        literal = value[1:closing].replace('\\"', '"')
+        if key not in ("rule", "path", "symbol", "contains",
+                       "justification"):
+            raise BaselineError(f"{origin}:{lineno}: unknown key {key!r}")
+        setattr(current, key, literal)
+    for e in entries:
+        if not e.rule or not e.path:
+            raise BaselineError(
+                f"{origin}:{e.lineno}: entry needs `rule` and `path`")
+        if not e.justification.strip():
+            raise BaselineError(
+                f"{origin}:{e.lineno}: entry for {e.rule} at {e.path} has "
+                "no justification — every accepted finding must say why")
+    return entries
+
+
+def load_baseline(path: str) -> List[Suppression]:
+    with open(path, encoding="utf-8") as f:
+        return parse_baseline(f.read(), origin=path)
+
+
+def apply_baseline(findings: List[Finding],
+                   suppressions: List[Suppression]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """-> (unsuppressed, suppressed).  Increments `hits` so the caller can
+    report stale entries (zero hits = the accepted finding is gone —
+    prune it)."""
+    unsuppressed: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        hit = None
+        for s in suppressions:
+            if s.matches(f):
+                hit = s
+                break
+        if hit is None:
+            unsuppressed.append(f)
+        else:
+            hit.hits += 1
+            suppressed.append(f)
+    return unsuppressed, suppressed

@@ -1,0 +1,249 @@
+"""GL3xx — concurrency lint.
+
+The serving design is "immutable device snapshots + one writer lock"
+(docs/DESIGN.md §3): every attribute the background machinery shares is
+assigned under `self._lock` (the lock inventory seeded from
+serve/server.py, serve/client.py, core/index.py, algo/bkt.py,
+utils/threadpool.py).  An unlocked assignment to one of those attributes
+from a thread-entry path is a data race that only shows up under heavy
+traffic — the most expensive class of bug to bisect from a bench number.
+
+Rules:
+
+* GL301 — an attribute that is elsewhere assigned under a `with
+  self.<lock>:` block is assigned WITHOUT the lock in a method reachable
+  from a thread entry point (`threading.Thread(target=...)`, a
+  `ThreadPool.add(...)` job, or `run_in_executor`).
+* GL302 — a closure (lambda or nested def) created inside a `for` loop
+  captures the loop variable by reference and is handed to a thread/pool
+  API: by the time the job runs, every closure sees the LAST iteration's
+  value.  Bind it as a default argument instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.core import (
+    Finding,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    _dotted,
+    statements_under_with,
+)
+
+RULES = {
+    "GL301": "lock-protected attribute assigned without the lock in a "
+             "thread-entry-reachable method",
+    "GL302": "late-binding loop-variable capture in a closure handed to "
+             "a thread/pool API",
+}
+
+#: call names that take a job/target callable
+_SPAWN_CALLS = {"add", "submit", "apply_async", "run_in_executor", "Thread"}
+_LOCK_HINTS = ("lock",)
+
+
+def _lock_attr_names(cls_methods: List[FunctionInfo]) -> Set[str]:
+    """Attribute names used as `with self.<name>:` context managers whose
+    name smells like a lock (`_lock`, `_wlock`, ...)."""
+    names: Set[str] = set()
+    for m in cls_methods:
+        for node in ast.walk(m.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    d = _dotted(item.context_expr)
+                    if d and d.startswith("self."):
+                        leaf = d.split(".")[-1]
+                        if any(h in leaf.lower() for h in _LOCK_HINTS):
+                            names.add(leaf)
+    return names
+
+
+def _self_attr_assigns(fn: FunctionInfo):
+    """(attr_name, lineno) for every `self.X = ...` / `self.X op= ...`."""
+    for node in ast.walk(fn.node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                yield tgt.attr, node.lineno
+            elif isinstance(tgt, ast.Tuple):
+                for el in tgt.elts:
+                    if isinstance(el, ast.Attribute) and \
+                            isinstance(el.value, ast.Name) and \
+                            el.value.id == "self":
+                        yield el.attr, node.lineno
+
+
+def _guarded_attrs(cls_methods: List[FunctionInfo],
+                   lock_names: Set[str]) -> Set[str]:
+    """Attributes assigned at least once under a lock in this class."""
+    guarded: Set[str] = set()
+    for m in cls_methods:
+        held = statements_under_with(m, sorted(lock_names))
+        for attr, line in _self_attr_assigns(m):
+            if line in held:
+                guarded.add(attr)
+    return guarded
+
+
+def _thread_entry_methods(cls_methods: List[FunctionInfo]) -> Set[str]:
+    """Method names handed to Thread(target=...) / pool.add / submit /
+    run_in_executor within THIS class's own methods (a spawn in class A
+    must not mark a same-named method in class B), expanded over
+    self-calls."""
+    entries: Set[str] = set()
+    for m in cls_methods:
+        for node in ast.walk(m.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            leaf = d.split(".")[-1] if d else ""
+            if leaf not in _SPAWN_CALLS:
+                continue
+            cands = list(node.args) + [kw.value for kw in node.keywords
+                                       if kw.arg in ("target", "func",
+                                                     "fn")]
+            for cand in cands:
+                cd = _dotted(cand)
+                if cd and cd.startswith("self."):
+                    entries.add(cd.split(".")[-1])
+    # expand over self.method() calls from entry methods (fixpoint)
+    by_name: Dict[str, FunctionInfo] = {m.name: m for m in cls_methods}
+    changed = True
+    while changed:
+        changed = False
+        for name in list(entries):
+            m = by_name.get(name)
+            if m is None:
+                continue
+            for node in ast.walk(m.node):
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    if d and d.startswith("self."):
+                        callee = d.split(".")[-1]
+                        if callee in by_name and callee not in entries:
+                            entries.add(callee)
+                            changed = True
+    return entries
+
+
+def _check_gl301(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in mod.classes():
+        method_nodes = {n for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        methods = [f for f in mod.functions if f.node in method_nodes]
+        if not methods:
+            continue
+        lock_names = _lock_attr_names(methods)
+        if not lock_names:
+            continue
+        guarded = _guarded_attrs(methods, lock_names)
+        entries = _thread_entry_methods(methods)
+        for m in methods:
+            if m.name not in entries or m.name == "__init__":
+                continue
+            held = statements_under_with(m, sorted(lock_names))
+            for attr, line in _self_attr_assigns(m):
+                if attr in guarded and line not in held:
+                    out.append(Finding(
+                        "GL301", mod.relpath, line,
+                        f"`self.{attr}` is lock-protected elsewhere in "
+                        f"`{cls.name}` but assigned here without "
+                        f"holding {'/'.join(sorted(lock_names))} on a "
+                        "thread-entry path", m.qualname))
+    return out
+
+
+def _loop_targets(loop: ast.For) -> Set[str]:
+    return {n.id for n in ast.walk(loop.target)
+            if isinstance(n, ast.Name)}
+
+
+def _free_names(fn_node: ast.AST) -> Set[str]:
+    """Names read inside a lambda/def, minus its own params and locals."""
+    bound: Set[str] = set()
+    args = fn_node.args
+    for p in args.posonlyargs + args.args + args.kwonlyargs:
+        bound.add(p.arg)
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+    reads: Set[str] = set()
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+                else:
+                    reads.add(n.id)
+    return reads - bound
+
+
+def _check_gl302(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in mod.functions:
+        for node in ast.walk(fn.node):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            loop_vars = _loop_targets(node)
+            if not loop_vars:
+                continue
+            for inner in ast.walk(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                d = _dotted(inner.func)
+                leaf = d.split(".")[-1] if d else ""
+                if leaf not in _SPAWN_CALLS:
+                    continue
+                cands = list(inner.args) + \
+                    [kw.value for kw in inner.keywords
+                     if kw.arg in ("target", "func", "fn")]
+                for cand in cands:
+                    if isinstance(cand, ast.Lambda):
+                        captured = _free_names(cand) & loop_vars
+                        if captured:
+                            out.append(Finding(
+                                "GL302", mod.relpath, cand.lineno,
+                                "lambda handed to "
+                                f"`{leaf}` captures loop variable(s) "
+                                f"{sorted(captured)} by reference — every "
+                                "job sees the final iteration's value "
+                                "(bind via a default argument)",
+                                fn.qualname))
+                    elif isinstance(cand, ast.Name):
+                        # nested def passed by name: find it in the loop
+                        for sub in mod.functions:
+                            if sub.name == cand.id and sub.parent is fn \
+                                    and sub.node.lineno >= node.lineno:
+                                captured = _free_names(sub.node) & loop_vars
+                                if captured:
+                                    out.append(Finding(
+                                        "GL302", mod.relpath,
+                                        sub.node.lineno,
+                                        f"closure `{cand.id}` handed to "
+                                        f"`{leaf}` captures loop "
+                                        f"variable(s) {sorted(captured)} "
+                                        "by reference (bind via a "
+                                        "default argument)", fn.qualname))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        out.extend(_check_gl301(mod))
+        out.extend(_check_gl302(mod))
+    return out

@@ -1,0 +1,114 @@
+"""GL5xx — dtype-parity lint (ops/ only).
+
+The int8/uint8 distance paths owe their exactness to int32-accumulating
+MXU dots (`preferred_element_type`), and int16 to the high/low byte split
+(ops/distance.py module docstring): converting integer VECTORS to float32
+before the contraction silently reintroduces per-product f32 rounding —
+the exact bug that cost direction-B int16 recall 0.934 vs the reference
+(reports/AB_REFERENCE.md).  Upcasting dot RESULTS (e.g. the weighted
+recombination in `_int16_parts_f32`) is fine; upcasting INPUTS is not.
+
+Rule:
+
+* GL501 — inside an ops/ function that handles integer dtypes, a value
+  produced by `.astype(float32)` / `.astype(jnp.float32)` flows into a
+  dot-like contraction (`dot`, `dot_general`, `matmul`, `einsum`,
+  `tensordot`, `@`).  Breaks exact-arithmetic parity with the reference.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.graftlint.core import Finding, ModuleInfo, Project, _dotted
+
+RULES = {
+    "GL501": "integer distance path upcasts vectors to float32 before "
+             "the dot (breaks exact-arithmetic parity)",
+}
+
+_SCOPE = "ops/"
+_DOT_CALLS = {"dot", "dot_general", "matmul", "einsum", "tensordot", "vdot"}
+_INT_TOKENS = ("int8", "uint8", "int16")
+
+
+def _is_f32_astype(node: ast.AST) -> bool:
+    """`x.astype(jnp.float32)` / `x.astype(np.float32)` / `.astype("float32")`."""
+    if not (isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr == "astype" and node.args):
+        return False
+    arg = node.args[0]
+    d = _dotted(arg)
+    if d and d.split(".")[-1] == "float32":
+        return True
+    return isinstance(arg, ast.Constant) and arg.value == "float32"
+
+
+def _mentions_int_dtype(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        d = _dotted(node) if isinstance(node, ast.Attribute) else None
+        if d and d.split(".")[-1] in _INT_TOKENS:
+            return True
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and node.value in _INT_TOKENS:
+            return True
+    return False
+
+
+def _check_function(mod: ModuleInfo, fn) -> List[Finding]:
+    if not _mentions_int_dtype(fn.node):
+        return []
+    out: List[Finding] = []
+    upcast_names: Set[str] = set()
+    upcast_lines = {}
+
+    # pass 1: names assigned from an f32 astype
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and _is_f32_astype(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    upcast_names.add(tgt.id)
+                    upcast_lines[tgt.id] = node.lineno
+
+    def feeds_upcast(arg: ast.AST) -> bool:
+        if _is_f32_astype(arg):
+            return True
+        return isinstance(arg, ast.Name) and arg.id in upcast_names
+
+    # pass 2: dot-like calls and matmul operators taking an upcast input
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            leaf = d.split(".")[-1] if d else ""
+            if leaf in _DOT_CALLS:
+                for arg in list(node.args) + \
+                        [k.value for k in node.keywords]:
+                    if feeds_upcast(arg):
+                        out.append(Finding(
+                            "GL501", mod.relpath, node.lineno,
+                            f"float32-upcast vector feeds `{leaf}` in an "
+                            "integer distance path — use an int32-"
+                            "accumulating dot (preferred_element_type) "
+                            "to keep exact parity", fn.qualname))
+                        break
+        elif isinstance(node, ast.BinOp) and \
+                isinstance(node.op, ast.MatMult):
+            if feeds_upcast(node.left) or feeds_upcast(node.right):
+                out.append(Finding(
+                    "GL501", mod.relpath, node.lineno,
+                    "float32-upcast vector feeds `@` in an integer "
+                    "distance path — use an int32-accumulating dot "
+                    "to keep exact parity", fn.qualname))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for relpath, mod in project.modules.items():
+        if _SCOPE not in relpath:
+            continue
+        for fn in mod.functions:
+            out.extend(_check_function(mod, fn))
+    return out

@@ -1,0 +1,97 @@
+"""GL4xx — error-path lint (serve/ and core/ only).
+
+The serving boundary's contract is: every failure either becomes an
+`ErrorCode` / an error-status wire reply, or is logged with a stack.  A
+handler that swallows an exception silently turns a data-loss bug into a
+"recall looks a bit low" mystery.  Scope is deliberately narrow — serve/
+and core/ are the error-code boundaries; kernels and tools keep their
+idioms (best-effort cleanup `except OSError: pass` is ACCEPTED there via
+the baseline, with a justification naming why it is best-effort).
+
+Rules:
+
+* GL401 — bare `except:` — catches SystemExit/KeyboardInterrupt too;
+  catch a type.
+* GL402 — a swallowed exception: the handler neither re-raises, nor logs,
+  nor returns/yields a value, nor references `ErrorCode` — its body is
+  pure no-op (pass / constant assignment / `continue`).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import Finding, ModuleInfo, Project, _dotted
+
+RULES = {
+    "GL401": "bare `except:` (catches SystemExit/KeyboardInterrupt)",
+    "GL402": "swallowed exception: handler neither raises, logs, returns "
+             "a value, nor produces an ErrorCode",
+}
+
+_SCOPES = ("sptag_tpu/serve/", "sptag_tpu/core/")
+
+_LOG_METHODS = {"exception", "warning", "error", "critical", "info",
+                "debug", "log"}
+
+
+def _handler_is_meaningful(handler: ast.ExceptHandler) -> bool:
+    """Does the handler DO anything with the failure?  Meaningful =
+    re-raise, return/yield a result, break/continue a retry loop, call
+    anything (logging, cleanup, state transition), assign object state
+    (`self.x = None` connection resets), or reference ErrorCode.  What
+    remains — `pass` and local constant assignments — is a swallow."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Yield,
+                             ast.YieldFrom, ast.Break, ast.Continue,
+                             ast.Call)):
+            return True
+        if isinstance(node, ast.Name) and node.id == "ErrorCode":
+            return True
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                for t in node.targets):
+            return True
+    return False
+
+
+def _check_module(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    # map line -> enclosing function qualname for symbol attribution
+    def enclosing(lineno: int) -> str:
+        best = ""
+        best_line = -1
+        for fn in mod.functions:
+            end = getattr(fn.node, "end_lineno", fn.node.lineno)
+            if fn.node.lineno <= lineno <= end and \
+                    fn.node.lineno > best_line:
+                best, best_line = fn.qualname, fn.node.lineno
+        return best
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(Finding(
+                "GL401", mod.relpath, node.lineno,
+                "bare `except:` catches SystemExit/KeyboardInterrupt — "
+                "name the exception type", enclosing(node.lineno)))
+            continue
+        if not _handler_is_meaningful(node):
+            caught = _dotted(node.type) or "…"
+            out.append(Finding(
+                "GL402", mod.relpath, node.lineno,
+                f"`except {caught}` swallows the failure (no raise / log "
+                "/ return / ErrorCode) — convert to an ErrorCode or log "
+                "it", enclosing(node.lineno)))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for relpath, mod in project.modules.items():
+        if any(relpath.startswith(s) or ("/" + s) in relpath
+               for s in _SCOPES):
+            out.extend(_check_module(mod))
+    return out

@@ -1,0 +1,137 @@
+"""GL1xx — host-sync lint.
+
+A host<->device synchronization inside the jitted search/build paths either
+fails at trace time (implicit tracer->bool) or, worse, silently forces a
+blocking device readback per call (`.item()`, `float()`, `np.asarray` on a
+committed array in the surrounding host code) — exactly the per-query sync
+TPU-KNN (arXiv:2206.14286) shows destroys peak-FLOP/s serving.  All rules
+run only over functions REACHABLE from a jit/shard_map root (core.py).
+
+Rules:
+
+* GL101 — `.item()` call inside a jit-reachable function.  On a tracer it
+  is a trace-time error; on a concrete array it is a device sync.
+* GL102 — `float()` / `int()` / `bool()` applied to a (statically) traced
+  value.  Static arguments and shape-derived ints are exempt via the
+  taint analysis.
+* GL103 — `np.asarray` / `np.array` / `np.copy` inside a jit-reachable
+  function: forces a host transfer mid-program (trace-time error under
+  jit; a silent sync in the op-by-op fallback).
+* GL104 — implicit tracer->bool: an `if` / `while` test or `and`/`or`/
+  `not` operand that taints as a traced value.  Use `jnp.where` /
+  `lax.cond` / `lax.select` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import (
+    Finding,
+    FunctionInfo,
+    Project,
+    _dotted,
+    body_nodes,
+    tracer_taint,
+)
+
+RULES = {
+    "GL101": "`.item()` inside a jit-reachable function (host sync)",
+    "GL102": "float()/int()/bool() on a traced jax value (host sync)",
+    "GL103": "np.asarray/np.array inside a jit-reachable function "
+             "(host transfer)",
+    "GL104": "implicit tracer-to-bool in `if`/`while`/boolean op "
+             "(trace-time error / per-call sync)",
+}
+
+_CASTS = {"float", "int", "bool"}
+_NP_SYNC = {"asarray", "array", "copy", "frombuffer", "ascontiguousarray"}
+
+
+def _np_alias_heads(fn: FunctionInfo) -> set:
+    return {alias for alias, full in fn.module.import_aliases.items()
+            if full.split(".")[0] == "numpy"}
+
+
+def _check_function(fn: FunctionInfo) -> List[Finding]:
+    out: List[Finding] = []
+    path = fn.module.relpath
+    tainted = tracer_taint(fn, inherited=_inherited(fn))
+    expr_tainted = fn._taint_expr
+    np_heads = _np_alias_heads(fn)
+
+    for node in body_nodes(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            # GL101: .item()
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args:
+                out.append(Finding(
+                    "GL101", path, node.lineno,
+                    "`.item()` forces a blocking device->host sync",
+                    fn.qualname))
+            # GL102: float()/int()/bool() on traced value
+            elif isinstance(f, ast.Name) and f.id in _CASTS \
+                    and len(node.args) == 1 and expr_tainted(node.args[0]):
+                out.append(Finding(
+                    "GL102", path, node.lineno,
+                    f"`{f.id}()` on a traced jax value syncs the device "
+                    "(use the array itself, or declare the input static)",
+                    fn.qualname))
+            # GL103: np.asarray / np.array
+            elif isinstance(f, ast.Attribute) and f.attr in _NP_SYNC and \
+                    isinstance(f.value, ast.Name) and f.value.id in np_heads:
+                out.append(Finding(
+                    "GL103", path, node.lineno,
+                    f"`{f.value.id}.{f.attr}()` inside a jit-reachable "
+                    "function forces a host transfer (keep the hot path "
+                    "in jnp)", fn.qualname))
+        # GL104: implicit tracer-to-bool
+        elif isinstance(node, (ast.If, ast.While)) and \
+                expr_tainted(node.test):
+            kw = "if" if isinstance(node, ast.If) else "while"
+            out.append(Finding(
+                "GL104", path, node.lineno,
+                f"`{kw}` on a traced value is a trace-time error (use "
+                "jnp.where / lax.cond)", fn.qualname))
+        elif isinstance(node, ast.BoolOp) and \
+                any(expr_tainted(v) for v in node.values):
+            out.append(Finding(
+                "GL104", path, node.lineno,
+                "`and`/`or` on a traced value coerces it to bool (use "
+                "`&`/`|`)", fn.qualname))
+        elif isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, ast.Not) and expr_tainted(node.operand):
+            out.append(Finding(
+                "GL104", path, node.lineno,
+                "`not` on a traced value coerces it to bool (use `~`)",
+                fn.qualname))
+        elif isinstance(node, ast.Assert) and expr_tainted(node.test):
+            out.append(Finding(
+                "GL104", path, node.lineno,
+                "`assert` on a traced value coerces it to bool "
+                "(use checkify or a host-side check)", fn.qualname))
+    # silence the "tainted unused" style complaint — the closure uses it
+    del tainted
+    return out
+
+
+def _inherited(fn: FunctionInfo):
+    """Nested defs see the enclosing function's taint (closure capture)."""
+    chain = []
+    p = fn.parent
+    while p is not None:
+        chain.append(p)
+        p = p.parent
+    inherited = set()
+    for anc in reversed(chain):
+        inherited = tracer_taint(anc, inherited=inherited)
+    return inherited
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in project.jit_reachable_functions():
+        out.extend(_check_function(fn))
+    return out

@@ -1,0 +1,109 @@
+"""GL2xx — retrace lint.
+
+XLA compiles one executable per (structure, static-args, shapes) signature.
+A Python scalar passed as a TRACED argument hashes by value, so every new
+value mints a fresh trace; shape-dependent branching inside a jitted body
+retraces per shape.  Both are invisible in tests (small value sets) and
+fatal in a long-lived server (unbounded compile-cache growth — the exact
+failure `serve/service.py:_sanitize_max_check` quantizes against).
+
+Rules:
+
+* GL201 — a `jax.jit` / `shard_map` root has a parameter whose annotation
+  or default marks it as a Python scalar (int/bool/str/float), but the
+  name is not listed in `static_argnames`.  Every distinct value
+  recompiles; declare it static or pass it as an array.
+* GL202 — an f-string inside a jitted body: it evaluates at TRACE time
+  (once per compile, against abstract values), which is almost never the
+  intent — and interpolating a tracer embeds `Traced<...>` garbage.
+* GL203 — `if` / `while` branching on `.shape` / `.ndim` inside a jitted
+  body: legal (shapes are static) but each distinct shape compiles a new
+  program.  Intentional shape specialization belongs in the baseline
+  with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graftlint.core import (
+    Finding,
+    FunctionInfo,
+    Project,
+    body_nodes,
+)
+
+RULES = {
+    "GL201": "scalar parameter of a jit/shard_map root not declared in "
+             "static_argnames (recompile per value)",
+    "GL202": "f-string inside a jitted body (evaluates at trace time)",
+    "GL203": "shape-dependent `if`/`while` inside a jitted body "
+             "(recompile per shape)",
+}
+
+_SCALAR_ANNOTATIONS = {"int", "bool", "str", "float"}
+
+
+def _scalar_params(fn: FunctionInfo) -> List[tuple]:
+    """(name, why) for params whose annotation or default is a Python
+    scalar.  `None` defaults are excluded: they are array-or-absent
+    sentinels in this codebase, not scalar config."""
+    a = fn.node.args
+    params = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    defaults = [None] * (len(a.posonlyargs) + len(a.args)
+                         - len(a.defaults)) + list(a.defaults) + \
+        list(a.kw_defaults)
+    out = []
+    for p, d in zip(params, defaults):
+        if p.arg == "self":
+            continue
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTATIONS:
+            out.append((p.arg, f"annotated `{ann.id}`"))
+            continue
+        if isinstance(d, ast.Constant) and \
+                isinstance(d.value, (bool, int, float, str)):
+            out.append((p.arg, f"default `{d.value!r}`"))
+    return out
+
+
+def _shape_dependent(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+            return True
+    return False
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        for fn in mod.functions:
+            # GL201 — roots only (that is where static_argnames lives)
+            if fn.is_jit_root or fn.is_shard_root:
+                for name, why in _scalar_params(fn):
+                    if name in fn.static_args:
+                        continue
+                    kind = "shard_map" if fn.is_shard_root else "jax.jit"
+                    out.append(Finding(
+                        "GL201", mod.relpath, fn.line,
+                        f"{kind} root parameter `{name}` ({why}) is not "
+                        "in static_argnames — every distinct value "
+                        "recompiles", fn.qualname))
+            if not fn.jit_reachable:
+                continue
+            for node in body_nodes(fn):
+                if isinstance(node, ast.JoinedStr):
+                    out.append(Finding(
+                        "GL202", mod.relpath, node.lineno,
+                        "f-string inside a jitted body evaluates at "
+                        "trace time, not per call", fn.qualname))
+                elif isinstance(node, (ast.If, ast.While)) and \
+                        _shape_dependent(node.test):
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    out.append(Finding(
+                        "GL203", mod.relpath, node.lineno,
+                        f"`{kw}` on `.shape`/`.ndim` inside a jitted "
+                        "body compiles one program per shape",
+                        fn.qualname))
+    return out

@@ -39,6 +39,24 @@ jax.config.update("jax_platforms", "cpu")
 import bench  # noqa: E402
 
 
+def newest_mtime(path):
+    """Newest mtime across a tree's CONTENTS (files and dirs), not just
+    the top directory inode: writing a large blob INTO an already-created
+    staging dir does not advance the dir's own mtime, so gating on it
+    alone could rmtree a multi-hour save still in flight (ADVICE r5).
+    Vanished entries (a concurrent save finishing its rename) are
+    skipped; the top-level stat is the floor."""
+    newest = os.path.getmtime(path)
+    for dirpath, dirnames, filenames in os.walk(path):
+        for name in dirnames + filenames:
+            try:
+                newest = max(newest,
+                             os.path.getmtime(os.path.join(dirpath, name)))
+            except OSError:
+                pass
+    return newest
+
+
 def prebuild(tag, builder):
     if bench.cache_ready(tag):
         print(f"[prebuild] {tag}: cached already", flush=True)
@@ -71,7 +89,7 @@ def main() -> None:
                 continue
             path = os.path.join(bench.CACHE_DIR, name)
             try:
-                if now - os.path.getmtime(path) > 3600:
+                if now - newest_mtime(path) > 3600:
                     shutil.rmtree(path, ignore_errors=True)
                     print(f"[prebuild] swept stale {name}", flush=True)
             except OSError:
