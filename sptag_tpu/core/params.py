@@ -65,6 +65,16 @@ class ParamSet:
         for spec in self.SPECS:
             yield spec.name, convert_to_string(getattr(self, spec.attr))
 
+    def non_default_items(self):
+        """(name, value) for every parameter whose current value differs
+        from its registered default — the compact config view the serving
+        /healthz endpoint publishes, so an operator can read what a live
+        index was actually built/tuned with without diffing ini files."""
+        for spec in self.SPECS:
+            current = getattr(self, spec.attr)
+            if current != spec.default:
+                yield spec.name, convert_to_string(current)
+
     def save_config(self) -> str:
         """One `Name=Value` line per registered param, in registry order —
         same shape the reference writes into indexloader.ini [Index]

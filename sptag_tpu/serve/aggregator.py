@@ -33,9 +33,12 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
-from typing import List, Optional
+import time
+from typing import List, Optional, Tuple
 
-from sptag_tpu.serve import wire
+from sptag_tpu.serve import protocol, wire
+from sptag_tpu.serve.metrics_http import MetricsHttpServer
+from sptag_tpu.utils import metrics, trace
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -169,12 +172,28 @@ class AggregatorContext:
                  listen_port: int = 8100,
                  search_timeout_s: float = 9.0,
                  merge_top_k: bool = False,
-                 merge_rel_tol: float = 1e-5):
+                 merge_rel_tol: float = 1e-5,
+                 metrics_port: int = 0,
+                 metrics_host: str = "127.0.0.1",
+                 slow_query_threshold_ms: float = 0.0,
+                 trace_requests: bool = True):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
         self.merge_top_k = merge_top_k
         self.merge_rel_tol = merge_rel_tol
+        # observability: /metrics + /healthz port (0 disables, negative
+        # binds OS-ephemeral; host defaults to loopback — exposing the
+        # unauthenticated endpoint is an operator choice) and slow-query
+        # log threshold (0 disables)
+        self.metrics_port = metrics_port
+        self.metrics_host = metrics_host
+        self.slow_query_threshold_ms = slow_query_threshold_ms
+        # False = never repack id-less queries to the extended wire layout
+        # (client.py trace_requests analog) — for backends that must see
+        # reference-exact minor-version-0 bodies; existing wire/text ids
+        # still ride through untouched
+        self.trace_requests = trace_requests
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -192,6 +211,15 @@ class AggregatorContext:
             ("true", "1", "yes"),
             merge_rel_tol=float(reader.get_parameter(
                 "Service", "MergeRelTol", "1e-5")),
+            metrics_port=int(reader.get_parameter(
+                "Service", "MetricsPort", "0")),
+            metrics_host=reader.get_parameter(
+                "Service", "MetricsHost", "127.0.0.1"),
+            slow_query_threshold_ms=float(reader.get_parameter(
+                "Service", "SlowQueryThresholdMs", "0")),
+            trace_requests=reader.get_parameter(
+                "Service", "TraceRequests", "1").lower() in
+            ("1", "true", "on", "yes"),
         )
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
@@ -210,9 +238,21 @@ class AggregatorService:
         self.context = context
         self._server: Optional[asyncio.AbstractServer] = None
         self._reconnect_task: Optional[asyncio.Task] = None
+        self._metrics_http: Optional[MetricsHttpServer] = None
 
     async def start(self, host: Optional[str] = None,
                     port: Optional[int] = None):
+        if self.context.metrics_port or \
+                self.context.slow_query_threshold_ms > 0:
+            metrics.install_request_id_logging()
+        if self.context.metrics_port:
+            # bind first: a metrics-port clash must fail start() before
+            # backend connections, the reconnect task, or the listen
+            # socket exist (no half-started aggregator on error)
+            self._metrics_http = MetricsHttpServer(
+                self.context.metrics_port, health=self._healthz,
+                host=self.context.metrics_host)
+            self._metrics_http.start()
         await self._connect_all()
         self._reconnect_task = asyncio.create_task(self._reconnect_loop())
         host = host or self.context.listen_addr
@@ -224,6 +264,9 @@ class AggregatorService:
         return addr[0], addr[1]
 
     async def stop(self) -> None:
+        if self._metrics_http:
+            self._metrics_http.shutdown()
+            self._metrics_http = None
         if self._reconnect_task:
             self._reconnect_task.cancel()
         if self._server:
@@ -231,6 +274,18 @@ class AggregatorService:
             await self._server.wait_closed()
         for s in self.context.servers:
             s.drop()
+
+    def _healthz(self) -> dict:
+        """/healthz payload: per-backend connectivity; "ok" only with every
+        configured backend connected (load balancers act on the code)."""
+        servers = [{"address": s.address, "port": s.port,
+                    "connected": s.connected}
+                   for s in self.context.servers]
+        n_up = sum(1 for s in servers if s["connected"])
+        status = ("ok" if servers and n_up == len(servers)
+                  else "degraded" if n_up else "down")
+        return {"status": status, "connected": n_up,
+                "configured": len(servers), "servers": servers}
 
     # ---------------------------------------------------------- connections
 
@@ -307,7 +362,14 @@ class AggregatorService:
                         header.connection_id, header.resource_id).pack())
                     await writer.drain()
                 elif t == wire.PacketType.SearchRequest:
-                    result = await self._scatter_gather(body)
+                    metrics.inc("aggregator.requests")
+                    t0 = time.perf_counter()
+                    body, rid = self._ensure_request_id(body)
+                    with trace.span("aggregator.scatter_gather"):
+                        result = await self._scatter_gather(body)
+                    # prefer the id echoed back by a shard (proof the trace
+                    # traversed a backend); fall back to the edge-minted one
+                    result.request_id = result.request_id or rid
                     rbody = result.pack()
                     writer.write(wire.PacketHeader(
                         wire.PacketType.SearchResponse,
@@ -315,10 +377,55 @@ class AggregatorService:
                         header.connection_id, header.resource_id).pack()
                         + rbody)
                     await writer.drain()
+                    total = time.perf_counter() - t0
+                    trace.record("aggregator.request", total)
+                    thresh = self.context.slow_query_threshold_ms
+                    if thresh > 0 and total * 1000.0 >= thresh:
+                        try:
+                            # the status byte is backend-supplied and may
+                            # be outside the enum ("hostile peers send
+                            # anything") — the log line must not raise
+                            status_name = wire.ResultStatus(
+                                result.status).name
+                        except ValueError:
+                            status_name = str(result.status)
+                        token = metrics.set_request_id(rid)
+                        try:
+                            log.warning(
+                                "slow query rid=%s total=%.2fms status=%s "
+                                "results=%d", rid or "-", total * 1000.0,
+                                status_name,
+                                sum(len(r.ids) for r in result.results))
+                        finally:
+                            metrics.reset_request_id(token)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
+
+    def _ensure_request_id(self, body: bytes) -> Tuple[bytes, str]:
+        """This is the id-minting EDGE: a body that already carries a wire
+        or text-channel id is forwarded untouched (the id rides to every
+        shard); an id-less body gets one minted and repacked.  A body that
+        does not decode rides through unchanged — malformed payloads stay
+        one backend's problem, as before."""
+        query = wire.RemoteQuery.unpack(body)
+        if query is None:
+            return body, ""
+        if query.request_id:
+            # attacker-sized wire field: bound it before it reaches logs
+            # (each shard re-caps its own copy at its edge)
+            return body, query.request_id[:64]
+        rid = protocol.request_id_of(query.query)
+        if rid:
+            # the TEXT already carries the id to the shards; no repack
+            return body, rid
+        if not self.context.trace_requests:
+            # operator opted out of extending the wire layout toward
+            # backends that require reference-exact bodies
+            return body, ""
+        query.request_id = wire.new_request_id()
+        return query.pack(), query.request_id
 
     async def _scatter_gather(self, body: bytes) -> wire.RemoteSearchResult:
         """Fan out to every Connected server; flat-merge the per-index
@@ -326,16 +433,21 @@ class AggregatorService:
         (AggregatorService.cpp:206-366)."""
         targets = [(i, s) for i, s in enumerate(self.context.servers)
                    if s.connected]
+        metrics.set_gauge("aggregator.connected_backends", len(targets))
         if not targets:
+            metrics.inc("aggregator.no_backend")
             return wire.RemoteSearchResult(wire.ResultStatus.FailedNetwork,
                                            [])
         tasks = [self._query_one(i, s, body) for i, s in targets]
         replies = await asyncio.gather(*tasks)
         merged = wire.RemoteSearchResult(wire.ResultStatus.Success, [])
-        for status, results in replies:
+        for status, results, shard_rid in replies:
             if status != wire.ResultStatus.Success:
                 merged.status = status
             merged.results.extend(results)
+            # a shard's echo proves the id made the full hop; keep the
+            # first one so the client's response id traveled end to end
+            merged.request_id = merged.request_id or shard_rid
         if self.context.merge_top_k:
             # declared-topology mode keys off the CONFIGURED servers, not
             # the connected subset: if any server declares a ReplicaGroup
@@ -346,7 +458,7 @@ class AggregatorService:
             declared = any(s.replica_group is not None
                            for s in self.context.servers)
             merged.results = merge_top_k(
-                [r for _, r in replies],
+                [r for _, r, _ in replies],
                 rel_tol=self.context.merge_rel_tol,
                 replica_groups=([s.replica_group for _, s in targets]
                                 if declared else None))
@@ -366,7 +478,8 @@ class AggregatorService:
                     # a concurrent drop() (backend reset) beat us to the
                     # lock; writer is gone and our future already failed
                     server.pending.pop(rid, None)
-                    return wire.ResultStatus.FailedNetwork, []
+                    metrics.inc("aggregator.backend_failures")
+                    return wire.ResultStatus.FailedNetwork, [], ""
                 server.writer.write(header.pack() + body)
                 await server.writer.drain()
             _, rbody = await asyncio.wait_for(
@@ -382,17 +495,20 @@ class AggregatorService:
                             "(rid %d)", server.address, server.port, rid)
                 result = None
             if result is None:
-                return wire.ResultStatus.FailedNetwork, []
-            return result.status, result.results
+                metrics.inc("aggregator.malformed_backend_body")
+                return wire.ResultStatus.FailedNetwork, [], ""
+            return result.status, result.results, result.request_id
         except asyncio.TimeoutError:
             # the connection stays up and aligned — the reader task will
             # drop the late reply when it arrives (no resource_id match)
             server.pending.pop(rid, None)
-            return wire.ResultStatus.Timeout, []
+            metrics.inc("aggregator.backend_timeouts")
+            return wire.ResultStatus.Timeout, [], ""
         except OSError:
             server.pending.pop(rid, None)
             server.drop()
-            return wire.ResultStatus.FailedNetwork, []
+            metrics.inc("aggregator.backend_failures")
+            return wire.ResultStatus.FailedNetwork, [], ""
 
 
 def main(argv=None) -> int:

@@ -32,16 +32,22 @@ import threading
 from typing import List, Optional
 
 from sptag_tpu.serve import wire
+from sptag_tpu.serve.protocol import request_id_of
 
 
 class AnnClient:
     def __init__(self, host: str, port: int,
                  timeout_s: float = 9.0,
-                 heartbeat_interval_s: float = 0.0):
+                 heartbeat_interval_s: float = 0.0,
+                 trace_requests: bool = True):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
         self.heartbeat_interval_s = heartbeat_interval_s
+        # trace_requests=False restores reference-EXACT request bytes
+        # (minor version 0, no request-id trailer) for peers that must
+        # see the unextended layout; explicit/text-channel ids still ride
+        self.trace_requests = trace_requests
         self._sock: Optional[socket.socket] = None
         # RLock: search() calls close() from inside its locked region on
         # error paths, and close() itself must hold the lock (the heartbeat
@@ -134,10 +140,17 @@ class AnnClient:
     # ---------------------------------------------------------------- search
 
     def search(self, query: str,
-               timeout_s: Optional[float] = None) -> wire.RemoteSearchResult:
+               timeout_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> wire.RemoteSearchResult:
         """Send one text-protocol query; returns the RemoteSearchResult
         (status Timeout / FailedNetwork on failure, matching the
-        aggregator's partial-result statuses)."""
+        aggregator's partial-result statuses).  Every request carries a
+        request id — `request_id`, the query's own `$requestid` option, or
+        a minted one — echoed back on `result.request_id` so one slow
+        query is traceable through aggregator → shard logs (construct the
+        client with trace_requests=False for reference-exact bytes)."""
+        req_id = request_id or request_id_of(query) or \
+            (wire.new_request_id() if self.trace_requests else "")
         if self._sock is None:
             try:
                 self.connect()
@@ -153,7 +166,7 @@ class AnnClient:
                     wire.ResultStatus.FailedNetwork, [])
             rid = self._next_resource
             self._next_resource += 1
-            body = wire.RemoteQuery(query).pack()
+            body = wire.RemoteQuery(query, request_id=req_id).pack()
             header = wire.PacketHeader(
                 wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
                 len(body), self._remote_cid, rid)
@@ -210,10 +223,13 @@ class PipelinedAnnClient:
     it).  Parity: Socket::ResourceManager (reference
     inc/Socket/ResourceManager.h:31-184)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 9.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 9.0,
+                 trace_requests: bool = True):
         self.host = host
         self.port = port
         self.timeout_s = timeout_s
+        # see AnnClient: False = reference-exact request bytes
+        self.trace_requests = trace_requests
         self._sock: Optional[socket.socket] = None
         self._wlock = threading.Lock()
         self._plock = threading.Lock()      # guards _pending + _next_rid
@@ -318,7 +334,10 @@ class PipelinedAnnClient:
     # ---------------------------------------------------------------- search
 
     def search(self, query: str,
-               timeout_s: Optional[float] = None) -> wire.RemoteSearchResult:
+               timeout_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> wire.RemoteSearchResult:
+        req_id = request_id or request_id_of(query) or \
+            (wire.new_request_id() if self.trace_requests else "")
         if self._sock is None:
             try:
                 self.connect()
@@ -331,7 +350,7 @@ class PipelinedAnnClient:
             rid = self._next_rid
             self._next_rid += 1
             self._pending[rid] = (ev, slot)
-        body = wire.RemoteQuery(query).pack()
+        body = wire.RemoteQuery(query, request_id=req_id).pack()
         header = wire.PacketHeader(
             wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
             len(body), self._remote_cid, rid)
@@ -376,12 +395,14 @@ class AnnClientPool:
     send + callback, ClientWrapper.h:40-49)."""
 
     def __init__(self, host: str, port: int, connections: int = 4,
-                 timeout_s: float = 9.0, max_workers: Optional[int] = None):
+                 timeout_s: float = 9.0, max_workers: Optional[int] = None,
+                 trace_requests: bool = True):
         if connections < 1:
             raise ValueError("connections must be >= 1")
         self.timeout_s = timeout_s
         self._clients: List[PipelinedAnnClient] = [
-            PipelinedAnnClient(host, port, timeout_s)
+            PipelinedAnnClient(host, port, timeout_s,
+                               trace_requests=trace_requests)
             for _ in range(connections)]
         self._rr = 0
         self._rr_lock = threading.Lock()
@@ -417,19 +438,22 @@ class AnnClientPool:
         return self._clients[start]
 
     def search(self, query: str,
-               timeout_s: Optional[float] = None) -> wire.RemoteSearchResult:
+               timeout_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> wire.RemoteSearchResult:
         # a closed pool must not serve: PipelinedAnnClient.search would
         # silently RE-DIAL the dropped socket, leaking a fresh connection
         # + reader thread from a pool the caller already tore down
         if self._closed:
             return wire.RemoteSearchResult(
                 wire.ResultStatus.FailedNetwork, [])
-        return self._pick().search(query, timeout_s)
+        return self._pick().search(query, timeout_s, request_id=request_id)
 
     def search_async(self, query: str,
-                     timeout_s: Optional[float] = None
+                     timeout_s: Optional[float] = None,
+                     request_id: Optional[str] = None
                      ) -> "concurrent.futures.Future[wire.RemoteSearchResult]":
-        return self._executor.submit(self.search, query, timeout_s)
+        return self._executor.submit(self.search, query, timeout_s,
+                                     request_id)
 
     def close(self) -> None:
         self._closed = True

@@ -1,0 +1,106 @@
+"""Metrics exposition endpoint — a tiny stdlib HTTP listener.
+
+Both serving front-ends (serve/server.py, serve/aggregator.py) own one of
+these when their `MetricsPort` is set:
+
+* ``GET /metrics`` — the process-wide registry (utils/metrics.py) in
+  Prometheus text format 0.0.4: request/error counters, queue gauges, and
+  every trace-span latency as a log-bucketed histogram.
+* ``GET /healthz`` — JSON from the owner's health callback (loaded
+  indexes + sample counts for a server, backend connectivity for an
+  aggregator); HTTP 200 when ``status`` is ``ok``, 503 otherwise, so load
+  balancers can act on the code alone.
+
+Port semantics: 0 = disabled (the owner never constructs this), a
+negative port binds OS-ephemeral (tests read the bound port back from
+``.port``).  The bind host defaults to LOOPBACK — the endpoint is
+unauthenticated and /healthz discloses index configuration, so exposing
+it beyond the machine is an explicit operator decision (`MetricsHost`).
+The listener runs on a daemon thread (ThreadingHTTPServer — a stalled
+scrape must not block the next one) and serves GETs only; it is an
+operator surface, deliberately outside the wire protocol's
+attack-hardened framing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from sptag_tpu.utils import metrics
+
+log = logging.getLogger(__name__)
+
+
+class MetricsHttpServer:
+    def __init__(self, port: int, health: Optional[Callable[[], Dict]] = None,
+                 host: str = "127.0.0.1"):
+        self.requested_port = port
+        self.host = host
+        self.health = health
+        self.port: Optional[int] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the bound port."""
+        owner = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):                            # noqa: N802
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = metrics.render_prometheus().encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                        code = 200
+                    elif self.path.split("?")[0] == "/healthz":
+                        try:
+                            state = owner.health() if owner.health else \
+                                {"status": "ok"}
+                        except Exception:                # noqa: BLE001
+                            # a broken health callback must answer 500,
+                            # not reset the probe's connection — a load
+                            # balancer reads a reset as process death
+                            log.exception("health callback failed")
+                            state = {"status": "error"}
+                        body = json.dumps(state).encode()
+                        ctype = "application/json"
+                        code = (200 if state.get("status") == "ok"
+                                else 500 if state.get("status") == "error"
+                                else 503)
+                    else:
+                        body, ctype, code = b"not found\n", "text/plain", 404
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except OSError:
+                    # scraper hung up mid-response — its problem, not ours
+                    log.debug("metrics scrape aborted by peer")
+
+            def log_message(self, fmt, *args):           # noqa: A002
+                log.debug("metrics http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, max(self.requested_port, 0)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="metrics-http", daemon=True)
+        self._thread.start()
+        log.info("metrics endpoint on %s:%d (/metrics, /healthz)",
+                 self.host, self.port)
+        return self.port
+
+    def shutdown(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

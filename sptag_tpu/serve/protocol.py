@@ -20,6 +20,9 @@ knob its IndexSearcher sweeps offline, src/IndexSearcher/main.cpp:66-228),
 and ``searchmode`` (``beam``/``dense``) picks the search engine per request
 — one served index can answer parity-mode and MXU-scan traffic
 concurrently (the reference has a single search path, so no analog).
+``requestid`` carries a trace id in the TEXT protocol — the channel for
+reference C++ clients that cannot set the versioned wire-body field
+(serve/wire.py); servers prefer the wire field and fall back to this.
 """
 
 from __future__ import annotations
@@ -83,6 +86,14 @@ class ParsedQuery:
         return v if v is not None and v > 0 else None
 
     @property
+    def request_id(self) -> Optional[str]:
+        """The `$requestid` trace id, capped at 64 chars (it rides into
+        log records and slow-query lines; a hostile mile-long token must
+        not).  None when absent/empty/oversized."""
+        raw = (self.options.get("requestid") or "").strip()
+        return raw if 0 < len(raw) <= 64 else None
+
+    @property
     def search_mode(self) -> Optional[str]:
         """Per-request engine pick, "beam", "dense", or "auto" (framework
         extension; see module docstring).  "auto" resolves per request by
@@ -117,6 +128,14 @@ class ParsedQuery:
                 return None
             return np.asarray(vals).astype(dt)
         return None
+
+
+def request_id_of(text: str) -> Optional[str]:
+    """The `$requestid` option of a query line, or None — a cheap
+    substring pre-check keeps the common no-id path at one scan."""
+    if "$requestid" not in text.lower():
+        return None
+    return parse_query(text).request_id
 
 
 def parse_query(text: str) -> ParsedQuery:

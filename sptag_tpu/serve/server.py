@@ -18,11 +18,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, Optional, Tuple
 
-from sptag_tpu.serve import wire
+from sptag_tpu.serve import protocol, wire
+from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
-from sptag_tpu.utils import trace
+from sptag_tpu.utils import metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -39,11 +41,22 @@ class SearchServer:
                  batch_window_ms: float = 2.0,
                  max_batch: int = 1024,
                  max_connections: int = 256,
-                 drain_timeout_s: float = 15.0):
+                 drain_timeout_s: float = 15.0,
+                 metrics_port: Optional[int] = None,
+                 slow_query_threshold_ms: Optional[float] = None):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
         self.max_batch = max_batch
+        # observability overrides; None = the [Service] ini settings
+        # (MetricsPort 0 disables, negative binds OS-ephemeral;
+        # SlowQueryThresholdMs 0 disables)
+        self.metrics_port = (metrics_port if metrics_port is not None
+                             else context.settings.metrics_port)
+        self.slow_query_threshold_ms = (
+            slow_query_threshold_ms if slow_query_threshold_ms is not None
+            else context.settings.slow_query_threshold_ms)
+        self._metrics_http: Optional[MetricsHttpServer] = None
         # reference parity: ConnectionManager hands out at most 256
         # connection slots (/root/reference/AnnService/inc/Socket/
         # ConnectionManager.h:23-67); excess clients are closed at accept
@@ -68,6 +81,18 @@ class SearchServer:
                     port: Optional[int] = None) -> Tuple[str, int]:
         host = host or self.context.settings.listen_addr
         port = port if port is not None else self.context.settings.listen_port
+        if self.metrics_port or self.slow_query_threshold_ms > 0:
+            # the slow-query log wants request-id-stamped records even
+            # with the HTTP endpoint disabled
+            metrics.install_request_id_logging()
+        if self.metrics_port:
+            # bind the metrics listener FIRST: an EADDRINUSE here must
+            # fail start() before the serve socket accepts or the batcher
+            # exists — no half-started server to clean up
+            self._metrics_http = MetricsHttpServer(
+                self.metrics_port, health=self._healthz,
+                host=self.context.settings.metrics_host)
+            self._metrics_http.start()
         self._server = await asyncio.start_server(self._on_client, host, port)
         self._batcher_task = asyncio.create_task(self._batcher())
         addr = self._server.sockets[0].getsockname()
@@ -75,11 +100,32 @@ class SearchServer:
         return addr[0], addr[1]
 
     async def stop(self) -> None:
+        if self._metrics_http:
+            self._metrics_http.shutdown()
+            self._metrics_http = None
         if self._batcher_task:
             self._batcher_task.cancel()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
+
+    def _healthz(self) -> dict:
+        """/healthz payload: load state per registered index (sample count,
+        value type, non-default params) plus live connection/queue depth."""
+        indexes = {}
+        for name, index in self.context.indexes.items():
+            info = {"samples": int(getattr(index, "num_samples", -1))}
+            vt = getattr(index, "value_type", None)
+            if vt is not None:
+                info["value_type"] = getattr(vt, "name", str(vt))
+            params = getattr(index, "params", None)
+            if params is not None and hasattr(params, "non_default_items"):
+                info["non_default_params"] = dict(params.non_default_items())
+            indexes[name] = info
+        return {"status": "ok" if indexes else "empty",
+                "indexes": indexes,
+                "connections": len(self._conns),
+                "queue_depth": self._queue.qsize()}
 
     # ------------------------------------------------------------ connection
 
@@ -88,6 +134,7 @@ class SearchServer:
         if len(self._conns) >= self.max_connections:
             # slot table full — close at accept, like the reference's
             # ConnectionManager returning no slot
+            metrics.inc("server.rejected_connections")
             log.warning("connection limit (%d) reached; rejecting client",
                         self.max_connections)
             writer.close()
@@ -100,11 +147,13 @@ class SearchServer:
         # inside asyncio's FlowControlMixin on Python 3.10/3.11 and would
         # kill the batcher — all writes serialize through this lock
         self._conns[cid] = (writer, asyncio.Lock())
+        metrics.set_gauge("server.connections", len(self._conns))
         try:
             while True:
                 head = await reader.readexactly(wire.HEADER_SIZE)
                 header = wire.PacketHeader.unpack(head)
                 if not 0 <= header.body_length <= MAX_BODY_LENGTH:
+                    metrics.inc("server.malformed_packets")
                     log.warning("cid %d: body_length %d exceeds cap; "
                                 "closing", cid, header.body_length)
                     break
@@ -116,9 +165,11 @@ class SearchServer:
         except Exception:                                    # noqa: BLE001
             # malformed header/body must cost only THIS connection, never
             # the server: log and drop the client
+            metrics.inc("server.malformed_packets")
             log.exception("cid %d: malformed packet; closing", cid)
         finally:
             self._conns.pop(cid, None)
+            metrics.set_gauge("server.connections", len(self._conns))
             writer.close()
 
     async def _send(self, cid: int, payload: bytes) -> None:
@@ -140,6 +191,7 @@ class SearchServer:
                 await asyncio.wait_for(writer.drain(),
                                        timeout=self.drain_timeout_s)
         except asyncio.TimeoutError:
+            metrics.inc("server.drain_timeouts")
             log.warning("cid %d: response drain exceeded %.0fs (client "
                         "not reading); evicting", cid,
                         self.drain_timeout_s)
@@ -153,6 +205,7 @@ class SearchServer:
             # BrokenPipeError / ConnectionResetError / anything transport:
             # the reader task's readexactly will observe the close and
             # clean up; the batcher must not die
+            metrics.inc("server.send_errors")
             self._conns.pop(cid, None)
             writer.transport.abort()
 
@@ -172,16 +225,35 @@ class SearchServer:
                                      header.resource_id)
             await self._send(cid, resp.pack())
         elif t == wire.PacketType.SearchRequest:
-            query = wire.RemoteQuery.unpack(body)
+            metrics.inc("server.requests")
+            with trace.span("server.decode"):
+                query = wire.RemoteQuery.unpack(body)
+            if query is None:
+                # a SearchRequest whose body does not decode still gets a
+                # FailedExecute answer downstream, but must be countable
+                metrics.inc("server.malformed_packets")
+            elif not query.request_id:
+                # text-protocol id channel (reference clients can't set
+                # the wire field); stays empty if neither is present
+                query.request_id = protocol.request_id_of(query.query) or ""
+            else:
+                # the wire field is attacker-sized (up to the body cap);
+                # it rides into every log line and response — bound it
+                # like the text channel does
+                query.request_id = query.request_id[:64]
             try:
-                self._queue.put_nowait((cid, header, query))
+                self._queue.put_nowait((cid, header, query,
+                                        time.perf_counter()))
+                metrics.set_gauge("server.queue_depth", self._queue.qsize())
             except asyncio.QueueFull:
                 # shed load at the edge rather than buffering unboundedly;
                 # the client sees a definitive, well-formed FailedExecute
                 # for THIS request (a body-less Dropped header would break
                 # result unpacking on the other side)
+                metrics.inc("server.queue_full")
                 shed = wire.RemoteSearchResult(
-                    wire.ResultStatus.FailedExecute, []).pack()
+                    wire.ResultStatus.FailedExecute, [],
+                    query.request_id if query is not None else "").pack()
                 resp = wire.PacketHeader(wire.PacketType.SearchResponse,
                                          wire.PacketProcessStatus.Dropped,
                                          len(shed), cid, header.resource_id)
@@ -212,9 +284,13 @@ class SearchServer:
             await self._serve_batch(batch)
 
     async def _serve_batch(self, batch) -> None:
+        t_assembled = time.perf_counter()
+        metrics.set_gauge("server.queue_depth", self._queue.qsize())
+        metrics.set_gauge("server.last_batch_size", len(batch))
         texts = []
-        for cid, header, query in batch:
+        for cid, header, query, t_enq in batch:
             texts.append(query.query if query is not None else "")
+            trace.record("server.queue_wait", t_assembled - t_enq)
         loop = asyncio.get_event_loop()
         try:
             def run_batch():
@@ -222,19 +298,46 @@ class SearchServer:
                     return self.executor.execute_batch(texts)
             results = await loop.run_in_executor(None, run_batch)
         except Exception:
+            metrics.inc("server.batch_failures")
             log.exception("batch execution failed")
             results = [wire.RemoteSearchResult(
                 wire.ResultStatus.FailedExecute, [])] * len(batch)
-        for (cid, header, query), result in zip(batch, results):
+        t_executed = time.perf_counter()
+        for (cid, header, query, t_enq), result in zip(batch, results):
             if query is None:
                 result = wire.RemoteSearchResult(
                     wire.ResultStatus.FailedExecute, [])
-            body = result.pack()
+            # echo the request id so the caller (client or aggregator) can
+            # match the response to its trace
+            rid = query.request_id if query is not None else ""
+            result.request_id = rid
+            with trace.span("server.encode"):
+                body = result.pack()
             resp = wire.PacketHeader(
                 wire.PacketType.SearchResponse,
                 wire.PacketProcessStatus.Ok, len(body), cid,
                 header.resource_id)
-            await self._send(cid, resp.pack() + body)
+            t_send0 = time.perf_counter()
+            with trace.span("server.drain"):
+                await self._send(cid, resp.pack() + body)
+            metrics.inc("server.responses")
+            now = time.perf_counter()
+            total = now - t_enq
+            trace.record("server.request", total)
+            thresh = self.slow_query_threshold_ms
+            if thresh > 0 and total * 1000.0 >= thresh:
+                token = metrics.set_request_id(rid)
+                try:
+                    log.warning(
+                        "slow query rid=%s total=%.2fms queue=%.2fms "
+                        "execute=%.2fms send=%.2fms results=%d",
+                        rid or "-", total * 1000.0,
+                        (t_assembled - t_enq) * 1000.0,
+                        (t_executed - t_assembled) * 1000.0,
+                        (now - t_send0) * 1000.0,
+                        sum(len(r.ids) for r in result.results))
+                finally:
+                    metrics.reset_request_id(token)
 
 
 def run_interactive(context: ServiceContext) -> None:

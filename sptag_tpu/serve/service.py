@@ -33,6 +33,7 @@ from sptag_tpu.serve.wire import (
     RemoteSearchResult,
     ResultStatus,
 )
+from sptag_tpu.utils import metrics
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -77,6 +78,17 @@ class ServiceSettings:
     # facades (wrappers/) whose host server is a local child, not for
     # exposing filesystem writes to remote networks.
     admin_persist_root: str = ""
+    # observability (serve/metrics_http.py): port for the /metrics +
+    # /healthz HTTP listener; 0 (default) disables it, negative binds
+    # OS-ephemeral (tests).  The bind host defaults to loopback — the
+    # endpoint is unauthenticated and /healthz discloses index config,
+    # so exposing it to a scrape network is an explicit operator choice
+    metrics_port: int = 0
+    metrics_host: str = "127.0.0.1"
+    # slow-query log threshold: a request whose TOTAL server time
+    # (queue wait + execute + send) reaches this many ms is logged with
+    # its request id, per-stage timings and result count; 0 disables
+    slow_query_threshold_ms: float = 0.0
 
 
 class ServiceContext:
@@ -117,6 +129,12 @@ class ServiceContext:
                 "Service", "AdminMaxDim", "4096")),
             admin_persist_root=reader.get_parameter(
                 "Service", "AdminPersistRoot", ""),
+            metrics_port=int(reader.get_parameter(
+                "Service", "MetricsPort", "0")),
+            metrics_host=reader.get_parameter(
+                "Service", "MetricsHost", "127.0.0.1"),
+            slow_query_threshold_ms=float(reader.get_parameter(
+                "Service", "SlowQueryThresholdMs", "0")),
         )
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
@@ -277,6 +295,7 @@ class SearchExecutor:
         from sptag_tpu.core.index import create_instance
         from sptag_tpu.core.types import ErrorCode
 
+        metrics.inc("service.admin_ops")
         if not self.context.settings.enable_remote_admin:
             return self._admin_reply(False, "disabled")
         op = parsed.options.get("admin", "").lower()
@@ -470,6 +489,7 @@ class SearchExecutor:
                     max_check=self._sanitize_max_check(parsed),
                     search_mode=self._sanitize_search_mode(parsed, index))
             except Exception:
+                metrics.inc("service.search_errors")
                 log.exception("search failed on index %s", name)
                 return RemoteSearchResult(ResultStatus.FailedExecute, [])
             out.results.append(IndexSearchResult(
@@ -524,6 +544,7 @@ class SearchExecutor:
                         search_mode=self._sanitize_search_mode(
                             parsed[ok[0]], index))
                 except Exception:
+                    metrics.inc("service.search_errors")
                     log.exception("batch search failed on index %s", name)
                     for i in ok:
                         results[i] = RemoteSearchResult(

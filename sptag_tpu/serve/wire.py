@@ -16,6 +16,16 @@ Parity targets (all under /root/reference/AnnService/):
 
 A C++ reference client can talk to this server and vice versa — the framing
 and bodies are bit-identical on x86 (little-endian).
+
+Framework extension (observability): RemoteQuery / RemoteSearchResult may
+carry a REQUEST ID, appended as one extra length-prefixed string after the
+reference fields and signalled by bumping the minor ("mirror") version to
+1.  A body without an id packs byte-identically to the reference (minor 0,
+no trailer), and unpack accepts both — so reference peers interoperate
+unchanged while this stack's edges (client / aggregator) mint an id that
+rides every hop and comes back in the response (the text protocol's
+`$requestid:` option is the equivalent channel for clients that cannot
+set the body field).
 """
 
 from __future__ import annotations
@@ -23,6 +33,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import struct
+import uuid
 from typing import List, Optional, Tuple
 
 HEADER_SIZE = 16
@@ -108,30 +119,50 @@ def read_string(buf: bytes, off: int) -> Tuple[bytes, int]:
     return bytes(buf[off:off + n]), off + n
 
 
+def new_request_id() -> str:
+    """Mint a request id at the edge (client / aggregator) — 16 hex chars,
+    unique enough to trace one query across aggregator → shard logs."""
+    return uuid.uuid4().hex[:16]
+
+
 @dataclasses.dataclass
 class RemoteQuery:
-    """inc/Socket/RemoteSearchQuery.h:23-46; version (1, 0), type String=0."""
+    """inc/Socket/RemoteSearchQuery.h:23-46; version (1, 0), type String=0.
+
+    `request_id` is the framework's traceability extension (module
+    docstring): empty packs the exact reference bytes; non-empty bumps the
+    minor version to MIRROR_RID and appends one trailing string."""
 
     query: str = ""
     query_type: int = 0
+    request_id: str = ""
 
     MAJOR = 1
     MIRROR = 0
+    MIRROR_RID = 1            # minor version signalling a request-id trailer
 
     def pack(self) -> bytes:
-        return (_U16X2_U8.pack(self.MAJOR, self.MIRROR, self.query_type)
-                + write_string(self.query))
+        mirror = self.MIRROR_RID if self.request_id else self.MIRROR
+        out = (_U16X2_U8.pack(self.MAJOR, mirror, self.query_type)
+               + write_string(self.query))
+        if self.request_id:
+            out += write_string(self.request_id)
+        return out
 
     @classmethod
     def unpack(cls, buf: bytes) -> Optional["RemoteQuery"]:
         try:
-            major, _, qtype = _U16X2_U8.unpack_from(buf, 0)
+            major, mirror, qtype = _U16X2_U8.unpack_from(buf, 0)
             if major != cls.MAJOR:
                 return None
-            q, _ = read_string(buf, _U16X2_U8.size)
+            q, off = read_string(buf, _U16X2_U8.size)
+            rid = b""
+            if mirror >= cls.MIRROR_RID and off < len(buf):
+                rid, off = read_string(buf, off)
         except struct.error:
             return None       # truncated body — hostile peers send anything
-        return cls(q.decode("utf-8", "replace"), qtype)
+        return cls(q.decode("utf-8", "replace"), qtype,
+                   rid.decode("utf-8", "replace"))
 
 
 @dataclasses.dataclass
@@ -148,16 +179,20 @@ class IndexSearchResult:
 class RemoteSearchResult:
     """inc/Socket/RemoteSearchQuery.h:57-92 — flat list of per-index result
     lists; the aggregator concatenates these without re-ranking
-    (AggregatorService.cpp:316-366)."""
+    (AggregatorService.cpp:316-366).  `request_id` echoes the query's id
+    (same versioned-trailer scheme as RemoteQuery)."""
 
     status: int = ResultStatus.Timeout
     results: List[IndexSearchResult] = dataclasses.field(default_factory=list)
+    request_id: str = ""
 
     MAJOR = 1
     MIRROR = 0
+    MIRROR_RID = 1
 
     def pack(self) -> bytes:
-        out = [_U16X2_U8.pack(self.MAJOR, self.MIRROR, self.status),
+        mirror = self.MIRROR_RID if self.request_id else self.MIRROR
+        out = [_U16X2_U8.pack(self.MAJOR, mirror, self.status),
                _U32.pack(len(self.results))]
         for r in self.results:
             out.append(write_string(r.index_name))
@@ -169,12 +204,14 @@ class RemoteSearchResult:
             if with_meta:
                 for m in r.metas:
                     out.append(write_string(m))
+        if self.request_id:
+            out.append(write_string(self.request_id))
         return b"".join(out)
 
     @classmethod
     def unpack(cls, buf: bytes) -> Optional["RemoteSearchResult"]:
         try:
-            major, _, status = _U16X2_U8.unpack_from(buf, 0)
+            major, mirror, status = _U16X2_U8.unpack_from(buf, 0)
             if major != cls.MAJOR:
                 return None
             off = _U16X2_U8.size
@@ -202,6 +239,9 @@ class RemoteSearchResult:
                         metas.append(m)
                 results.append(IndexSearchResult(
                     name.decode("utf-8", "replace"), ids, dists, metas))
+            rid = b""
+            if mirror >= cls.MIRROR_RID and off < len(buf):
+                rid, off = read_string(buf, off)
         except struct.error:
             return None       # truncated body — hostile peers send anything
-        return cls(status, results)
+        return cls(status, results, rid.decode("utf-8", "replace"))

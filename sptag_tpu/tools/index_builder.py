@@ -61,6 +61,10 @@ def main(argv=None) -> int:
     parser.add_argument("--platform", default=None,
                         help="pin the jax platform (e.g. cpu); default "
                         "honors SPTAG_TPU_PLATFORM")
+    parser.add_argument("--trace-report", action="store_true",
+                        help="print the span report (count/total/max/"
+                        "p50/p90/p99 per build stage, incl. XLA compile "
+                        "spans) as JSON on exit")
     args = parser.parse_args(argv)
     pin_platform(args.platform)
 
@@ -97,6 +101,11 @@ def main(argv=None) -> int:
         log.error("save failed: %s", code)
         return 1
     log.info("saved index to %s", args.outputfolder)
+    if args.trace_report:
+        import json
+
+        from sptag_tpu.utils import trace
+        print(json.dumps(trace.report(), indent=2, sort_keys=True))
     return 0
 
 

@@ -31,7 +31,7 @@ import numpy as np
 from sptag_tpu.core.index import load_index
 from sptag_tpu.io.reader import ReaderOptions, load_vectors
 from sptag_tpu.tools.index_builder import split_passthrough
-from sptag_tpu.utils import pin_platform
+from sptag_tpu.utils import pin_platform, trace
 
 log = logging.getLogger(__name__)
 
@@ -76,6 +76,10 @@ def main(argv=None) -> int:
     parser.add_argument("--platform", default=None,
                         help="pin the jax platform (e.g. cpu); default "
                         "honors SPTAG_TPU_PLATFORM")
+    parser.add_argument("--trace-report", action="store_true",
+                        help="print the span report (count/total/max/"
+                        "p50/p90/p99, incl. XLA compile spans) as JSON "
+                        "after the sweep")
     args = parser.parse_args(argv)
     pin_platform(args.platform)
 
@@ -106,7 +110,9 @@ def main(argv=None) -> int:
         for off in range(0, len(q), args.batch):
             t0 = time.perf_counter()
             _, ids = index.search_batch(q[off:off + args.batch], k)
-            batch_times.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            batch_times.append(dt)
+            trace.record("searcher.search_batch", dt)
             all_ids[off:off + args.batch] = ids
         total = time.perf_counter() - t_total0
         qps = len(q) / total
@@ -121,6 +127,9 @@ def main(argv=None) -> int:
                 out_f.write(" ".join(str(int(v)) for v in row) + "\n")
     if out_f:
         out_f.close()
+    if args.trace_report:
+        import json
+        print(json.dumps(trace.report(), indent=2, sort_keys=True))
     return 0
 
 

@@ -7,8 +7,11 @@ framework.  Two cooperating layers:
 
 * host spans — `span("name")` context managers record wall-time into a
   process-wide registry; `report()` aggregates count/total/mean/max per
-  name.  Cheap enough to leave on in production paths (a perf_counter pair
-  and a dict update per span).
+  name plus p50/p90/p99 derived from the log-bucketed histogram every
+  `record()` also feeds (utils/metrics.py — which is how the Prometheus
+  endpoint exports span latencies with no extra wiring).  Cheap enough to
+  leave on in production paths (a perf_counter pair, a dict update and a
+  histogram bucket increment per span).
 * device tracing — the same `span` emits a `jax.profiler.TraceAnnotation`
   when a jax profiler trace is active, so host spans line up with device
   timelines in TensorBoard/Perfetto; `start_trace(logdir)` / `stop_trace()`
@@ -23,6 +26,8 @@ import contextlib
 import threading
 import time
 from typing import Dict, Iterator, Optional
+
+from sptag_tpu.utils import metrics
 
 _lock = threading.Lock()
 _spans: Dict[str, list] = {}      # name -> [count, total_s, max_s]
@@ -57,20 +62,33 @@ def record(name: str, seconds: float) -> None:
         rec[0] += 1
         rec[1] += seconds
         rec[2] = max(rec[2], seconds)
+    metrics.observe(name, seconds)
 
 
 def report() -> Dict[str, Dict[str, float]]:
-    """Snapshot of all spans: {name: {count, total_s, mean_s, max_s}}."""
+    """Snapshot of all spans: {name: {count, total_s, mean_s, max_s,
+    p50_s, p90_s, p99_s}} — the percentiles come from the log-bucketed
+    metrics histogram each record() feeds (upper-bound estimates, within
+    one ~1.3x bucket of the true quantile)."""
     with _lock:
-        return {
-            name: {"count": c, "total_s": round(t, 6),
-                   "mean_s": round(t / c, 6) if c else 0.0,
-                   "max_s": round(mx, 6)}
-            for name, (c, t, mx) in _spans.items()
-        }
+        spans = {name: tuple(rec) for name, rec in _spans.items()}
+    out: Dict[str, Dict[str, float]] = {}
+    for name, (c, t, mx) in spans.items():
+        entry = {"count": c, "total_s": round(t, 6),
+                 "mean_s": round(t / c, 6) if c else 0.0,
+                 "max_s": round(mx, 6)}
+        h = metrics.histogram_or_none(name)
+        if h is not None and h.count:
+            entry.update({"p50_s": round(h.percentile(50), 6),
+                          "p90_s": round(h.percentile(90), 6),
+                          "p99_s": round(h.percentile(99), 6)})
+        out[name] = entry
+    return out
 
 
 def reset() -> None:
+    """Clear the span registry (the paired metrics histograms are cleared
+    by metrics.reset(); tests/conftest.py resets both)."""
     with _lock:
         _spans.clear()
 

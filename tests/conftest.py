@@ -32,6 +32,19 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_telemetry_registries():
+    """Start every test with empty trace-span and metrics registries —
+    both are process-global, so without this a span/counter assertion in
+    one test would see every earlier test's serving traffic (and the
+    suite's pass/fail would depend on execution order)."""
+    from sptag_tpu.utils import metrics, trace
+
+    trace.reset()
+    metrics.reset()
+    yield
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Release compiled-executable state between test modules.

@@ -389,6 +389,81 @@ def test_gl501_int32_accumulating_dot_clean():
 
 
 # ---------------------------------------------------------------------------
+# GL6xx observability names (metric-cardinality bound)
+# ---------------------------------------------------------------------------
+
+def test_gl601_dynamic_span_name_flagged():
+    src = (
+        "from sptag_tpu.utils import trace\n"
+        "def serve_one(index_name, q):\n"
+        "    with trace.span(f'serve.{index_name}'):\n"
+        "        return q\n"
+        "def record_it(stage, dt):\n"
+        "    trace.record('stage.' + stage, dt)\n"
+    )
+    found = lint_one(src, select=["GL601"])
+    assert rules_of(found) == ["GL601"]
+    assert len(found) == 2
+    assert found[0].symbol == "serve_one"
+
+
+def test_gl601_literal_and_module_constant_clean():
+    src = (
+        "from sptag_tpu.utils import trace\n"
+        "SPAN = 'serve.execute'\n"
+        "def serve_one(q):\n"
+        "    with trace.span('serve.decode'):\n"
+        "        pass\n"
+        "    trace.record(SPAN, 0.5)\n"
+        "    return q\n"
+    )
+    assert lint_one(src, select=["GL601"]) == []
+
+
+def test_gl601_out_of_family_trace_calls_clean():
+    """Only span/record carry names; report()/reset() and unrelated
+    modules that happen to bind the name `trace` stay out of scope."""
+    src = (
+        "from sptag_tpu.utils import trace\n"
+        "import contextlib as trace2\n"
+        "def done(tag):\n"
+        "    trace.report()\n"
+        "    trace2.suppress(tag)\n"
+    )
+    assert lint_one(src, select=["GL601", "GL602"]) == []
+
+
+def test_gl602_dynamic_metrics_name_flagged():
+    src = (
+        "from sptag_tpu.utils import metrics\n"
+        "def count(kind):\n"
+        "    metrics.inc('server.%s' % kind)\n"
+        "    metrics.histogram(kind).observe(0.1)\n"
+    )
+    found = lint_one(src, select=["GL602"])
+    assert rules_of(found) == ["GL602"]
+    assert len(found) == 2
+    assert "string literal" in found[0].message
+
+
+def test_gl602_literal_and_from_import_forms():
+    """Literals pass; the from-imported function form is resolved too."""
+    clean = (
+        "from sptag_tpu.utils import metrics\n"
+        "def count():\n"
+        "    metrics.inc('server.requests')\n"
+        "    metrics.set_gauge('server.queue_depth', 3)\n"
+    )
+    assert lint_one(clean, select=["GL602"]) == []
+    dirty = (
+        "from sptag_tpu.utils.metrics import observe\n"
+        "def time_it(name, dt):\n"
+        "    observe(name, dt)\n"
+    )
+    assert rules_of(lint_one(dirty, select=["GL602"])) == ["GL602"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery + the tier-1 repo gate
 # ---------------------------------------------------------------------------
 
@@ -433,6 +508,7 @@ def test_every_rule_has_an_id_and_description():
         "GL301", "GL302",
         "GL401", "GL402",
         "GL501",
+        "GL601", "GL602",
     }
     assert all(ALL_RULES[r] for r in ALL_RULES)
 

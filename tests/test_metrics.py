@@ -1,0 +1,258 @@
+"""utils/metrics.py + serve/metrics_http.py unit tests: bucket scheme,
+percentile sanity, Prometheus rendering, trace integration, the request-id
+logging filter, thread-safety under a hammering pool, and the HTTP
+exposition endpoint.  (The end-to-end serving assertions live in
+tests/test_serve.py.)"""
+
+import http.client
+import json
+import logging
+import threading
+
+import pytest
+
+from sptag_tpu.utils import metrics, trace
+from sptag_tpu.utils.threadpool import ThreadPool
+
+
+# ------------------------------------------------------------- instruments
+
+def test_counter_and_gauge_basics():
+    metrics.inc("t.requests")
+    metrics.inc("t.requests", 4)
+    assert metrics.counter_value("t.requests") == 5
+    assert metrics.counter_value("t.never_touched") == 0
+    metrics.set_gauge("t.depth", 7)
+    assert metrics.gauge("t.depth").value == 7.0
+    metrics.gauge("t.depth").inc(-2)
+    assert metrics.gauge("t.depth").value == 5.0
+
+
+def test_histogram_bucket_scheme_and_percentiles():
+    # bounds grow by ~1.3 from 1 µs — any quantile estimate is within one
+    # bucket of the truth
+    for a, b in zip(metrics.BUCKET_BOUNDS, metrics.BUCKET_BOUNDS[1:]):
+        assert b == pytest.approx(a * metrics.BUCKET_GROWTH)
+    h = metrics.histogram("t.lat")
+    assert h.percentile(50) == 0.0                 # empty
+    for ms in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):    # 90% at 1ms, max 100ms
+        h.observe(ms / 1000.0)
+    assert h.count == 10
+    assert h.sum == pytest.approx(0.109)
+    assert h.max == pytest.approx(0.1)
+    # p50 within one growth factor of the true 1 ms median
+    assert 0.001 <= h.percentile(50) <= 0.001 * metrics.BUCKET_GROWTH
+    # p99 lands in the 100 ms outlier's bucket
+    assert 0.1 <= h.percentile(99) <= 0.1 * metrics.BUCKET_GROWTH
+    assert h.percentile(50) <= h.percentile(90) <= h.percentile(99)
+    # values past the last bound report the exact observed max
+    h2 = metrics.histogram("t.overflow")
+    h2.observe(99999.0)
+    assert h2.percentile(99) == 99999.0
+
+
+def test_prometheus_rendering():
+    metrics.inc("t.reqs", 3)
+    metrics.set_gauge("t.queue_depth", 2)
+    h = metrics.histogram("t.span")
+    h.observe(0.002)
+    h.observe(0.004)
+    text = metrics.render_prometheus()
+    assert "# TYPE sptag_tpu_t_reqs_total counter" in text
+    assert "sptag_tpu_t_reqs_total 3" in text
+    assert "sptag_tpu_t_queue_depth 2" in text
+    assert "# TYPE sptag_tpu_t_span_seconds histogram" in text
+    assert 'sptag_tpu_t_span_seconds_bucket{le="+Inf"} 2' in text
+    assert "sptag_tpu_t_span_seconds_count 2" in text
+    # bucket counts are CUMULATIVE and end at the total
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("sptag_tpu_t_span_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts) and counts[-1] == 2
+
+
+def test_snapshot_plain_data_view():
+    """snapshot() is the programmatic (non-Prometheus) registry view."""
+    metrics.inc("t.snap_c", 2)
+    metrics.set_gauge("t.snap_g", 1.5)
+    metrics.observe("t.snap_h", 0.01)
+    snap = metrics.snapshot()
+    assert snap["counters"]["t.snap_c"] == 2
+    assert snap["gauges"]["t.snap_g"] == 1.5
+    h = snap["histograms"]["t.snap_h"]
+    assert h["count"] == 1 and h["sum"] == pytest.approx(0.01)
+    assert 0 < h["p50"] <= h["p99"] <= h["max"] * metrics.BUCKET_GROWTH
+
+
+def test_reset_isolates_registry():
+    metrics.inc("t.gone")
+    metrics.reset()
+    assert metrics.counter_value("t.gone") == 0
+    assert "t_gone" not in metrics.render_prometheus()
+
+
+# ------------------------------------------------------ trace integration
+
+def test_trace_report_gains_percentiles():
+    for ms in (1, 1, 1, 50):
+        trace.record("t.stage", ms / 1000.0)
+    rep = trace.report()["t.stage"]
+    assert rep["count"] == 4
+    assert rep["total_s"] == pytest.approx(0.053)
+    assert rep["p50_s"] <= rep["p90_s"] <= rep["p99_s"]
+    assert 0.001 <= rep["p50_s"] <= 0.001 * metrics.BUCKET_GROWTH
+    assert rep["p99_s"] >= 0.05
+    # the same data is live on the Prometheus surface with no extra wiring
+    assert "sptag_tpu_t_stage_seconds_count 4" in metrics.render_prometheus()
+
+
+def test_trace_span_feeds_histogram():
+    with trace.span("t.span_ctx"):
+        pass
+    assert metrics.histogram("t.span_ctx").count == 1
+    assert "p50_s" in trace.report()["t.span_ctx"]
+
+
+# ----------------------------------------------------------- thread-safety
+
+def test_registry_thread_safety_under_hammering_pool():
+    """8 workers x 2000 ops against ONE counter, ONE gauge and ONE
+    histogram (creation races included: every op re-resolves by name).
+    Exact final counts pin the locking — a lost update shows up as a
+    short count."""
+    n_threads, n_ops = 8, 2000
+    pool = ThreadPool()
+    pool.init(n_threads)
+    start = threading.Barrier(n_threads)
+
+    def hammer():
+        start.wait(timeout=30)
+        for i in range(n_ops):
+            metrics.inc("t.hammer")
+            metrics.observe("t.hammer_lat", 0.001 * ((i % 7) + 1))
+            metrics.set_gauge("t.hammer_gauge", i)
+
+    for _ in range(n_threads):
+        pool.add(hammer)
+    pool.join()
+    pool.stop()
+    total = n_threads * n_ops
+    assert metrics.counter_value("t.hammer") == total
+    h = metrics.histogram("t.hammer_lat")
+    assert h.count == total
+    # cumulative bucket counts are consistent with the total
+    assert h.bucket_counts()[-1] == (float("inf"), total)
+    assert h.percentile(50) >= 0.001
+
+
+# ------------------------------------------------------- request-id filter
+
+def test_request_id_log_filter():
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture()
+    handler.addFilter(metrics.RequestIdLogFilter())
+    logger = logging.getLogger("test.rid")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info("outside any request")
+        token = metrics.set_request_id("rid-abc123")
+        try:
+            logger.info("inside the request")
+        finally:
+            metrics.reset_request_id(token)
+        logger.info("after the request")
+    finally:
+        logger.removeHandler(handler)
+    assert [r.request_id for r in records] == ["-", "rid-abc123", "-"]
+
+
+def test_install_request_id_logging_stamps_via_record_factory():
+    """install_request_id_logging() works through the log-record factory,
+    so handlers attached LATER (and ones with no filter) still see
+    record.request_id — the late-basicConfig case a handler filter
+    misses."""
+    metrics.install_request_id_logging()
+    metrics.install_request_id_logging()           # idempotent
+    records = []
+
+    class Capture(logging.Handler):                # note: NO filter
+        def emit(self, record):
+            records.append(record)
+
+    logger = logging.getLogger("test.rid.factory")
+    handler = Capture()
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        token = metrics.set_request_id("rid-factory")
+        try:
+            logger.info("stamped by the factory")
+        finally:
+            metrics.reset_request_id(token)
+        logger.info("outside")
+    finally:
+        logger.removeHandler(handler)
+    assert [r.request_id for r in records] == ["rid-factory", "-"]
+
+
+# ------------------------------------------------------------ http endpoint
+
+def test_metrics_http_server_serves_metrics_and_healthz():
+    from sptag_tpu.serve.metrics_http import MetricsHttpServer
+
+    metrics.inc("t.http_reqs", 2)
+    health = {"status": "ok", "indexes": {"main": {"samples": 42}}}
+    srv = MetricsHttpServer(-1, health=lambda: dict(health))
+    port = srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "sptag_tpu_t_http_reqs_total 2" in text
+
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read()) == health
+
+        # degraded state answers 503 so load balancers can act on the code
+        health["status"] = "degraded"
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 503
+        resp.read()
+
+        conn.request("GET", "/nope")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        conn.close()
+    finally:
+        srv.shutdown()
+
+
+def test_metrics_http_health_callback_exception_answers_500():
+    """A broken health callback must answer HTTP 500 — a connection reset
+    would read as process death to the probing load balancer."""
+    from sptag_tpu.serve.metrics_http import MetricsHttpServer
+
+    srv = MetricsHttpServer(-1, health=lambda: 1 // 0)
+    port = srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 500
+        assert json.loads(resp.read()) == {"status": "error"}
+        conn.close()
+    finally:
+        srv.shutdown()

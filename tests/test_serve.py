@@ -7,6 +7,8 @@ that gap per the survey's prescription."""
 
 import asyncio
 import base64
+import json
+import logging
 import os
 import threading
 import time
@@ -16,6 +18,7 @@ import pytest
 
 import sptag_tpu as sp
 from sptag_tpu.serve import wire
+from sptag_tpu.utils import metrics
 from sptag_tpu.serve.aggregator import AggregatorContext, AggregatorService, RemoteServer
 from sptag_tpu.serve.client import AnnClient
 from sptag_tpu.serve.protocol import parse_query
@@ -545,6 +548,10 @@ def test_server_survives_malformed_packets():
         assert res.status == wire.ResultStatus.Success
         assert res.results[0].ids[0] == 3
         client.close()
+        # each attack shape incremented the named error counter: (a) the
+        # oversized body_length and (b) the garbage RemoteQuery body —
+        # dashboards see the hostile traffic, not just log lines
+        assert metrics.counter_value("server.malformed_packets") >= 2
     finally:
         t.stop()
 
@@ -588,6 +595,7 @@ def test_server_connection_cap():
         assert res.results[0].ids[0] == 5
         c4.close()
         c1.close()
+        assert metrics.counter_value("server.rejected_connections") >= 1
     finally:
         t.stop()
 
@@ -911,6 +919,8 @@ def test_server_sheds_load_when_queue_full():
                     assert rr.status == wire.ResultStatus.Success
         assert dropped > 0, "flood never tripped the bounded queue"
         assert served > 0, "server served nothing"
+        # every shed response is also a named counter increment
+        assert metrics.counter_value("server.queue_full") == dropped
         s.close()
     finally:
         t.stop()
@@ -930,12 +940,18 @@ def test_server_evicts_slow_reader_without_stalling_batcher():
     t.start()
     host, port = t.wait_ready()
     try:
-        # non-reading flooder: big resultnum -> fat responses fill the
-        # 64 KiB transport buffer quickly
-        s = socket.create_connection((host, port), timeout=10)
-        qtext = "$resultnum:50 " + "|".join(str(x) for x in data[3])
+        # non-reading flooder: a SHRUNK receive buffer plus ~10 MB of fat
+        # responses — the kernel autotunes the server's send buffer up to
+        # tcp_wmem[2] (4 MB here), so anything smaller is absorbed without
+        # drain() ever blocking and the eviction never fires
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        s.settimeout(10)
+        s.connect((host, port))
+        qtext = ("$resultnum:200 $extractmetadata:true "
+                 + "|".join(str(x) for x in data[3]))
         body = wire.RemoteQuery(qtext).pack()
-        for rid in range(400):
+        for rid in range(3000):
             h = wire.PacketHeader(wire.PacketType.SearchRequest,
                                   wire.PacketProcessStatus.Ok, len(body),
                                   0, rid)
@@ -943,6 +959,15 @@ def test_server_evicts_slow_reader_without_stalling_batcher():
                 s.sendall(h.pack() + body)
             except OSError:
                 break                       # server already evicted us
+        # the eviction lands in the registry, not just the log (the
+        # drain-timeout counter; send_errors if the transport died first)
+        deadline = time.time() + 15
+        while time.time() < deadline and not (
+                metrics.counter_value("server.drain_timeouts")
+                + metrics.counter_value("server.send_errors")):
+            time.sleep(0.05)
+        assert metrics.counter_value("server.drain_timeouts") \
+            + metrics.counter_value("server.send_errors") >= 1
         # the healthy client must still get answers while/after the
         # flooder is stalled+evicted
         c = AnnClient(host, port, timeout_s=20.0)
@@ -1585,3 +1610,214 @@ def test_index_host_child_lifecycle(tmp_path):
     finally:
         proc.kill()
         proc.wait(timeout=10)
+
+
+# ------------------------------------------------------------ observability
+
+def test_wire_request_id_roundtrip_and_reference_byte_parity():
+    """The request-id rides as a minor-versioned TRAILER: bodies without
+    one stay bit-identical to the reference layout (golden fixtures pin
+    the exact bytes), bodies with one round-trip it."""
+    q0 = wire.RemoteQuery("1|2|3")
+    assert q0.pack()[2:4] == b"\x00\x00"           # minor version 0
+    assert wire.RemoteQuery.unpack(q0.pack()).request_id == ""
+    q1 = wire.RemoteQuery("1|2|3", request_id="rid0123456789abcd")
+    assert q1.pack()[2:4] == b"\x01\x00"           # minor version 1
+    assert q1.pack().startswith(q0.pack()[:2])
+    q2 = wire.RemoteQuery.unpack(q1.pack())
+    assert (q2.query, q2.request_id) == ("1|2|3", "rid0123456789abcd")
+
+    r = wire.RemoteSearchResult(wire.ResultStatus.Success, [
+        wire.IndexSearchResult("a", [1], [0.5], [b"m1"])],
+        request_id="ridX")
+    r2 = wire.RemoteSearchResult.unpack(r.pack())
+    assert r2.request_id == "ridX"
+    assert r2.results[0].metas == [b"m1"]
+    no_rid = wire.RemoteSearchResult(wire.ResultStatus.Success, [])
+    assert wire.RemoteSearchResult.unpack(no_rid.pack()).request_id == ""
+
+    # text-protocol channel (reference clients): $requestid option
+    from sptag_tpu.serve.protocol import request_id_of
+    assert request_id_of("$requestid:abc 1|2|3") == "abc"
+    assert request_id_of("1|2|3") is None
+    assert request_id_of("$requestid:" + "x" * 65 + " 1|2") is None
+
+
+def _http_get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    return resp.status, body
+
+
+def test_observability_end_to_end_aggregator_two_shards():
+    """THE acceptance loop (ISSUE 2): two shard servers behind an
+    aggregator, all three with MetricsPort enabled; queries flow; then
+    assert (a) the Prometheus endpoints serve request counters and latency
+    histograms with sane percentiles, (b) a client-minted request id
+    round-trips client -> aggregator -> shard -> response (shard slow-query
+    logs prove the shard saw it), (c) an injected malformed packet
+    increments the error counter, (d) /healthz reports index load state and
+    backend connectivity."""
+    import socket
+
+    ctx_a, data = _make_context(name="shard_a")
+    ctx_b, _ = _make_context(name="shard_b")
+    # threshold low enough that EVERY query logs a slow-query line — the
+    # shard-side line carrying the client's rid is the propagation proof
+    srv_a = SearchServer(ctx_a, batch_window_ms=1.0, metrics_port=-1,
+                         slow_query_threshold_ms=1e-6)
+    srv_b = SearchServer(ctx_b, batch_window_ms=1.0, metrics_port=-1,
+                         slow_query_threshold_ms=1e-6)
+    ta, tb = _ServerThread(srv_a), _ServerThread(srv_b)
+    ta.start()
+    tb.start()
+    (ha, pa), (hb, pb) = ta.wait_ready(), tb.wait_ready()
+
+    agg_ctx = AggregatorContext(search_timeout_s=10.0, metrics_port=-1)
+    agg_ctx.servers = [RemoteServer(ha, pa), RemoteServer(hb, pb)]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    shard_log = logging.getLogger("sptag_tpu.serve.server")
+    capture = Capture()
+    shard_log.addHandler(capture)
+    try:
+        client = AnnClient(hg, pg, timeout_s=10.0)
+        client.connect()
+        qtext = ("$indexname:shard_a,shard_b "
+                 + "|".join(str(x) for x in data[5]))
+        # (b) explicit client-minted id round-trips the WHOLE loop: the
+        # aggregator takes the response id from a shard's echo, so
+        # equality proves client -> aggregator -> shard -> response
+        res = client.search(qtext, request_id="e2e-rid-0042")
+        assert res.status == wire.ResultStatus.Success
+        assert res.request_id == "e2e-rid-0042"
+        assert sorted(r.index_name for r in res.results) == \
+            ["shard_a", "shard_b"]
+        # ...and the shard-side slow-query log carries the same id with
+        # per-stage timings
+        assert any("rid=e2e-rid-0042" in m and "queue=" in m
+                   and "execute=" in m for m in records)
+        # an auto-minted id is still echoed (client edge generates one)
+        res2 = client.search(qtext)
+        assert res2.status == wire.ResultStatus.Success
+        assert len(res2.request_id) == 16
+        for _ in range(6):
+            client.search(qtext)
+
+        # (c) injected malformed packet -> named error counter
+        before = metrics.counter_value("server.malformed_packets")
+        s = socket.create_connection((ha, pa), timeout=5)
+        junk = b"\xff" * 32
+        h = wire.PacketHeader(wire.PacketType.SearchRequest,
+                              wire.PacketProcessStatus.Ok, len(junk), 0, 0)
+        s.sendall(h.pack() + junk)
+        s.settimeout(5)
+        s.recv(4096)                          # wait for the FailedExecute
+        s.close()
+        assert metrics.counter_value("server.malformed_packets") > before
+
+        # (a) Prometheus endpoints: counters + histograms, sane percentiles
+        for srv in (srv_a, srv_b):
+            status, text = _http_get(srv._metrics_http.port, "/metrics")
+            assert status == 200
+            assert "sptag_tpu_server_requests_total" in text
+            assert "sptag_tpu_server_request_seconds_bucket" in text
+            assert "sptag_tpu_server_execute_batch_seconds_count" in text
+        status, text = _http_get(agg._metrics_http.port, "/metrics")
+        assert status == 200
+        assert "sptag_tpu_aggregator_requests_total" in text
+        assert "sptag_tpu_aggregator_request_seconds_bucket" in text
+        req_hist = metrics.histogram("server.request")
+        assert req_hist.count >= 8
+        p50, p99 = req_hist.percentile(50), req_hist.percentile(99)
+        assert 0 < p50 <= p99 < 60.0           # sane seconds, not garbage
+        qh = metrics.histogram("server.queue_wait")
+        assert qh.count >= 8 and qh.percentile(50) >= 0
+
+        # (d) /healthz: index load state on shards, connectivity on the agg
+        status, body = _http_get(srv_a._metrics_http.port, "/healthz")
+        state = json.loads(body)
+        assert status == 200 and state["status"] == "ok"
+        assert state["indexes"]["shard_a"]["samples"] == 200
+        assert state["indexes"]["shard_a"]["value_type"] == "Float"
+        status, body = _http_get(agg._metrics_http.port, "/healthz")
+        state = json.loads(body)
+        assert status == 200 and state["status"] == "ok"
+        assert state["connected"] == 2 and state["configured"] == 2
+
+        client.close()
+    finally:
+        shard_log.removeHandler(capture)
+        tg.stop()
+        ta.stop()
+        tb.stop()
+
+
+def test_metrics_port_ini_and_disabled_by_default():
+    """[Service] MetricsPort/SlowQueryThresholdMs parse on both tiers;
+    MetricsPort=0 (the default) never binds a listener."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".ini",
+                                     delete=False) as f:
+        f.write("[Service]\nMetricsPort=9091\nMetricsHost=10.0.0.5\n"
+                "SlowQueryThresholdMs=250\n")
+        path = f.name
+    s = ServiceContext.from_ini(path).settings
+    assert s.metrics_port == 9091
+    assert s.metrics_host == "10.0.0.5"
+    assert s.slow_query_threshold_ms == 250.0
+    agg = AggregatorContext.from_ini(path)
+    assert agg.metrics_port == 9091
+    assert agg.metrics_host == "10.0.0.5"
+    assert agg.slow_query_threshold_ms == 250.0
+    assert agg.trace_requests          # default: mint ids at the edge
+    os.unlink(path)
+    with tempfile.NamedTemporaryFile("w", suffix=".ini",
+                                     delete=False) as f:
+        f.write("[Service]\nTraceRequests=0\n")
+        path = f.name
+    agg_off = AggregatorContext.from_ini(path)
+    assert not agg_off.trace_requests
+    # opted out: an id-less body is forwarded byte-identical (never
+    # repacked to the extended layout); existing ids still ride
+    svc = AggregatorService(agg_off)
+    raw = wire.RemoteQuery("1|2|3").pack()
+    assert svc._ensure_request_id(raw) == (raw, "")
+    tagged = wire.RemoteQuery("1|2|3", request_id="keepme").pack()
+    assert svc._ensure_request_id(tagged) == (tagged, "keepme")
+    os.unlink(path)
+    # the bind host DEFAULTS to loopback: the endpoint is unauthenticated
+    assert ServiceSettings().metrics_host == "127.0.0.1"
+
+    ctx, data = _make_context()
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        assert server._metrics_http is None      # default: disabled
+        # trace_requests=False restores reference-exact request bytes:
+        # no id reaches the server, so none is echoed
+        cli = AnnClient(host, port, timeout_s=10.0, trace_requests=False)
+        cli.connect()
+        res = cli.search("|".join(str(x) for x in data[3]))
+        assert res.status == wire.ResultStatus.Success
+        assert res.request_id == ""
+        cli.close()
+    finally:
+        t.stop()

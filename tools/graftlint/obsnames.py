@@ -1,0 +1,147 @@
+"""GL6xx — observability-name lint (metric-cardinality bound).
+
+The telemetry registry (utils/metrics.py) keys series directly off their
+names and never expires one: a span/counter/histogram name interpolated
+from runtime values (an f-string, concatenation, %-format, .format, a
+per-call variable) mints a fresh series per distinct value — unbounded
+registry growth in a long-lived server, and every Prometheus scrape
+re-serializes all of it.  Names must therefore be STRING LITERALS at the
+call site; module-level `NAME = "..."` constants are accepted too (their
+value set is bounded by definition).
+
+Rules:
+
+* GL601 — the name argument of `trace.span(...)` / `trace.record(...)`
+  is not a string literal or module-level string constant.
+* GL602 — the name argument of a metrics-registry call
+  (`metrics.counter/gauge/histogram/inc/set_gauge/observe/
+  counter_value/histogram_or_none`) is not a string literal or
+  module-level string constant.
+
+Calls are resolved through import aliases (`from sptag_tpu.utils import
+trace` / `import sptag_tpu.utils.metrics as metrics` / from-imports of the
+functions themselves), so the modules' own internal plumbing that passes a
+`name` PARAMETER through is out of scope by construction — the lint
+surface is the call sites that choose the name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.graftlint.core import Finding, ModuleInfo, Project, _dotted
+
+RULES = {
+    "GL601": "trace span/record name is not a string literal — dynamic "
+             "names make metric cardinality unbounded",
+    "GL602": "metrics registry name is not a string literal — dynamic "
+             "names make metric cardinality unbounded",
+}
+
+_TRACE_MODULE = "sptag_tpu.utils.trace"
+_METRICS_MODULE = "sptag_tpu.utils.metrics"
+
+_TRACE_FNS = {"span", "record"}
+_METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
+                "observe", "counter_value", "histogram_or_none"}
+
+
+def _module_str_constants(mod: ModuleInfo) -> Set[str]:
+    """Names bound at module level to a string constant (e.g.
+    `TRACE_SPAN = "xla.backend_compile"`) — bounded by definition."""
+    out: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
+    """GL601/GL602 when this call targets the trace/metrics registries
+    (resolved through the module's import aliases), else None."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        full = mod.resolve_head(func.value.id)
+        if full == _TRACE_MODULE and func.attr in _TRACE_FNS:
+            return "GL601"
+        if full == _METRICS_MODULE and func.attr in _METRICS_FNS:
+            return "GL602"
+        return None
+    if isinstance(func, ast.Name):
+        target = mod.from_imports.get(func.id, "")
+        modpath, _, sym = target.rpartition(".")
+        if modpath == _TRACE_MODULE and sym in _TRACE_FNS:
+            return "GL601"
+        if modpath == _METRICS_MODULE and sym in _METRICS_FNS:
+            return "GL602"
+    return None
+
+
+def _name_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _is_bounded(arg: ast.AST, constants: Set[str]) -> bool:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return True
+    return isinstance(arg, ast.Name) and arg.id in constants
+
+
+def _describe(arg: ast.AST) -> str:
+    if isinstance(arg, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(arg, ast.BinOp):
+        return "a concatenation/format expression"
+    if isinstance(arg, ast.Call):
+        return "a call result"
+    if isinstance(arg, ast.Name):
+        return f"the variable `{arg.id}`"
+    return "a dynamic expression"
+
+
+def _check_module(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    constants = _module_str_constants(mod)
+
+    def enclosing(lineno: int) -> str:
+        best, best_line = "", -1
+        for fn in mod.functions:
+            end = getattr(fn.node, "end_lineno", fn.node.lineno)
+            if fn.node.lineno <= lineno <= end and \
+                    fn.node.lineno > best_line:
+                best, best_line = fn.qualname, fn.node.lineno
+        return best
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        rule = _rule_for_call(node, mod)
+        if rule is None:
+            continue
+        arg = _name_arg(node)
+        if arg is None or _is_bounded(arg, constants):
+            continue
+        fn_name = _dotted(node.func) or "<call>"
+        out.append(Finding(
+            rule, mod.relpath, node.lineno,
+            f"`{fn_name}` name is {_describe(arg)} — use a string "
+            "literal (or a module-level str constant) so metric "
+            "cardinality stays bounded", enclosing(node.lineno)))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        out.extend(_check_module(mod))
+    return out

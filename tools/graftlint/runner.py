@@ -14,12 +14,13 @@ import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tools.graftlint import (concurrency, dtype_parity, errorpath,
-                             hostsync, retrace)
+                             hostsync, obsnames, retrace)
 from tools.graftlint.baseline import (BaselineError, Suppression,
                                       apply_baseline, load_baseline)
 from tools.graftlint.core import Finding, Project
 
-CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity)
+CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity,
+            obsnames)
 
 #: rule id -> one-line description, collected from every checker module
 ALL_RULES: Dict[str, str] = {}
@@ -68,7 +69,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="graftlint",
         description="TPU/JAX static-analysis suite for sptag_tpu "
                     "(host-sync, retrace, concurrency, error-path, "
-                    "dtype-parity)")
+                    "dtype-parity, observability-names)")
     parser.add_argument("paths", nargs="*", default=["sptag_tpu"],
                         help="package roots to lint (default: sptag_tpu)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
