@@ -583,7 +583,10 @@ class BKTIndex(VectorIndex):
         if self._rebuild_pool is None:
             from sptag_tpu.utils.threadpool import ThreadPool
 
-            self._rebuild_pool = ThreadPool()
+            # named pool: a leaked-worker warning (threadpool.py stop())
+            # must say WHICH pool wedged, and the lock sanitizer's
+            # watchdog dumps read better with the owner spelled out
+            self._rebuild_pool = ThreadPool(name="bkt-rebuild")
             self._rebuild_pool.init(1)    # one worker = reference cadence
         self._rebuild_pending = False
         # enqueue BEFORE clearing the event: if add() raises (pool stopped

@@ -40,6 +40,7 @@ from sptag_tpu.core.types import (
 )
 from sptag_tpu.core.vectorset import MetadataSet, VectorSet, metas_for
 from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.utils import locksan
 from sptag_tpu.utils.ini import IniReader
 
 # THE sentinel distance for empty/filtered result slots, shared with every
@@ -105,7 +106,9 @@ class VectorIndex(abc.ABC):
         self.params: ParamSet = self._make_params()
         self.metadata: Optional[MetadataSet] = None
         self._meta_to_vec: Optional[Dict[bytes, int]] = None
-        self._lock = threading.RLock()   # single-writer mutation lock (P7)
+        # single-writer mutation lock (P7); sanitized under SPTAG_LOCKSAN
+        # (utils/locksan.py) — plain RLock otherwise
+        self._lock = locksan.make_rlock("VectorIndex._lock")
         self._meta_file = "metadata.bin"
         self._meta_index_file = "metadataIndex.bin"
 

@@ -33,6 +33,7 @@ from typing import List, Optional
 
 from sptag_tpu.serve import wire
 from sptag_tpu.serve.protocol import request_id_of
+from sptag_tpu.utils import locksan
 
 
 class AnnClient:
@@ -52,7 +53,7 @@ class AnnClient:
         # RLock: search() calls close() from inside its locked region on
         # error paths, and close() itself must hold the lock (the heartbeat
         # pump mutates _sock concurrently)
-        self._lock = threading.RLock()
+        self._lock = locksan.make_rlock("AnnClient._lock")
         self._next_resource = 1
         self._remote_cid = wire.INVALID_CONNECTION_ID
         self._hb_stop: Optional[threading.Event] = None
@@ -231,8 +232,11 @@ class PipelinedAnnClient:
         # see AnnClient: False = reference-exact request bytes
         self.trace_requests = trace_requests
         self._sock: Optional[socket.socket] = None
-        self._wlock = threading.Lock()
-        self._plock = threading.Lock()      # guards _pending + _next_rid
+        self._wlock = locksan.make_lock("PipelinedAnnClient._wlock")
+        # guards _pending + _next_rid; never nests with _wlock — the
+        # canonical order (registration, then locked send, then lock-free
+        # wait) is documented in docs/DESIGN.md §9
+        self._plock = locksan.make_lock("PipelinedAnnClient._plock")
         self._pending: dict = {}            # rid -> [Event, result-slot]
         self._next_rid = 1
         self._remote_cid = wire.INVALID_CONNECTION_ID
@@ -405,7 +409,7 @@ class AnnClientPool:
                                trace_requests=trace_requests)
             for _ in range(connections)]
         self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = locksan.make_lock("AnnClientPool._rr_lock")
         self._closed = False
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers or 4 * connections,

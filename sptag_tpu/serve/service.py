@@ -89,6 +89,15 @@ class ServiceSettings:
     # (queue wait + execute + send) reaches this many ms is logged with
     # its request id, per-stage timings and result count; 0 disables
     slow_query_threshold_ms: float = 0.0
+    # runtime lock sanitizer (utils/locksan.py): when on, locks created
+    # from here on (index writer locks, client locks, thread pools) are
+    # wrapped to detect lock-order inversions at runtime; the watchdog
+    # threshold dumps all held locks + thread stacks into the log when a
+    # lock wait exceeds it (0 = watchdog off).  Env SPTAG_LOCKSAN
+    # equivalently enables it process-wide ("strict" makes inversions
+    # raise instead of log)
+    lock_sanitizer: bool = False
+    locksan_watchdog_ms: float = 0.0
 
 
 class ServiceContext:
@@ -135,7 +144,20 @@ class ServiceContext:
                 "Service", "MetricsHost", "127.0.0.1"),
             slow_query_threshold_ms=float(reader.get_parameter(
                 "Service", "SlowQueryThresholdMs", "0")),
+            lock_sanitizer=reader.get_parameter(
+                "Service", "LockSanitizer", "0").lower() in
+            ("1", "true", "on", "yes", "strict"),
+            locksan_watchdog_ms=float(reader.get_parameter(
+                "Service", "LockSanWatchdogMs", "0")),
         )
+        if s.lock_sanitizer:
+            # before the indexes load: their writer locks must be created
+            # with the sanitizer already on to be wrapped
+            from sptag_tpu.utils import locksan
+            locksan.enable(
+                strict=(reader.get_parameter(
+                    "Service", "LockSanitizer", "0").lower() == "strict"),
+                watchdog_ms=(s.locksan_watchdog_ms or None))
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
         for name in (t.strip() for t in index_list.split(",")):

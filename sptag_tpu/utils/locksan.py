@@ -1,0 +1,392 @@
+"""Runtime lock sanitizer — the dynamic complement of graftlint's GL7xx.
+
+The static lock-order analysis (tools/graftlint/lockgraph.py) proves
+properties about lock ACQUISITION SITES; this module checks the orders a
+live process actually exercises.  Both build the same artifact — a lock
+ORDER GRAPH with an edge A→B whenever lock B is acquired while A is held
+— and tests/test_locksan.py cross-checks one against the other: a runtime
+edge that the static graph can reach in reverse is a deadlock the lint
+missed (or a baseline entry that lied).
+
+Opt-in and zero-cost when off: `make_lock(name)` / `make_rlock(name)`
+return plain `threading.Lock()` / `RLock()` unless the sanitizer is
+enabled (env ``SPTAG_LOCKSAN=1`` — ``strict`` to make inversions raise —
+or ini ``[Service] LockSanitizer``; see serve/service.py).  When enabled
+they return `SanLock` / `SanRLock`, which
+
+* record a per-thread stack of held lock names;
+* on each nested acquisition, add the edge to the process-wide order
+  graph; if the REVERSE order was ever observed (a path new→…→held
+  already exists), that is a lock-order inversion: both stacks — the
+  first witness of the established order and the acquisition at hand —
+  are logged, the ``locksan.inversions`` counter bumps, and in strict
+  mode the acquisition is refused with `LockOrderError` (the lock is NOT
+  left held);
+* optionally run a WATCHDOG: when a blocking acquire waits longer than
+  the threshold (``SPTAG_LOCKSAN_WATCHDOG_MS`` / ini
+  ``LockSanWatchdogMs``), every thread's held locks and current stack are
+  dumped to the log (the same request-id-stamped stream the slow-query
+  log uses) and ``locksan.watchdog_stalls`` bumps — the post-mortem for a
+  stall that static analysis could not see coming.
+
+Adopted by serve/client.py, core/index.py (and through it algo/bkt.py),
+and utils/threadpool.py; tests/conftest.py enables the sanitizer for the
+whole tier-1 suite, so every serve/index test doubles as an inversion
+probe.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set
+
+from sptag_tpu.utils import metrics
+
+log = logging.getLogger(__name__)
+
+
+class LockOrderError(RuntimeError):
+    """Raised (strict mode only) when an acquisition inverts the observed
+    lock order.  The offending lock is released before raising."""
+
+
+# --------------------------------------------------------------------------
+# configuration
+# --------------------------------------------------------------------------
+
+_cfg_lock = threading.Lock()
+_enabled_override: Optional[bool] = None
+_strict_override: Optional[bool] = None
+_watchdog_ms_override: Optional[float] = None
+
+
+def _env_mode() -> str:
+    return os.environ.get("SPTAG_LOCKSAN", "").strip().lower()
+
+
+def enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return _env_mode() in ("1", "true", "on", "log", "strict", "raise")
+
+
+def strict() -> bool:
+    if _strict_override is not None:
+        return _strict_override
+    return _env_mode() in ("strict", "raise")
+
+
+def watchdog_ms() -> float:
+    if _watchdog_ms_override is not None:
+        return _watchdog_ms_override
+    try:
+        return float(os.environ.get("SPTAG_LOCKSAN_WATCHDOG_MS", "0"))
+    except ValueError:
+        return 0.0
+
+
+def enable(strict: Optional[bool] = None,
+           watchdog_ms: Optional[float] = None) -> None:
+    """Turn the sanitizer on for locks created FROM NOW ON (make_lock
+    decides at creation time).  `strict`/`watchdog_ms` override the env;
+    None keeps the env-derived value."""
+    global _enabled_override, _strict_override, _watchdog_ms_override
+    with _cfg_lock:
+        _enabled_override = True
+        if strict is not None:
+            _strict_override = strict
+        if watchdog_ms is not None:
+            _watchdog_ms_override = watchdog_ms
+
+
+def disable() -> None:
+    global _enabled_override, _strict_override, _watchdog_ms_override
+    with _cfg_lock:
+        _enabled_override = False
+        _strict_override = None
+        _watchdog_ms_override = None
+
+
+def reset_config() -> None:
+    """Drop every enable()/disable() override — the environment decides
+    again (test hygiene)."""
+    global _enabled_override, _strict_override, _watchdog_ms_override
+    with _cfg_lock:
+        _enabled_override = None
+        _strict_override = None
+        _watchdog_ms_override = None
+
+
+# --------------------------------------------------------------------------
+# held-lock bookkeeping + order graph
+# --------------------------------------------------------------------------
+
+_tls = threading.local()
+
+_graph_lock = threading.Lock()
+#: observed canonical order: name -> set of names acquired while it was held
+_order: Dict[str, Set[str]] = {}
+#: (held, acquired) -> formatted stack of the FIRST observation of the edge
+_edge_witness: Dict[tuple, str] = {}
+_inversions: List[dict] = []
+_seen_inversions: Set[tuple] = set()
+#: thread id -> that thread's live held-stack (same list object as its TLS)
+_thread_stacks: Dict[int, List[str]] = {}
+
+
+def _stack() -> List[str]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+        with _graph_lock:
+            _thread_stacks[threading.get_ident()] = s
+    return s
+
+
+def _has_path(src: str, dst: str) -> bool:
+    """DFS over `_order` (caller holds `_graph_lock`)."""
+    seen: Set[str] = set()
+    todo = [src]
+    while todo:
+        n = todo.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        todo.extend(_order.get(n, ()))
+    return False
+
+
+#: hard cap on retained inversion records — detection (metric, strict
+#: raise) is NEVER deduplicated, but a pathological retry loop must not
+#: grow the record list without bound
+_MAX_INVERSION_RECORDS = 1000
+
+
+def _record_edges(held: List[str], name: str) -> Optional[dict]:
+    """Record held→name edges; returns the first inversion found (if
+    any).  EVERY occurrence of an inversion is detected, counted and
+    recorded (strict mode must refuse repeats too, and the per-test
+    probe must see an inversion no matter which test provoked the pair
+    first) — only the stack-dump LOG is deduplicated per pair to avoid
+    spam.  Stack formatting happens OUTSIDE `_graph_lock` so first-time
+    edge bookkeeping does not convoy unrelated acquisitions."""
+    new_edges: List[tuple] = []
+    found: List[tuple] = []           # (held_lock, first_time, witness)
+    with _graph_lock:
+        for h in held:
+            if h == name:
+                continue
+            edges = _order.setdefault(h, set())
+            if name in edges:
+                continue
+            if _has_path(name, h):
+                key = (name, h)
+                first = key not in _seen_inversions
+                _seen_inversions.add(key)
+                found.append((h, first,
+                              _edge_witness.get((name, h), "")))
+            else:
+                edges.add(name)
+                new_edges.append((h, name))
+    if not new_edges and not found:
+        return None
+    here = "".join(traceback.format_stack()[:-3])
+    inversion: Optional[dict] = None
+    with _graph_lock:
+        for e in new_edges:
+            _edge_witness.setdefault(e, here)
+        for h, first, established in found:
+            rec = {
+                "held": h,
+                "acquiring": name,
+                "established_order": f"{name} -> {h}",
+                "established_at": established,
+                "stack": here,
+                "first": first,
+            }
+            if len(_inversions) < _MAX_INVERSION_RECORDS:
+                _inversions.append(rec)
+            if inversion is None:
+                inversion = rec
+    for h, first, established in found:
+        metrics.inc("locksan.inversions")
+        if first:
+            log.error(
+                "lock-order inversion: acquiring %r while holding %r, "
+                "but the order %s -> %s was already observed.\n"
+                "--- established at ---\n%s--- inverted here ---\n%s",
+                name, h, name, h,
+                established or "(witness stack unavailable)\n", here)
+    return inversion
+
+
+def _watchdog_dump(name: str, waited_s: float) -> None:
+    metrics.inc("locksan.watchdog_stalls")
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    with _graph_lock:
+        stacks = {tid: list(s) for tid, s in _thread_stacks.items() if s}
+    lines = [f"locksan watchdog: waited {waited_s * 1000.0:.0f} ms for "
+             f"{name!r}; held locks by thread:"]
+    for tid, held in stacks.items():
+        lines.append(f"  thread {names.get(tid, '?')} ({tid}) holds {held}")
+        frame = frames.get(tid)
+        if frame is not None:
+            lines.append("".join(traceback.format_stack(frame)))
+    if not stacks:
+        lines.append("  (no sanitized locks held — the owner is a plain "
+                     "lock or another process)")
+    log.warning("%s", "\n".join(lines))
+
+
+# --------------------------------------------------------------------------
+# the wrappers
+# --------------------------------------------------------------------------
+
+class SanLock:
+    """`threading.Lock` wrapper feeding the order graph + watchdog."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    # ---- protocol ----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not blocking:
+            ok = self._inner.acquire(False)
+        elif timeout is not None and timeout >= 0:
+            ok = self._inner.acquire(True, timeout)
+        else:
+            wd = watchdog_ms() / 1000.0
+            if wd > 0:
+                ok = self._inner.acquire(True, wd)
+                if not ok:
+                    t0 = time.monotonic()
+                    _watchdog_dump(self.name, wd)
+                    self._inner.acquire()
+                    metrics.observe("locksan.stall_wait",
+                                    wd + time.monotonic() - t0)
+                    ok = True
+            else:
+                self._inner.acquire()
+                ok = True
+        if ok:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == self.name:
+                    del stack[i]
+                    break
+
+    def locked(self) -> bool:
+        # RLock grew .locked() only in 3.12; fall back to _is_owned-style
+        # probing for older interpreters
+        probe = getattr(self._inner, "locked", None)
+        if probe is not None:
+            return probe()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __enter__(self) -> "SanLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name!r})"
+
+    # ---- bookkeeping -------------------------------------------------
+
+    def _note_acquired(self) -> None:
+        stack = _stack()
+        if self.name in stack:
+            # reentrant re-acquisition (SanRLock): already ordered
+            stack.append(self.name)
+            return
+        inversion = None
+        if stack:
+            held = list(dict.fromkeys(stack))
+            inversion = _record_edges(held, self.name)
+        stack.append(self.name)
+        if inversion is not None and strict():
+            stack.pop()
+            self._inner.release()
+            raise LockOrderError(
+                f"acquiring {inversion['acquiring']!r} while holding "
+                f"{inversion['held']!r} inverts the established order "
+                f"{inversion['established_order']}")
+
+
+class SanRLock(SanLock):
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+
+def make_lock(name: str):
+    """A mutex named `name`: `SanLock` when the sanitizer is enabled,
+    plain `threading.Lock` (zero overhead) otherwise."""
+    return SanLock(name) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str):
+    return SanRLock(name) if enabled() else threading.RLock()
+
+
+# --------------------------------------------------------------------------
+# introspection (tests, cross-check against the static graph)
+# --------------------------------------------------------------------------
+
+def order_graph() -> Dict[str, Set[str]]:
+    with _graph_lock:
+        return {k: set(v) for k, v in _order.items()}
+
+
+def inversions() -> List[dict]:
+    with _graph_lock:
+        return list(_inversions)
+
+
+def inversion_count() -> int:
+    with _graph_lock:
+        return len(_inversions)
+
+
+def held_locks() -> Dict[int, List[str]]:
+    with _graph_lock:
+        return {tid: list(s) for tid, s in _thread_stacks.items() if s}
+
+
+def reset_observations() -> None:
+    """Clear the order graph + inversion records (test isolation).  Live
+    held-stacks are left alone — locks currently held stay accounted."""
+    with _graph_lock:
+        _order.clear()
+        _edge_witness.clear()
+        _inversions.clear()
+        _seen_inversions.clear()

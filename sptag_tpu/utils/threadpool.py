@@ -8,34 +8,66 @@ most uses (io/reader.py uses it for block parsing), but services that need
 the reference's fire-and-forget + drain semantics (the async RebuildJob
 pattern, BKTIndex.cpp:39-49) get them here without dragging in executor
 futures.
+
+Concurrency contract: `_stopped` and the queue are mutated together under
+`_lock` — the old flag-check-then-put in `add()` raced `stop()`, so a job
+enqueued between the check and the sentinel `None`s landed AFTER the
+sentinels and never ran (accepted-but-dropped, the worst failure mode for
+fire-and-forget).  `stop()` is idempotent, joins its workers OUTSIDE the
+lock (a running job may need to call back into the pool's owner), and
+reports workers that outlive the join timeout via the
+``threadpool.leaked_workers`` counter; `init()` on a stopped pool fails
+loudly instead of spawning workers that would immediately eat a stale
+sentinel.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Callable, Optional
 
+from sptag_tpu.utils import locksan, metrics
+
+log = logging.getLogger(__name__)
+
 
 class ThreadPool:
-    def __init__(self):
+    def __init__(self, name: str = "pool"):
+        # `name` tags log lines (which pool leaked a worker?); metric
+        # names stay literal (GL6xx) so leak counts aggregate process-wide
+        self.name = name
         self._queue: "queue.Queue[Optional[Callable[[], None]]]" = \
             queue.Queue()
         self._workers: list = []
         self._stopped = False
+        # guards _stopped + the enqueue/sentinel ordering (see module doc)
+        self._lock = locksan.make_lock("ThreadPool._lock")
 
     def init(self, threads: int = 1) -> None:
-        """Spawn `threads` daemon workers (ThreadPool.h:25-43)."""
-        for _ in range(max(1, threads)):
-            t = threading.Thread(target=self._run, daemon=True)
-            t.start()
-            self._workers.append(t)
+        """Spawn `threads` daemon workers (ThreadPool.h:25-43).  Raises
+        RuntimeError on a stopped pool — its queue ends in sentinels, so
+        fresh workers would exit immediately while callers assume a live
+        pool."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError(
+                    f"ThreadPool {self.name!r} is stopped; create a new "
+                    "pool instead of re-initializing it")
+            for _ in range(max(1, threads)):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+                self._workers.append(t)
 
     def add(self, job: Callable[[], None]) -> None:
-        """Enqueue a job; runs on some worker (ThreadPool.h:53-60)."""
-        if self._stopped:
-            raise RuntimeError("ThreadPool is stopped")
-        self._queue.put(job)
+        """Enqueue a job; runs on some worker (ThreadPool.h:53-60).
+        Flag check and enqueue are one atomic step: every job `add()`
+        ACCEPTS is guaranteed to run before the stop sentinels."""
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError(f"ThreadPool {self.name!r} is stopped")
+            self._queue.put_nowait(job)
 
     def current_jobs(self) -> int:
         """Approximate queued-but-unstarted job count (ThreadPool.h:96)."""
@@ -45,14 +77,30 @@ class ThreadPool:
         """Block until every queued job has finished."""
         self._queue.join()
 
-    def stop(self) -> None:
-        """Drain and terminate the workers."""
-        self._stopped = True
-        for _ in self._workers:
-            self._queue.put(None)
-        for t in self._workers:
-            t.join(timeout=10)
-        self._workers.clear()
+    def stop(self, join_timeout_s: float = 10.0) -> None:
+        """Drain and terminate the workers (idempotent).  Workers that
+        outlive `join_timeout_s` — a wedged job — are abandoned (they are
+        daemons) but never silently: a warning names the pool and the
+        ``threadpool.leaked_workers`` counter makes the leak visible in
+        /metrics."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            workers, self._workers = self._workers, []
+            for _ in workers:
+                self._queue.put_nowait(None)
+        leaked = 0
+        for t in workers:
+            t.join(timeout=join_timeout_s)
+            if t.is_alive():
+                leaked += 1
+        if leaked:
+            metrics.inc("threadpool.leaked_workers", leaked)
+            log.warning(
+                "ThreadPool %r: %d worker(s) still running %.1fs after "
+                "stop() — job wedged; daemon thread(s) abandoned",
+                self.name, leaked, join_timeout_s)
 
     def _run(self) -> None:
         while True:
@@ -63,8 +111,7 @@ class ThreadPool:
             try:
                 job()
             except Exception:                          # noqa: BLE001
-                import logging
-                logging.getLogger(__name__).exception("ThreadPool job failed")
+                log.exception("ThreadPool %r job failed", self.name)
             finally:
                 self._queue.task_done()
                 # drop the reference before blocking in get(): a retained

@@ -509,8 +509,411 @@ def test_every_rule_has_an_id_and_description():
         "GL401", "GL402",
         "GL501",
         "GL601", "GL602",
+        "GL701", "GL702", "GL703", "GL704",
     }
     assert all(ALL_RULES[r] for r in ALL_RULES)
+
+
+# ---------------------------------------------------------------------------
+# GL7xx lock-order / blocking-under-lock / async hazards / handle leaks
+# ---------------------------------------------------------------------------
+
+_TWO_LOCK_INVERSION = (
+    "import threading\n"
+    "A = threading.Lock()\n"
+    "B = threading.Lock()\n"
+    "def forward():\n"
+    "    with A:\n"
+    "        with B:\n"
+    "            pass\n"
+    "def backward():\n"
+    "    with B:\n"
+    "        with A:\n"
+    "            pass\n"
+)
+
+
+def test_gl701_two_lock_inversion_flagged():
+    found = lint_one(_TWO_LOCK_INVERSION, select=["GL701"])
+    assert rules_of(found) == ["GL701"]
+    msg = found[0].message
+    assert ".A" in msg and ".B" in msg and "cycle" in msg
+
+
+def test_gl701_consistent_order_clean():
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def one():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def two():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+    )
+    assert lint_one(src, select=["GL701"]) == []
+
+
+def test_gl701_cycle_through_the_call_graph():
+    """f holds A and calls g (which takes B); h holds B and calls k
+    (which takes A) — the inversion only exists interprocedurally."""
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def g():\n"
+        "    with B:\n"
+        "        pass\n"
+        "def f():\n"
+        "    with A:\n"
+        "        g()\n"
+        "def k():\n"
+        "    with A:\n"
+        "        pass\n"
+        "def h():\n"
+        "    with B:\n"
+        "        k()\n"
+    )
+    found = lint_one(src, select=["GL701"])
+    assert rules_of(found) == ["GL701"]
+    assert "via call" in found[0].message
+
+
+def test_gl701_attribute_locks_resolved_through_base_class():
+    """self._lock created in a base class and acquired in the subclass is
+    ONE lock; a subclass-vs-base order flip must still form a cycle."""
+    src = (
+        "import threading\n"
+        "OTHER = threading.Lock()\n"
+        "class Base:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def locked_then_other(self):\n"
+        "        with self._lock:\n"
+        "            with OTHER:\n"
+        "                pass\n"
+        "class Sub(Base):\n"
+        "    def other_then_locked(self):\n"
+        "        with OTHER:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+    found = lint_one(src, select=["GL701"])
+    assert rules_of(found) == ["GL701"]
+    assert "Base._lock" in found[0].message
+
+
+def test_gl701_multi_item_with_orders_its_items():
+    """`with A, B:` enters sequentially — B under A.  A reversed nested
+    pair elsewhere must close the cycle."""
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def one():\n"
+        "    with A, B:\n"
+        "        pass\n"
+        "def two():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n"
+    )
+    assert rules_of(lint_one(src, select=["GL701"])) == ["GL701"]
+
+
+def test_gl701_self_deadlock_through_callee():
+    """Caller holds a non-reentrant Lock; a synchronous callee
+    re-acquires it — guaranteed deadlock, only visible across the call."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _inner(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._inner()\n"
+    )
+    found = lint_one(src, select=["GL701"])
+    assert [f.symbol for f in found] == ["C.outer"]
+    assert "through call" in found[0].message
+
+
+def test_gl702_positional_queue_timeout_clean():
+    src = (
+        "import queue\n"
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._queue = queue.Queue()\n"
+        "    def bounded(self):\n"
+        "        with self._lock:\n"
+        "            return self._queue.get(True, 5.0)\n"
+    )
+    assert lint_one(src, select=["GL702"]) == []
+
+
+def test_gl701_nonreentrant_self_acquisition_flagged():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+    found = lint_one(src, select=["GL701"])
+    assert rules_of(found) == ["GL701"]
+    assert "self-deadlock" in found[0].message
+
+
+def test_gl701_rlock_self_acquisition_clean():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+    assert lint_one(src, select=["GL701"]) == []
+
+
+def test_gl702_sleep_under_lock_flagged():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "L = threading.Lock()\n"
+        "def f():\n"
+        "    with L:\n"
+        "        time.sleep(1.0)\n"
+    )
+    found = lint_one(src, select=["GL702"])
+    assert rules_of(found) == ["GL702"]
+    assert "time.sleep" in found[0].message
+
+
+def test_gl702_sleep_outside_lock_clean():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "L = threading.Lock()\n"
+        "def f():\n"
+        "    with L:\n"
+        "        x = 1\n"
+        "    time.sleep(1.0)\n"
+    )
+    assert lint_one(src, select=["GL702"]) == []
+
+
+def test_gl702_queue_get_without_timeout_under_lock():
+    src = (
+        "import queue\n"
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._queue = queue.Queue()\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            return self._queue.get()\n"
+        "    def good(self):\n"
+        "        with self._lock:\n"
+        "            return self._queue.get(timeout=1.0)\n"
+        "    def also_good(self):\n"
+        "        with self._lock:\n"
+        "            return self._queue.put_nowait(1)\n"
+    )
+    found = lint_one(src, select=["GL702"])
+    assert [f.symbol for f in found] == ["C.bad"]
+
+
+def test_gl702_reaches_blocking_call_through_helper():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self, sock):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._sock = sock\n"
+        "    def _send(self, data):\n"
+        "        self._sock.sendall(data)\n"
+        "    def locked_send(self, data):\n"
+        "        with self._lock:\n"
+        "            self._send(data)\n"
+    )
+    found = lint_one(src, select=["GL702"])
+    assert [f.symbol for f in found] == ["C.locked_send"]
+    assert "sendall" in found[0].message
+
+
+def test_gl702_spawn_target_does_not_count_as_locked_call():
+    """A callable PASSED to Thread/add runs later on another thread —
+    its blocking ops must not be attributed to the spawner's lock."""
+    src = (
+        "import threading\n"
+        "import time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _worker(self):\n"
+        "        time.sleep(5)\n"
+        "    def start(self):\n"
+        "        with self._lock:\n"
+        "            t = threading.Thread(target=self._worker)\n"
+        "            t.start()\n"
+        "            t.join()\n"
+    )
+    assert lint_one(src, select=["GL702"]) == []
+
+
+def test_gl703_threading_lock_in_async_def_flagged():
+    src = (
+        "import threading\n"
+        "L = threading.Lock()\n"
+        "async def handler():\n"
+        "    with L:\n"
+        "        return 1\n"
+    )
+    found = lint_one(src, select=["GL703"])
+    assert rules_of(found) == ["GL703"]
+    assert "event loop" in found[0].message
+
+
+def test_gl703_time_sleep_in_async_def_flagged_asyncio_sleep_clean():
+    src = (
+        "import asyncio\n"
+        "import time\n"
+        "async def bad():\n"
+        "    time.sleep(0.1)\n"
+        "async def good():\n"
+        "    await asyncio.sleep(0.1)\n"
+    )
+    found = lint_one(src, select=["GL703"])
+    assert [f.symbol for f in found] == ["bad"]
+
+
+def test_gl703_nonwrite_await_under_asyncio_lock():
+    src = (
+        "import asyncio\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._wlock = asyncio.Lock()\n"
+        "    async def bad(self, fut, writer):\n"
+        "        async with self._wlock:\n"
+        "            await fut\n"
+        "    async def good(self, writer, payload):\n"
+        "        async with self._wlock:\n"
+        "            writer.write(payload)\n"
+        "            await writer.drain()\n"
+        "    async def also_good(self, writer):\n"
+        "        async with self._wlock:\n"
+        "            await asyncio.wait_for(writer.drain(), timeout=5)\n"
+    )
+    found = lint_one(src, select=["GL703"])
+    assert [f.symbol for f in found] == ["C.bad"]
+
+
+def test_gl703_sync_code_never_flagged():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "L = threading.Lock()\n"
+        "def plain():\n"
+        "    with L:\n"
+        "        pass\n"
+        "    time.sleep(0.1)\n"
+    )
+    assert lint_one(src, select=["GL703"]) == []
+
+
+def test_gl704_unjoined_thread_attribute_flagged():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    )
+    found = lint_one(src, select=["GL704"])
+    assert rules_of(found) == ["GL704"]
+    assert "_t" in found[0].message
+
+
+def test_gl704_joined_thread_attribute_clean():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "    def stop(self):\n"
+        "        self._t.join(timeout=5)\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    )
+    assert lint_one(src, select=["GL704"]) == []
+
+
+def test_gl704_bare_create_task_flagged_stored_and_cancelled_clean():
+    src = (
+        "import asyncio\n"
+        "class S:\n"
+        "    async def fire_and_forget(self):\n"
+        "        asyncio.create_task(self._pump())\n"
+        "    async def start(self):\n"
+        "        self._task = asyncio.create_task(self._pump())\n"
+        "    async def stop(self):\n"
+        "        self._task.cancel()\n"
+        "    async def _pump(self):\n"
+        "        pass\n"
+    )
+    found = lint_one(src, select=["GL704"])
+    assert [f.symbol for f in found] == ["S.fire_and_forget"]
+
+
+def test_gl704_worker_collection_join_loop_clean():
+    src = (
+        "import threading\n"
+        "class Pool:\n"
+        "    def __init__(self):\n"
+        "        self._workers = []\n"
+        "    def init(self, n):\n"
+        "        for _ in range(n):\n"
+        "            t = threading.Thread(target=self._run)\n"
+        "            t.start()\n"
+        "            self._workers.append(t)\n"
+        "    def stop(self):\n"
+        "        workers, self._workers = self._workers, []\n"
+        "        for t in workers:\n"
+        "            t.join(timeout=10)\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    )
+    assert lint_one(src, select=["GL704"]) == []
+
+
+def test_gl7_order_graph_exposed_for_runtime_crosscheck():
+    """build_order_graph is the public surface tests/test_locksan.py
+    cross-checks against the runtime-observed graph."""
+    from tools.graftlint.core import Project
+    from tools.graftlint.lockgraph import build_order_graph
+    project = Project({"sptag_tpu/x.py": _TWO_LOCK_INVERSION})
+    _model, edges, witness = build_order_graph(project)
+    a, b = "sptag_tpu.x.A", "sptag_tpu.x.B"
+    assert b in edges[a] and a in edges[b]
+    assert witness[(a, b)][2] == "forward"
 
 
 def test_repo_is_lint_clean_under_baseline():

@@ -1,8 +1,20 @@
-"""Helper::ThreadPool parity (reference inc/Helper/ThreadPool.h:18-111)."""
+"""Helper::ThreadPool parity (reference inc/Helper/ThreadPool.h:18-111)
+plus the ISSUE 3 concurrency-contract tests: the add()/stop() race,
+stopped-pool reuse, and leaked-worker visibility.
+
+The race being pinned: `add()` used to check `_stopped` and then `put()`
+without a lock, so a job enqueued concurrently with `stop()` could land
+AFTER the `None` sentinels and never run — accepted-but-dropped.  The
+contract now is: every job `add()` ACCEPTS (returns without raising) runs
+exactly once; every job add() rejects raises RuntimeError.
+"""
 
 import threading
 import time
 
+import pytest
+
+from sptag_tpu.utils import metrics
 from sptag_tpu.utils.threadpool import ThreadPool
 
 
@@ -43,3 +55,81 @@ def test_threadpool_stop_rejects_new_jobs():
         raise AssertionError("expected RuntimeError after stop")
     except RuntimeError:
         pass
+
+
+def test_add_vs_stop_race_accepted_jobs_run_exactly_once():
+    """Hammer add() from several threads while stop() lands mid-stream:
+    the set of jobs that ran must be EXACTLY the set add() accepted."""
+    for _ in range(20):
+        pool = ThreadPool(name="hammer")
+        pool.init(4)
+        ran = []
+        ran_lock = threading.Lock()
+        accepted = [[] for _ in range(4)]
+        start = threading.Event()
+
+        def feeder(slot, out):
+            start.wait()
+            for i in range(50):
+                token = (slot, i)
+
+                def job(token=token):
+                    with ran_lock:
+                        ran.append(token)
+                try:
+                    pool.add(job)
+                except RuntimeError:
+                    return          # pool stopped — all later adds reject
+                out.append(token)
+
+        feeders = [threading.Thread(target=feeder, args=(s, accepted[s]))
+                   for s in range(4)]
+        for t in feeders:
+            t.start()
+        start.set()
+        time.sleep(0.001)
+        pool.stop()
+        for t in feeders:
+            t.join()
+        # stop() drains: sentinels sit behind every accepted job
+        pool.join()
+        want = {tok for out in accepted for tok in out}
+        with ran_lock:
+            got = list(ran)
+        assert len(got) == len(set(got)), "a job ran more than once"
+        assert set(got) == want, (
+            f"accepted-but-dropped: {sorted(want - set(got))}; "
+            f"ran-but-rejected: {sorted(set(got) - want)}")
+
+
+def test_stopped_pool_rejects_init_and_stop_is_idempotent():
+    pool = ThreadPool(name="stopped")
+    pool.init(1)
+    pool.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        pool.add(lambda: None)
+    with pytest.raises(RuntimeError, match="stopped"):
+        pool.init(1)             # must NOT spawn workers on a dead queue
+    pool.stop()                  # second stop: clean no-op
+    assert pool.current_jobs() == 0
+
+
+def test_leaked_worker_is_counted_and_logged(caplog):
+    pool = ThreadPool(name="wedge")
+    pool.init(1)
+    release = threading.Event()
+    started = threading.Event()
+
+    def wedged():
+        started.set()
+        release.wait(10)
+
+    pool.add(wedged)
+    assert started.wait(5)
+    before = metrics.counter_value("threadpool.leaked_workers")
+    with caplog.at_level("WARNING", logger="sptag_tpu.utils.threadpool"):
+        pool.stop(join_timeout_s=0.05)
+    assert metrics.counter_value("threadpool.leaked_workers") == before + 1
+    assert any("wedge" in r.getMessage() and "still running" in r.getMessage()
+               for r in caplog.records)
+    release.set()                # let the daemon finish; no dangling wait

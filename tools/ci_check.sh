@@ -9,7 +9,9 @@ set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
-echo "== graftlint =="
+# the full suite includes the GL7xx pass (lock-order / blocking-under-
+# lock / async hazards / handle leaks); `--select GL7` scopes a rerun
+echo "== graftlint (GL1xx-GL7xx) =="
 python -m tools.graftlint sptag_tpu/
 
 if [[ "${1:-}" == "--lint-only" ]]; then

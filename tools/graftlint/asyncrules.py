@@ -1,0 +1,151 @@
+"""GL703 — sync/async hazard lint.
+
+The serving tier mixes an asyncio event loop (serve/server.py,
+serve/aggregator.py) with thread-based clients and device work.  Two
+hazard families kill its tail latency:
+
+* BLOCKING THE LOOP: a `threading.Lock` acquired — or blocking I/O /
+  `time.sleep` / a device sync executed — inside an `async def` stalls
+  EVERY connection the loop serves, not just the offending one.  The
+  sanctioned escape is `run_in_executor` (whose nested sync callable is
+  deliberately out of scope here: it runs on an executor thread).
+* SERIALIZING UNDER AN asyncio.Lock: `await`ing anything other than the
+  write/drain the lock exists to serialize (an RPC, a future, a gather)
+  while holding an `asyncio.Lock` extends the critical section across an
+  arbitrary suspension — one slow awaitable convoys every task behind
+  the lock.  `await writer.drain()` (and `wait_for(...drain...)`) is the
+  pattern serve/server.py's per-connection lock exists for; everything
+  else is flagged.
+
+Lock identities resolve through the shared project lock model
+(tools/graftlint/lockgraph.LockModel), so a `threading.Lock` created in
+`__init__` and acquired in an `async def` of the same class is caught
+even without a name hint.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.graftlint import lockgraph
+from tools.graftlint.core import Finding, FunctionInfo, Project, _dotted
+
+RULES = {
+    "GL703": "sync/async hazard: threading lock or blocking call on the "
+             "event loop, or a non-write await under an asyncio.Lock",
+}
+
+#: awaits allowed while an asyncio.Lock is held — the write+flush the
+#: lock serializes.  `wait_for` is unwrapped to its first argument.
+_AWAIT_OK_LEAVES = {"write", "writelines", "drain", "close", "wait_closed",
+                    "sendall"}
+
+
+def _await_leaf(value: ast.AST) -> str:
+    """Leaf name of an awaited expression, unwrapping wait_for."""
+    if isinstance(value, ast.Call):
+        d = _dotted(value.func)
+        leaf = d.split(".")[-1] if d else (
+            value.func.attr if isinstance(value.func, ast.Attribute)
+            else "<call>")
+        if leaf == "wait_for" and value.args:
+            return _await_leaf(value.args[0])
+        return leaf
+    if isinstance(value, ast.Name):
+        return value.id
+    return "<expression>"
+
+
+def _asyncio_lock_item(item: ast.withitem, fn: FunctionInfo,
+                       model: lockgraph.LockModel) -> Optional[str]:
+    """Display name when an `async with` item is (or smells like) an
+    asyncio lock."""
+    lock = model.resolve_lock_expr(fn, item.context_expr)
+    if lock is not None:
+        return lock.canonical if lock.kind in ("asyncio", "unknown") \
+            else None
+    d = _dotted(item.context_expr)
+    if d and "lock" in d.split(".")[-1].lower():
+        # unresolvable expression (tuple-unpacked local, dataclass field)
+        # with a lock-ish name: an `async with` on it is an asyncio lock
+        # by construction
+        return d
+    return None
+
+
+def _scan_async_fn(fn: FunctionInfo,
+                   model: lockgraph.LockModel) -> List[Finding]:
+    out: List[Finding] = []
+    mod = fn.module
+    nested = {f.node for f in mod.functions if f.parent is fn}
+
+    def visit(node: ast.AST, lock_held: Optional[str],
+              in_await: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if child in nested:
+                continue
+            now_lock = lock_held
+            now_await = in_await
+            if isinstance(child, ast.With):
+                for item in child.items:
+                    lock = model.resolve_lock_expr(fn, item.context_expr)
+                    if lock is not None and lock.kind == "threading":
+                        out.append(Finding(
+                            "GL703", mod.relpath, child.lineno,
+                            f"threading lock `{lock.canonical}` held "
+                            "inside `async def` — a contended acquire "
+                            "stalls the whole event loop (use "
+                            "asyncio.Lock or run_in_executor)",
+                            fn.qualname))
+            elif isinstance(child, ast.AsyncWith):
+                for item in child.items:
+                    name = _asyncio_lock_item(item, fn, model)
+                    if name is not None:
+                        now_lock = name
+            elif isinstance(child, ast.Await):
+                now_await = True
+                if lock_held is not None:
+                    leaf = _await_leaf(child.value)
+                    if leaf not in _AWAIT_OK_LEAVES:
+                        out.append(Finding(
+                            "GL703", mod.relpath, child.lineno,
+                            f"`await {leaf}` while holding asyncio lock "
+                            f"`{lock_held}` — the critical section spans "
+                            "an arbitrary suspension and convoys every "
+                            "task behind the lock", fn.qualname))
+            elif isinstance(child, ast.Call):
+                d = _dotted(child.func)
+                if d and d.split(".")[-1] == "acquire":
+                    recv = child.func
+                    if isinstance(recv, ast.Attribute):
+                        lock = model.resolve_lock_expr(fn, recv.value)
+                        if lock is not None and lock.kind == "threading":
+                            out.append(Finding(
+                                "GL703", mod.relpath, child.lineno,
+                                f"`{lock.canonical}.acquire()` inside "
+                                "`async def` blocks the event loop",
+                                fn.qualname))
+                if not in_await:
+                    desc = lockgraph._blocking_desc(child, mod)
+                    if desc is not None:
+                        out.append(Finding(
+                            "GL703", mod.relpath, child.lineno,
+                            f"blocking {desc} inside `async def` stalls "
+                            "the whole event loop (await the async "
+                            "equivalent or use run_in_executor)",
+                            fn.qualname))
+            visit(child, now_lock, now_await)
+
+    visit(fn.node, None, False)
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    model = lockgraph.get_model(project)
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        for fn in mod.functions:
+            if isinstance(fn.node, ast.AsyncFunctionDef):
+                out.extend(_scan_async_fn(fn, model))
+    return out

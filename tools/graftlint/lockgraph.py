@@ -1,0 +1,872 @@
+"""GL70x — interprocedural lock-order & blocking-under-lock analysis.
+
+The serving tier holds ~15 distinct locks across serve/, core/ and
+utils/, and no per-class view (GL3xx) can see a deadlock: a lock-order
+inversion is by definition a property of TWO acquisition sites in
+different functions — often different modules — reached through the call
+graph.  This checker builds a PROJECT-WIDE lock model:
+
+* every lock object is resolved to a canonical id — attribute locks
+  (``self._lock`` in class C of module M → ``M.C._lock``, resolved
+  through the class's base chain so `BKTIndex`'s inherited writer lock
+  and `VectorIndex`'s are ONE lock) and module-level locks
+  (``trace._lock`` → ``sptag_tpu.utils.trace._lock``);
+* a lock-ACQUISITION GRAPH is built by walking every function body and
+  following calls through the project call graph (including
+  ``self.<attr>.<method>()`` through ``self.<attr> = Class()``
+  assignments): an edge A→B means lock B is (possibly transitively)
+  acquired while A is held.  Callables merely PASSED to a spawn API
+  (``Thread(target=f)``, ``pool.add(f)``) deliberately do not count —
+  they run later, on another thread, not under the caller's locks.
+
+Rules:
+
+* GL701 — a cycle in the acquisition graph (potential deadlock), reported
+  once per strongly-connected component with the witness path for each
+  edge; plus the degenerate case of a non-reentrant ``threading.Lock``
+  re-acquired while already held (guaranteed self-deadlock).
+* GL702 — a blocking call while a lock is held: socket
+  sendall/recv/create_connection, ``queue.get/put`` without a timeout,
+  ``Future.result()`` without a timeout, ``time.sleep``, jax's
+  ``block_until_ready`` / ``device_get``, and subprocess calls.  One
+  stalled holder convoys every thread behind the lock — the KBest
+  serving-tail pathology.
+* GL704 — a ``threading.Thread`` / ``asyncio.create_task`` handle that
+  never reaches a ``join()`` / ``cancel()`` on any shutdown path in its
+  module: the thread/task outlives its owner silently.  Handles appended
+  to a collection are accepted when the module joins/cancels loop
+  targets (the worker-list idiom); handles returned to the caller are
+  the caller's responsibility.
+
+The runtime complement is sptag_tpu/utils/locksan.py — it observes the
+orders a live process actually takes; tests/test_locksan.py cross-checks
+its observed graph against this module's static one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Finding,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    _dotted,
+)
+
+RULES = {
+    "GL701": "lock-order cycle in the project acquisition graph "
+             "(potential deadlock)",
+    "GL702": "blocking call (socket/queue/Future/sleep/device-sync/"
+             "subprocess) while a lock is held",
+    "GL704": "thread/task handle never reaches a join/cancel on any "
+             "shutdown path",
+}
+
+#: lock constructors -> reentrant?
+_THREADING_CTORS = {
+    "threading.Lock": False,
+    "threading.RLock": True,
+    "threading.Condition": True,
+    "threading.Semaphore": True,
+    "threading.BoundedSemaphore": True,
+}
+_ASYNCIO_CTORS = {"asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore",
+                  "asyncio.BoundedSemaphore"}
+#: sptag_tpu.utils.locksan factories / classes -> reentrant?
+_LOCKSAN_CTORS = {"make_lock": False, "make_rlock": True,
+                  "SanLock": False, "SanRLock": True}
+
+#: `with self.X:` where X's creation is unseen still counts as a lock
+#: when the name smells like one (mirrors GL3xx)
+_LOCK_NAME_HINTS = ("lock", "mutex", "cond", "sem")
+
+_SOCKET_LEAVES = {"sendall", "sendto", "recv", "recv_into", "recvfrom",
+                  "accept"}
+_SUBPROCESS_LEAVES = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def _resolve_target(func: ast.AST, mod: ModuleInfo) -> Optional[str]:
+    """Fully-resolved dotted target of a call, through import aliases and
+    from-imports: `sleep` (from time import sleep) -> "time.sleep"."""
+    d = _dotted(func)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    full = mod.resolve_head(head)
+    if full is None:
+        full = mod.from_imports.get(head)
+    if full:
+        return full + ("." + rest if rest else "")
+    return d
+
+
+def _lock_ctor(call: ast.Call, mod: ModuleInfo) -> Optional[Tuple[str, bool]]:
+    """(kind, reentrant) when `call` constructs a lock object, else None.
+    kind is "threading" or "asyncio"."""
+    t = _resolve_target(call.func, mod)
+    if t is None:
+        return None
+    if t in _THREADING_CTORS:
+        return "threading", _THREADING_CTORS[t]
+    if t in _ASYNCIO_CTORS:
+        return "asyncio", True
+    leaf = t.split(".")[-1]
+    if leaf in _LOCKSAN_CTORS and "locksan" in t:
+        return "threading", _LOCKSAN_CTORS[leaf]
+    return None
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg in ("timeout", "block") for kw in call.keywords)
+
+
+def _blocking_desc(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
+    """Human-readable description when `call` can block indefinitely (or
+    for an unbounded external duration), else None."""
+    t = _resolve_target(call.func, mod)
+    if t is not None:
+        if t == "time.sleep":
+            return "time.sleep()"
+        if t == "socket.create_connection":
+            return "socket.create_connection()"
+        parts = t.split(".")
+        if parts[0] == "subprocess" and parts[-1] in _SUBPROCESS_LEAVES:
+            return f"{t}()"
+        if t in ("jax.block_until_ready", "jax.device_get"):
+            return f"{t}() (host<->device sync)"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    leaf = call.func.attr
+    if leaf in _SOCKET_LEAVES:
+        return f"socket .{leaf}()"
+    if leaf == "block_until_ready":
+        return ".block_until_ready() (host<->device sync)"
+    recv = (_dotted(call.func.value) or "").lower()
+    if leaf in ("get", "put") and \
+            ("queue" in recv or recv.endswith("_q")):
+        if _has_timeout_kw(call):
+            return None
+        if any(isinstance(a, ast.Constant) and a.value is False
+               for a in call.args):
+            return None                     # q.get(False) is non-blocking
+        # positional timeout forms: get(block, timeout) / put(item,
+        # block, timeout) are bounded waits
+        if len(call.args) >= (2 if leaf == "get" else 3):
+            return None
+        return f"queue .{leaf}() without timeout"
+    if leaf == "result" and not call.args and not _has_timeout_kw(call):
+        return "Future.result() without timeout"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the project-wide lock model
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    canonical: str
+    kind: str               # "threading" | "asyncio" | "unknown"
+    reentrant: bool
+    path: str
+    line: int
+
+
+class LockModel:
+    """Lock inventory + class topology for one parsed Project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.modpath_of: Dict[int, str] = {
+            id(mod): mp for mp, mod in project.by_modpath.items()}
+        self.locks: Dict[str, LockDef] = {}
+        # modpath -> {name: LockDef}
+        self.module_locks: Dict[str, Dict[str, LockDef]] = {}
+        # (modpath, clsname) -> {attr: LockDef} created in that class
+        self.attr_creators: Dict[Tuple[str, str], Dict[str, LockDef]] = {}
+        # (modpath, clsname) -> resolved base classes
+        self.class_bases: Dict[Tuple[str, str],
+                               List[Tuple[str, str]]] = {}
+        # id(FunctionInfo) -> (modpath, clsname)
+        self.class_of_fn: Dict[int, Tuple[str, str]] = {}
+        # (modpath, clsname) -> {attr: (modpath2, clsname2)} from
+        # `self.attr = Class()` assignments
+        self.attr_types: Dict[Tuple[str, str],
+                              Dict[str, Tuple[str, str]]] = {}
+        # (modpath, clsname) -> set of direct-method AST nodes
+        self.method_nodes: Dict[Tuple[str, str], Set[ast.AST]] = {}
+        self._classes_by_module: Dict[str, Dict[str, ast.ClassDef]] = {}
+        self._ancestry_cache: Dict[Tuple[str, str],
+                                   List[Tuple[str, str]]] = {}
+        self._build()
+
+    # ------------------------------------------------------------ building
+
+    def _register(self, d: LockDef) -> LockDef:
+        return self.locks.setdefault(d.canonical, d)
+
+    def _build(self) -> None:
+        proj = self.project
+        for mp, mod in proj.by_modpath.items():
+            self._classes_by_module[mp] = {
+                c.name: c for c in mod.classes()}
+        for mp, mod in proj.by_modpath.items():
+            # module-level locks
+            locks: Dict[str, LockDef] = {}
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    kind = _lock_ctor(node.value, mod)
+                    if kind is None:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            locks[tgt.id] = self._register(LockDef(
+                                f"{mp}.{tgt.id}", kind[0], kind[1],
+                                mod.relpath, node.lineno))
+            self.module_locks[mp] = locks
+            # classes: bases, methods, attr locks, attr types
+            for cls in mod.classes():
+                key = (mp, cls.name)
+                self.class_bases[key] = [
+                    b for b in (self._resolve_base(e, mod, mp)
+                                for e in cls.bases) if b is not None]
+                methods = {n for n in cls.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+                self.method_nodes[key] = methods
+                creators: Dict[str, LockDef] = {}
+                types: Dict[str, Tuple[str, str]] = {}
+                for m in methods:
+                    for node in ast.walk(m):
+                        if not (isinstance(node, ast.Assign)
+                                and isinstance(node.value, ast.Call)):
+                            continue
+                        for tgt in node.targets:
+                            if not (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                continue
+                            kind = _lock_ctor(node.value, mod)
+                            if kind is not None:
+                                creators.setdefault(tgt.attr, self._register(
+                                    LockDef(f"{mp}.{cls.name}.{tgt.attr}",
+                                            kind[0], kind[1], mod.relpath,
+                                            node.lineno)))
+                            else:
+                                ref = self._resolve_class_ref(
+                                    node.value.func, mod, mp)
+                                if ref is not None:
+                                    types.setdefault(tgt.attr, ref)
+                self.attr_creators[key] = creators
+                self.attr_types[key] = types
+            # map every FunctionInfo (incl. nested defs) to its class
+            for fn in mod.functions:
+                top = fn
+                while top.parent is not None:
+                    top = top.parent
+                for cls in mod.classes():
+                    if top.node in self.method_nodes[(mp, cls.name)]:
+                        self.class_of_fn[id(fn)] = (mp, cls.name)
+                        break
+
+    def _resolve_base(self, expr: ast.AST, mod: ModuleInfo,
+                      mp: str) -> Optional[Tuple[str, str]]:
+        d = _dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            t = mod.from_imports.get(d)
+            if t:
+                bmp, _, cn = t.rpartition(".")
+                if bmp in self.project.by_modpath:
+                    return bmp, cn
+            if d in self._classes_by_module.get(mp, {}):
+                return mp, d
+            return None
+        full = mod.resolve_head(parts[0])
+        if full and full in self.project.by_modpath and len(parts) == 2:
+            return full, parts[1]
+        return None
+
+    def _resolve_class_ref(self, func: ast.AST, mod: ModuleInfo,
+                           mp: str) -> Optional[Tuple[str, str]]:
+        """`ThreadPool(...)` / `mod.Class(...)` -> (modpath, classname)
+        when it names a project class."""
+        t = _resolve_target(func, mod)
+        if t is None:
+            return None
+        if "." not in t:
+            if t in self._classes_by_module.get(mp, {}):
+                return mp, t
+            return None
+        tmp, _, cn = t.rpartition(".")
+        if tmp in self.project.by_modpath and \
+                cn in self._classes_by_module.get(tmp, {}):
+            return tmp, cn
+        return None
+
+    # ---------------------------------------------------------- resolution
+
+    def ancestry(self, key: Tuple[str, str]) -> List[Tuple[str, str]]:
+        """[cls, bases..., grandbases...] — pre-order, cycle-safe."""
+        cached = self._ancestry_cache.get(key)
+        if cached is not None:
+            return cached
+        out: List[Tuple[str, str]] = []
+        seen: Set[Tuple[str, str]] = set()
+        todo = [key]
+        while todo:
+            k = todo.pop(0)
+            if k in seen:
+                continue
+            seen.add(k)
+            out.append(k)
+            todo.extend(self.class_bases.get(k, ()))
+        self._ancestry_cache[key] = out
+        return out
+
+    def attr_lock(self, key: Tuple[str, str],
+                  attr: str) -> Optional[LockDef]:
+        """Resolve `self.<attr>` in class `key` to its lock, preferring
+        the MOST ANCESTRAL creating class so inherited locks canonicalize
+        to one id."""
+        found: Optional[LockDef] = None
+        for k in self.ancestry(key):
+            d = self.attr_creators.get(k, {}).get(attr)
+            if d is not None:
+                found = d
+        return found
+
+    def resolve_lock_expr(self, fn: FunctionInfo,
+                          expr: ast.AST) -> Optional[LockDef]:
+        """Resolve a `with`-statement context expression to a lock."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        mod = fn.module
+        mp = self.modpath_of.get(id(mod))
+        if mp is None:
+            return None
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            key = self.class_of_fn.get(id(fn))
+            if key is not None:
+                found = self.attr_lock(key, parts[1])
+                if found is not None:
+                    return found
+                if any(h in parts[1].lower() for h in _LOCK_NAME_HINTS):
+                    # unseen creation (built dynamically, or passed in):
+                    # still track the order, anchored on the using class
+                    return self._register(LockDef(
+                        f"{mp}.{key[1]}.{parts[1]}", "unknown", True,
+                        mod.relpath, getattr(expr, "lineno", 1)))
+            return None
+        if len(parts) == 1:
+            return self.module_locks.get(mp, {}).get(parts[0])
+        if len(parts) == 2:
+            full = mod.resolve_head(parts[0])
+            if full and full in self.project.by_modpath:
+                return self.module_locks.get(full, {}).get(parts[1])
+        return None
+
+    def resolve_calls(self, call: ast.Call,
+                      fn: FunctionInfo) -> List[FunctionInfo]:
+        """Callees of `call` that execute SYNCHRONOUSLY in the caller —
+        direct names, `self.m()`, module-alias calls, and
+        `self.<attr>.<method>()` through attr_types.  Callables passed as
+        ARGUMENTS are excluded on purpose (spawn targets run later)."""
+        f = call.func
+        mod = fn.module
+        if isinstance(f, ast.Name):
+            return self.project._resolve_call(mod, f.id, None)
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name):
+                return self.project._resolve_call(mod, f.attr, f.value.id)
+            if isinstance(f.value, ast.Attribute) and \
+                    isinstance(f.value.value, ast.Name) and \
+                    f.value.value.id == "self":
+                key = self.class_of_fn.get(id(fn))
+                if key is not None:
+                    ref = None
+                    for k in self.ancestry(key):
+                        ref = self.attr_types.get(k, {}).get(f.value.attr)
+                        if ref is not None:
+                            break
+                    if ref is not None:
+                        tmod = self.project.by_modpath.get(ref[0])
+                        nodes = self.method_nodes.get(ref, set())
+                        if tmod is not None:
+                            return [g for g in tmod.functions_named(f.attr)
+                                    if g.node in nodes]
+        return []
+
+
+# ---------------------------------------------------------------------------
+# per-function scan
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _FnScan:
+    fn: FunctionInfo
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    #: (held, acquired, line) from syntactic nesting
+    edges: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)
+    #: (held_tuple, call_node, line) for calls under at least one lock
+    locked_calls: List[Tuple[Tuple[str, ...], ast.Call, int]] = \
+        dataclasses.field(default_factory=list)
+    #: (held_tuple, desc, line) direct blocking ops under a lock
+    locked_blocking: List[Tuple[Tuple[str, ...], str, int]] = \
+        dataclasses.field(default_factory=list)
+    #: blocking ops anywhere in the function (for caller-side reporting)
+    blocking: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: resolved synchronous callees (whole function)
+    callees: List[FunctionInfo] = dataclasses.field(default_factory=list)
+    #: non-reentrant lock re-acquired under itself: (canonical, line)
+    self_deadlocks: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+
+
+def _scan_function(fn: FunctionInfo, model: LockModel) -> _FnScan:
+    scan = _FnScan(fn)
+    nested = {f.node for f in fn.module.functions if f.parent is fn}
+
+    def visit(node: ast.AST, held: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if child in nested:
+                continue
+            now = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in child.items:
+                    lock = model.resolve_lock_expr(fn, item.context_expr)
+                    if lock is None:
+                        continue
+                    c = lock.canonical
+                    # items of one `with A, B:` enter sequentially — B is
+                    # acquired with A already held, exactly like nesting
+                    cur = held + acquired
+                    if c in cur:
+                        if lock.kind == "threading" and not lock.reentrant:
+                            scan.self_deadlocks.append((c, child.lineno))
+                    else:
+                        for h in cur:
+                            scan.edges.append((h, c, child.lineno))
+                        acquired.append(c)
+                    scan.acquires.add(c)
+                if acquired:
+                    now = held + acquired
+            if isinstance(child, ast.Call):
+                callees = model.resolve_calls(child, fn)
+                scan.callees.extend(callees)
+                desc = _blocking_desc(child, fn.module)
+                if desc is not None:
+                    scan.blocking.setdefault(desc, child.lineno)
+                if now:
+                    if callees:
+                        scan.locked_calls.append(
+                            (tuple(dict.fromkeys(now)), child,
+                             child.lineno))
+                    if desc is not None:
+                        scan.locked_blocking.append(
+                            (tuple(dict.fromkeys(now)), desc,
+                             child.lineno))
+            visit(child, now)
+
+    visit(fn.node, [])
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# interprocedural fixpoint + graph assembly
+# ---------------------------------------------------------------------------
+
+def get_model(project: Project) -> "LockModel":
+    """Memoized LockModel for a Project — lockgraph and asyncrules both
+    run per lint invocation, and class-topology + attr-type inference
+    over every module is the expensive part; build it once."""
+    model = getattr(project, "_gl7_lock_model", None)
+    if model is None or model.project is not project:
+        model = LockModel(project)
+        project._gl7_lock_model = model
+    return model
+
+
+@dataclasses.dataclass
+class _Analysis:
+    model: LockModel
+    scans: Dict[int, _FnScan]
+    reach_acq: Dict[int, Set[str]]
+    reach_blk: Dict[int, Dict[str, str]]
+    edges: Dict[str, Set[str]]
+    witness: Dict[Tuple[str, str], Tuple[str, int, str, str]]
+
+
+def _analyze(project: Project) -> _Analysis:
+    model = get_model(project)
+    scans = {id(fn): _scan_function(fn, model)
+             for mod in project.modules.values() for fn in mod.functions}
+
+    # fixpoint: locks (and blocking ops) reachable through synchronous
+    # calls from each function
+    reach_acq: Dict[int, Set[str]] = {
+        k: set(s.acquires) for k, s in scans.items()}
+    reach_blk: Dict[int, Dict[str, str]] = {
+        k: {d: s.fn.qualname for d in s.blocking}
+        for k, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, s in scans.items():
+            for callee in s.callees:
+                ck = id(callee)
+                if ck not in scans or ck == k:
+                    continue
+                before = len(reach_acq[k])
+                reach_acq[k] |= reach_acq[ck]
+                if len(reach_acq[k]) != before:
+                    changed = True
+                for desc, origin in reach_blk[ck].items():
+                    if desc not in reach_blk[k]:
+                        reach_blk[k][desc] = origin
+                        changed = True
+
+    edges: Dict[str, Set[str]] = {}
+    witness: Dict[Tuple[str, str], Tuple[str, int, str, str]] = {}
+
+    def add_edge(a: str, b: str, path: str, line: int, symbol: str,
+                 note: str) -> None:
+        if a == b:
+            return
+        edges.setdefault(a, set()).add(b)
+        witness.setdefault((a, b), (path, line, symbol, note))
+
+    for s in scans.values():
+        relpath = s.fn.module.relpath
+        for a, b, line in s.edges:
+            add_edge(a, b, relpath, line, s.fn.qualname, "nested `with`")
+        for held, call, line in s.locked_calls:
+            for callee in model.resolve_calls(call, s.fn):
+                ck = id(callee)
+                if ck not in scans:
+                    continue
+                for b in reach_acq[ck]:
+                    for a in held:
+                        add_edge(a, b, relpath, line, s.fn.qualname,
+                                 f"via call to `{callee.qualname}`")
+    return _Analysis(model, scans, reach_acq, reach_blk, edges, witness)
+
+
+def build_order_graph(project: Project
+                      ) -> Tuple[LockModel,
+                                 Dict[str, Set[str]],
+                                 Dict[Tuple[str, str],
+                                      Tuple[str, int, str, str]]]:
+    """-> (model, edges {A: {B}}, witness {(A,B): (path, line, symbol,
+    note)}).  Public so tests can cross-check the static graph against
+    locksan's runtime-observed one."""
+    a = _analyze(project)
+    return a.model, a.edges, a.witness
+
+
+def _sccs(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan strongly-connected components over the edge map."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(edges.get(v, ())):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    nodes = set(edges)
+    for vs in edges.values():
+        nodes |= vs
+    for v in sorted(nodes):
+        if v not in index:
+            strong(v)
+    return [c for c in out if len(c) > 1]
+
+
+def _cycle_in(component: List[str],
+              edges: Dict[str, Set[str]]) -> List[str]:
+    """One concrete cycle inside an SCC (guaranteed to exist)."""
+    comp = set(component)
+    start = sorted(component)[0]
+    path = [start]
+    seen = {start}
+    node = start
+    while True:
+        nxt = sorted(n for n in edges.get(node, ()) if n in comp)[0]
+        if nxt in seen:
+            return path[path.index(nxt):] + [nxt]
+        path.append(nxt)
+        seen.add(nxt)
+        node = nxt
+
+
+def check(project: Project) -> List[Finding]:
+    ana = _analyze(project)
+    model, scans = ana.model, ana.scans
+    edges, witness = ana.edges, ana.witness
+    out: List[Finding] = []
+
+    # ---- GL701: cycles + non-reentrant self-acquisition -------------------
+    for comp in _sccs(edges):
+        cycle = _cycle_in(comp, edges)
+        steps = []
+        for a, b in zip(cycle, cycle[1:]):
+            path, line, symbol, note = witness[(a, b)]
+            steps.append(f"{a} -> {b} ({symbol} at {path}:{line}, {note})")
+        path0, line0, symbol0, _ = witness[(cycle[0], cycle[1])]
+        out.append(Finding(
+            "GL701", path0, line0,
+            "lock-order cycle (potential deadlock): " + "; ".join(steps),
+            symbol0))
+    for s in scans.values():
+        for canonical, line in s.self_deadlocks:
+            out.append(Finding(
+                "GL701", s.fn.module.relpath, line,
+                f"non-reentrant lock `{canonical}` re-acquired while "
+                "already held — guaranteed self-deadlock (use an RLock "
+                "or restructure)", s.fn.qualname))
+    # interprocedural form: caller holds a non-reentrant lock and a
+    # synchronous callee re-acquires it (add_edge drops a==b edges, so
+    # this is checked separately)
+    self_seen: Set[Tuple[str, str]] = set()
+    for s in scans.values():
+        for held, call, line in s.locked_calls:
+            for callee in model.resolve_calls(call, s.fn):
+                ck = id(callee)
+                if ck not in scans:
+                    continue
+                for b in ana.reach_acq[ck]:
+                    if b not in held:
+                        continue
+                    lock = model.locks.get(b)
+                    if lock is None or lock.kind != "threading" or \
+                            lock.reentrant:
+                        continue
+                    key = (s.fn.qualname, b)
+                    if key in self_seen:
+                        continue
+                    self_seen.add(key)
+                    out.append(Finding(
+                        "GL701", s.fn.module.relpath, line,
+                        f"non-reentrant lock `{b}` re-acquired through "
+                        f"call to `{callee.qualname}` while already held "
+                        "— guaranteed self-deadlock on the same "
+                        "instance", s.fn.qualname))
+
+    # ---- GL702: blocking under a held lock ---------------------------------
+    reach_blk = ana.reach_blk
+    reported: Set[Tuple[str, str, str]] = set()
+    for s in scans.values():
+        relpath = s.fn.module.relpath
+        for held, desc, line in s.locked_blocking:
+            for a in held:
+                key = (s.fn.qualname, a, desc)
+                if key in reported:
+                    continue
+                reported.add(key)
+                out.append(Finding(
+                    "GL702", relpath, line,
+                    f"{desc} while holding `{a}` — every thread behind "
+                    "the lock stalls for the full wait", s.fn.qualname))
+        for held, call, line in s.locked_calls:
+            for callee in model.resolve_calls(call, s.fn):
+                ck = id(callee)
+                if ck not in scans:
+                    continue
+                for desc, origin in reach_blk[ck].items():
+                    for a in held:
+                        key = (s.fn.qualname, a, desc)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        out.append(Finding(
+                            "GL702", relpath, line,
+                            f"call reaches {desc} (in `{origin}`) while "
+                            f"holding `{a}` — every thread behind the "
+                            "lock stalls for the full wait",
+                            s.fn.qualname))
+
+    # ---- GL704: leaked thread/task handles ---------------------------------
+    for mod in project.modules.values():
+        out.extend(_check_leaks(mod, model))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+# ---------------------------------------------------------------------------
+# GL704 — thread/task leak detection
+# ---------------------------------------------------------------------------
+
+def _handle_kind(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
+    t = _resolve_target(call.func, mod)
+    if t == "threading.Thread":
+        return "thread"
+    leaf = (t or "").split(".")[-1] if t else (
+        call.func.attr if isinstance(call.func, ast.Attribute) else "")
+    if leaf in ("create_task", "ensure_future"):
+        return "task"
+    return None
+
+
+def _shutdown_surface(mod: ModuleInfo
+                      ) -> Tuple[Set[str], Set[str], bool]:
+    """(attrs with .join/.cancel, local names with .join/.cancel,
+    any_loop_join) for the module."""
+    attr_joined: Set[str] = set()
+    name_joined: Set[str] = set()
+    any_loop_join = False
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("join", "cancel"):
+            target = node.func.value
+            if isinstance(target, ast.Attribute):
+                attr_joined.add(target.attr)
+            elif isinstance(target, ast.Name):
+                name_joined.add(target.id)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            targets = {n.id for n in ast.walk(node.target)
+                       if isinstance(n, ast.Name)}
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Call) and \
+                        isinstance(inner.func, ast.Attribute) and \
+                        inner.func.attr in ("join", "cancel") and \
+                        isinstance(inner.func.value, ast.Name) and \
+                        inner.func.value.id in targets:
+                    any_loop_join = True
+    return attr_joined, name_joined, any_loop_join
+
+
+def _enclosing_fn(mod: ModuleInfo, node: ast.AST) -> Optional[FunctionInfo]:
+    best: Optional[FunctionInfo] = None
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return None
+    for fn in mod.functions:
+        end = getattr(fn.node, "end_lineno", fn.node.lineno)
+        if fn.node.lineno <= line <= end and \
+                (best is None or fn.node.lineno > best.node.lineno):
+            best = fn
+    return best
+
+
+def _check_leaks(mod: ModuleInfo, model: LockModel) -> List[Finding]:
+    out: List[Finding] = []
+    attr_joined, name_joined, any_loop_join = _shutdown_surface(mod)
+
+    def attr_ok(attr: str) -> bool:
+        return attr in attr_joined
+
+    # map: statement handling.  Walk Assign / bare-Expr statements.
+    for node in ast.walk(mod.tree):
+        ctor: Optional[ast.Call] = None
+        kind: Optional[str] = None
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            kind = _handle_kind(node.value, mod)
+            ctor = node.value
+            if kind is None:
+                continue
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Attribute):
+                if not attr_ok(tgt.attr):
+                    out.append(_leak_finding(mod, ctor, kind, tgt.attr))
+            elif isinstance(tgt, ast.Name):
+                if not _local_handle_ok(mod, node, tgt.id, attr_joined,
+                                        name_joined, any_loop_join):
+                    out.append(_leak_finding(mod, ctor, kind, tgt.id))
+        elif isinstance(node, ast.Expr) and \
+                isinstance(node.value, ast.Call):
+            call = node.value
+            kind = _handle_kind(call, mod)
+            if kind is None and isinstance(call.func, ast.Attribute) and \
+                    isinstance(call.func.value, ast.Call):
+                # Thread(...).start() — the handle is dropped on the spot
+                kind = _handle_kind(call.func.value, mod)
+                call = call.func.value
+            if kind is not None:
+                # a dropped task can be GC'd mid-flight and can never be
+                # cancelled on shutdown; a dropped thread can never be
+                # joined
+                out.append(_leak_finding(mod, call, kind, None))
+    return out
+
+
+def _local_handle_ok(mod: ModuleInfo, assign: ast.Assign, name: str,
+                     attr_joined: Set[str], name_joined: Set[str],
+                     any_loop_join: bool) -> bool:
+    fn = _enclosing_fn(mod, assign)
+    scope = fn.node if fn is not None else mod.tree
+    if name in name_joined:
+        return True
+    for node in ast.walk(scope):
+        # self.Y = t  -> judged as attribute Y
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == name:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        (tgt.attr in attr_joined or any_loop_join):
+                    return True
+        # X.append(t) -> worker-collection idiom; accepted when the
+        # module joins/cancels loop targets anywhere
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "add") and \
+                any(isinstance(a, ast.Name) and a.id == name
+                    for a in node.args):
+            if any_loop_join:
+                return True
+            tv = node.func.value
+            if isinstance(tv, ast.Attribute) and tv.attr in attr_joined:
+                return True
+        # return t -> the caller owns the handle now
+        if isinstance(node, ast.Return) and node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+    return False
+
+
+def _leak_finding(mod: ModuleInfo, ctor: ast.Call, kind: str,
+                  handle: Optional[str]) -> Finding:
+    fn = _enclosing_fn(mod, ctor)
+    what = "Thread" if kind == "thread" else "task"
+    where = f"`{handle}`" if handle else "an unnamed handle"
+    return Finding(
+        "GL704", mod.relpath, ctor.lineno,
+        f"{what} handle {where} never reaches a join()/cancel() on any "
+        "shutdown path in this module — the "
+        f"{'thread outlives' if kind == 'thread' else 'task can be GC-collected mid-flight and outlives'} "
+        "its owner silently",
+        fn.qualname if fn is not None else "")

@@ -13,14 +13,15 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from tools.graftlint import (concurrency, dtype_parity, errorpath,
-                             hostsync, obsnames, retrace)
+from tools.graftlint import (asyncrules, concurrency, dtype_parity,
+                             errorpath, hostsync, lockgraph, obsnames,
+                             retrace)
 from tools.graftlint.baseline import (BaselineError, Suppression,
                                       apply_baseline, load_baseline)
 from tools.graftlint.core import Finding, Project
 
 CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity,
-            obsnames)
+            obsnames, lockgraph, asyncrules)
 
 #: rule id -> one-line description, collected from every checker module
 ALL_RULES: Dict[str, str] = {}
@@ -69,7 +70,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="graftlint",
         description="TPU/JAX static-analysis suite for sptag_tpu "
                     "(host-sync, retrace, concurrency, error-path, "
-                    "dtype-parity, observability-names)")
+                    "dtype-parity, observability-names, lock-order/"
+                    "blocking-under-lock, sync-async hazards)")
     parser.add_argument("paths", nargs="*", default=["sptag_tpu"],
                         help="package roots to lint (default: sptag_tpu)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -123,7 +125,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         total_unsuppressed, suppressed = apply_baseline(findings,
                                                         suppressions)
         total_suppressed = len(suppressed)
-        stale = [s for s in suppressions if s.hits == 0]
+        # under --select, only suppressions for the selected rules can
+        # meaningfully be stale — the others never had a chance to match
+        stale = [s for s in suppressions if s.hits == 0
+                 and (not args.select
+                      or any(s.rule.startswith(p) for p in args.select))]
 
     for f in total_unsuppressed:
         print(f.format())
