@@ -97,6 +97,9 @@ class BKTIndex(VectorIndex):
         self._rebuild_done.set()          # no rebuild in flight
         self._rebuild_pending = False
         self._refine_dense_cache = None   # (key, DenseTreeSearcher)
+        # continuous-batching slot scheduler (algo/scheduler.py), bound to
+        # ONE engine snapshot; rebuilt lazily when the engine is replaced
+        self._scheduler = None
         # bumped whenever row ids are remapped (build / compaction) so an
         # in-flight background rebuild can detect its snapshot went stale
         self._structure_gen = 0
@@ -514,16 +517,108 @@ class BKTIndex(VectorIndex):
             d, ids = self._engine_search(queries, min(k, self._n), mc)
         return self._pad_results(d, ids, k)
 
+    def _get_scheduler(self):
+        """Slot scheduler over the CURRENT engine snapshot (created
+        lazily).  A snapshot swap RETIRES the old scheduler: it stops
+        accepting new queries but finishes everything already submitted
+        against its (immutable) old snapshot — the same semantics as
+        monolithic searches that were mid-flight when the swap landed —
+        and its worker exits on its own once drained."""
+        from sptag_tpu.algo.scheduler import BeamSlotScheduler
+
+        engine = self._get_engine()
+        old = None
+        with self._lock:
+            sched = self._scheduler
+            if (sched is not None and sched._engine is engine
+                    and not sched._stopped and not sched._draining):
+                return sched
+            old = sched
+            p = self.params
+            sched = BeamSlotScheduler(
+                engine, slots=int(getattr(p, "beam_slots", 1024)),
+                segment_iters=int(getattr(p, "beam_segment_iters", 0)),
+                name="beam-sched")
+            self._scheduler = sched
+        if old is not None:
+            old.retire()      # non-blocking; in-flight queries complete
+        return sched
+
+    def _scheduler_submit(self, queries: np.ndarray, k: int,
+                          max_check: int) -> list:
+        """Submit prepared queries to the slot scheduler; KDT overrides to
+        attach its per-query kd-tree seeds."""
+        p = self.params
+        sched = self._get_scheduler()
+        return [sched.submit(queries[i], k, max_check,
+                             beam_width=getattr(p, "beam_width", 16),
+                             nbp_limit=p.no_better_propagation_limit,
+                             dynamic_pivots=p.other_dynamic_pivots)
+                for i in range(queries.shape[0])]
+
     def _engine_search(self, queries: np.ndarray, k: int, max_check: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
         """Beam-walk branch of _search_batch; KDT overrides to seed from
         its kd-tree descent instead of the shared pivots."""
         p = self.params
+        if int(getattr(p, "continuous_batching", 0)):
+            # same results, continuously batched: the sync batch rides the
+            # slot scheduler so it shares device time with concurrent
+            # submitters instead of convoying them
+            from sptag_tpu.algo.scheduler import gather_futures
+
+            return gather_futures(
+                self._scheduler_submit(queries, k, max_check), k)
+        seg = int(getattr(p, "beam_segment_iters", 0))
         return self._get_engine().search(
             queries, k, max_check=max_check,
             beam_width=getattr(p, "beam_width", 16),
             nbp_limit=p.no_better_propagation_limit,
-            dynamic_pivots=p.other_dynamic_pivots)
+            dynamic_pivots=p.other_dynamic_pivots,
+            segment_iters=seg or None)
+
+    def submit_batch(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None,
+                     search_mode: Optional[str] = None) -> list:
+        """Streaming submit (core/index.py contract): with
+        ContinuousBatching=1 and a beam-resolved mode, futures resolve AS
+        QUERIES RETIRE from the slot scheduler; otherwise falls back to
+        the synchronous base implementation."""
+        p = self.params
+        mc = max_check if max_check is not None else p.max_check
+        mode = search_mode or getattr(p, "search_mode", "beam")
+        if (self._n == 0 or not int(getattr(p, "continuous_batching", 0))
+                or mode not in ("beam", "auto")
+                or self.resolve_search_mode(mode, mc) != "beam"
+                or not getattr(p, "build_graph", 1)):
+            return super().submit_batch(queries, k, max_check=max_check,
+                                        search_mode=search_mode)
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"query dim {queries.shape[1]} != index dim "
+                f"{self.feature_dim}")
+        queries = self._prepare_query(queries)
+        from concurrent.futures import Future
+
+        from sptag_tpu.algo.scheduler import pad_result_row
+
+        out = []
+        for inner in self._scheduler_submit(queries, min(k, self._n), mc):
+            outer: Future = Future()
+
+            def _pad(f, outer=outer):
+                e = f.exception()
+                if e is not None:
+                    outer.set_exception(e)
+                    return
+                d, ids = f.result()
+                outer.set_result(pad_result_row(d, ids, k))
+            inner.add_done_callback(_pad)
+            out.append(outer)
+        return out
 
     @staticmethod
     def _pad_results(d: np.ndarray, ids: np.ndarray, k: int
@@ -638,8 +733,11 @@ class BKTIndex(VectorIndex):
         (a running rebuild job needs the lock to finish)."""
         with self._lock:
             pool, self._rebuild_pool = self._rebuild_pool, None
+            sched, self._scheduler = self._scheduler, None
         if pool is not None:
             pool.stop()
+        if sched is not None:
+            sched.stop()
 
     def __del__(self):                    # pragma: no cover - GC timing
         try:

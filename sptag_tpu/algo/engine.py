@@ -185,15 +185,8 @@ def _sorted_dup_mask(ids: jax.Array):
     return _sorted_dedup(ids)[1]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit",
-                     "inject"))
-def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
-                        pivot_mask, queries, k: int, L: int, B: int, T: int,
-                        metric: int, base: int, nbp_limit: int,
-                        inject: int = 4, data_score=None, nbr_vecs=None,
-                        nbr_sq=None):
+def _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
+                      metric: int):
     """Shared-pivot seeding (BKT): one dense (Q, P) matmul scores the whole
     pivot set; the top-L pivots initialize every query's beam.  `pivot_mask`
     (W,) int32 is the precomputed packed bitset of the pivot ids.
@@ -202,9 +195,10 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     injects the next `inject` of them whenever the frontier falls behind
     the best unvisited pivot, mirroring the reference's mid-walk
     `SearchTrees` refill (`NGQueue.top > SPTQueue.top`, BKTIndex.cpp:153-155;
-    `NumberOfOtherDynamicPivots` is the refill size)."""
+    `NumberOfOtherDynamicPivots` is the refill size).
+
+    Returns (cand_ids, cand_d, visited, spare_ids, spare_d)."""
     Q = queries.shape[0]
-    N = data.shape[0]
     P = pivot_ids.shape[0]
 
     d0 = dist_ops.pairwise_distance(queries, pivot_vecs,
@@ -227,25 +221,15 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     # every pivot was scored: mark visited so the walk never re-scores one
     visited = jnp.broadcast_to(pivot_mask[None, :],
                                (Q, pivot_mask.shape[0])).astype(jnp.int32)
-
-    return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
-                 visited, k, L, B, T, metric, base, nbp_limit,
-                 spare_ids=spare_ids, spare_d=spare_d, inject=inject,
-                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+    return cand_ids, cand_d, visited, spare_ids, spare_d
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
-def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
-                               queries, k: int, L: int, B: int, T: int,
-                               metric: int, base: int, nbp_limit: int,
-                               data_score=None, nbr_vecs=None,
-                               nbr_sq=None):
+def _seed_from_seeds(data, sqnorm, seed_ids, queries, L: int, metric: int,
+                     base: int):
     """Per-query seeding (KDT): `seed_ids` (Q, S) come from a host-side tree
     descent per query (the reference's KDTSearch leaf seeding,
     KDTree.h:178-215); they are gathered and scored as one batched
-    contraction, then the same walk runs."""
+    contraction.  Returns (cand_ids, cand_d, visited)."""
     Q = queries.shape[0]
     N = data.shape[0]
     S = seed_ids.shape[1]
@@ -270,30 +254,80 @@ def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
     cand_d = -neg
     cand_ids = jnp.where(cand_d < MAX_DIST,
                          jnp.take_along_axis(seed_ids, pos, axis=1), -1)
+    return cand_ids, cand_d, visited
 
+
+@functools.partial(jax.jit, static_argnames=("L", "metric"))
+def _beam_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
+                      metric: int):
+    """Standalone jit of the pivot seeding — the scheduler seeds refill
+    buckets with it, then walks them under `_beam_segment_kernel`."""
+    return _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L,
+                             metric)
+
+
+@functools.partial(jax.jit, static_argnames=("L", "metric", "base"))
+def _beam_seed_seeded_kernel(data, sqnorm, seed_ids, queries, L: int,
+                             metric: int, base: int):
+    return _seed_from_seeds(data, sqnorm, seed_ids, queries, L, metric,
+                            base)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
+                     "inject"))
+def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
+                        pivot_mask, queries, t_limit, k: int, L: int,
+                        B: int, metric: int, base: int, nbp_limit: int,
+                        inject: int = 4, data_score=None, nbr_vecs=None,
+                        nbr_sq=None):
+    """Pivot-seeded monolithic walk: seed + walk + finalize fused in one
+    program.  `t_limit` (Q,) carries the per-row iteration budget as a
+    TRACED array, so distinct MaxCheck values that map to the same (L, B)
+    reuse one compiled program."""
+    cand_ids, cand_d, visited, spare_ids, spare_d = _seed_from_pivots(
+        pivot_ids, pivot_vecs, pivot_mask, queries, L, metric)
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
-                 visited, k, L, B, T, metric, base, nbp_limit,
+                 visited, k, L, B, t_limit, metric, base, nbp_limit,
+                 spare_ids=spare_ids, spare_d=spare_d, inject=inject,
                  data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit",
+    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit"))
+def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
+                               queries, t_limit, k: int, L: int, B: int,
+                               metric: int, base: int, nbp_limit: int,
+                               data_score=None, nbr_vecs=None,
+                               nbr_sq=None):
+    cand_ids, cand_d, visited = _seed_from_seeds(data, sqnorm, seed_ids,
+                                                 queries, L, metric, base)
+    return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
+                 visited, k, L, B, t_limit, metric, base, nbp_limit,
+                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
                      "inject"))
 def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
-                         pivot_mask, queries3, k: int, L: int, B: int,
-                         T: int, metric: int, base: int, nbp_limit: int,
+                         pivot_mask, queries3, t_limit, k: int, L: int,
+                         B: int, metric: int, base: int, nbp_limit: int,
                          inject: int = 4, data_score=None, nbr_vecs=None,
                          nbr_sq=None):
     """(M, chunk, D) query chunks under one `lax.map` — a single device
     program for any batch size (one upload, one dispatch, one read; the
     tunneled backend costs ~60 ms per host round trip).  The per-chunk
     visited bitset is reused across sequential chunks instead of scaling
-    with the total batch."""
+    with the total batch.  `t_limit` is (chunk,) and shared by all chunks
+    (one search call = one budget)."""
     def body(q):
         return _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids,
-                                   pivot_vecs, pivot_mask, q, k, L, B, T,
-                                   metric, base, nbp_limit, inject,
+                                   pivot_vecs, pivot_mask, q, t_limit, k,
+                                   L, B, metric, base, nbp_limit, inject,
                                    data_score=data_score,
                                    nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
     return jax.lax.map(body, queries3)
@@ -301,39 +335,75 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "L", "B", "T", "metric", "base", "nbp_limit"))
+    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit"))
 def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
-                                queries3, k: int, L: int, B: int, T: int,
+                                queries3, t_limit, k: int, L: int, B: int,
                                 metric: int, base: int, nbp_limit: int,
                                 data_score=None, nbr_vecs=None,
                                 nbr_sq=None):
     def body(args):
         s, q = args
         return _beam_search_seeded_kernel(data, sqnorm, graph, deleted, s,
-                                          q, k, L, B, T, metric, base,
-                                          nbp_limit,
+                                          q, t_limit, k, L, B, metric,
+                                          base, nbp_limit,
                                           data_score=data_score,
                                           nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
     return jax.lax.map(body, (seeds3, queries3))
 
 
-def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
-          k: int, L: int, B: int, T: int, metric: int, base: int,
-          nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0,
-          data_score=None, nbr_vecs=None, nbr_sq=None):
-    """`data_score`: optional low-precision (bf16) shadow of `data` used for
+def _init_walk_state(cand_ids, cand_d, visited):
+    """Fresh loop-carried state over a seeded beam: the 7-tuple
+    `(cand_ids, cand_d, expanded, visited, no_better, ptr, it)` that the
+    monolithic walk, the segmented kernel, and the slot scheduler all
+    carry (the state-checkpointing contract — DESIGN.md §10).  `it` is a
+    PER-QUERY iteration counter (Q,) so rows with different budgets can
+    share one compiled program via the traced `t_limit` vector."""
+    Q, L = cand_ids.shape
+    # expanded has a dump slot at column L; visited a dump slot at row N
+    expanded = jnp.concatenate(
+        [cand_ids < 0, jnp.zeros((Q, 1), bool)], axis=1)        # (Q, L+1)
+    no_better = jnp.zeros((Q,), jnp.int32)
+    ptr = jnp.zeros((Q,), jnp.int32)      # next un-injected spare pivot
+    it = jnp.zeros((Q,), jnp.int32)
+    return cand_ids, cand_d, expanded, visited, no_better, ptr, it
+
+
+def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
+                  B: int, metric: int, base: int, nbp_limit: int,
+                  spare_ids=None, spare_d=None, inject: int = 0,
+                  data_score=None, nbr_vecs=None, nbr_sq=None):
+    """One beam iteration as a reusable (body, row_alive) pair over the
+    walk's constants — shared verbatim by the monolithic `lax.while_loop`
+    walk and the segmented kernel, so the two execute IDENTICAL per-row
+    trajectories (the bit-parity contract the scheduler's retire decision
+    rests on).
+
+    `row_alive(state)` is the per-row continuation predicate: True while
+    the next body application could still change the row's pool.  A row
+    for which it is False is in an ABSORBING no-op state — the body
+    freezes its beam, counters and spare pointer — so retiring it early
+    (scheduler) and keeping it resident (monolithic batch) yield the same
+    final (dists, ids).  That absorption is why `no_better` is FROZEN for
+    non-live rows rather than reset on a non-worse frontier: the old
+    reset let a tripped row re-activate one iteration later, making its
+    result depend on whether OTHER queries kept the batch loop running —
+    batch-composition-dependent results that no compacting scheduler
+    could reproduce.  (The reference never un-trips either: below budget
+    it re-enters the trees — the spare-injection path here — rather than
+    observing frontier improvement without expanding.)
+
+    `data_score`: optional low-precision (bf16) shadow of `data` used for
     the in-loop candidate scoring — halves the dominant gather's HBM bytes
     and doubles the MXU rate on TPU.  The loop's distances only ORDER the
     beam; the final pool is re-ranked against the exact f32 rows before the
-    top-k, so returned distances (and the included/excluded boundary at k)
-    are computed at full precision.
+    top-k (_finalize), so returned distances (and the included/excluded
+    boundary at k) are computed at full precision.
 
     `nbr_vecs` (N, m, D) / `nbr_sq` (N, m): optional packed per-node
     neighbor vectors (BeamPackedNeighbors) — the in-loop gather becomes B
     block reads per query instead of B*m scattered row reads."""
     Q = queries.shape[0]
     N = data.shape[0]
-    rerank = data_score is not None and data_score.dtype != data.dtype
     score_src = data_score if data_score is not None else data
     queries_s = (queries.astype(score_src.dtype)
                  if queries.dtype != score_src.dtype and
@@ -343,15 +413,9 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
     # only REAL spare entries count as remaining work — the spare queue is
     # -1/MAX_DIST padded (fewer pivots than slots), and treating pads as
     # pending injections would keep converged queries spinning through
-    # no-op inject/reset cycles until the full T budget
+    # no-op inject/reset cycles until the full budget
     n_spare = (jnp.sum(spare_ids >= 0, axis=1).astype(jnp.int32)
                if use_spares else None)
-
-    # expanded has a dump slot at column L; visited a dump slot at row N
-    expanded = jnp.concatenate(
-        [cand_ids < 0, jnp.zeros((Q, 1), bool)], axis=1)        # (Q, L+1)
-    no_better = jnp.zeros((Q,), jnp.int32)
-    ptr = jnp.zeros((Q,), jnp.int32)      # next un-injected spare pivot
     k_eff = min(k, L)
 
     def _active(no_better, ptr):
@@ -366,7 +430,7 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
             act = act | (ptr < n_spare)
         return act
 
-    def cond(state):
+    def row_alive(state):
         cand_ids, cand_d, expanded, visited, no_better, ptr, it = state
         active = _active(no_better, ptr)
         has_work = jnp.any((~expanded[:, :L]) & (cand_ids >= 0), axis=1)
@@ -374,11 +438,14 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
             # a fully-expanded beam with pending spares still has work —
             # the next injection may open an unreached graph component
             has_work = has_work | (ptr < n_spare)
-        return (it < T) & jnp.any(active & has_work)
+        return (it < t_limit) & active & has_work
 
     def body(state):
         cand_ids, cand_d, expanded, visited, no_better, ptr, it = state
-        active = _active(no_better, ptr)                         # (Q,)
+        # a row past its own budget is frozen exactly like an nbp-tripped
+        # one — this is what lets rows with DIFFERENT t_limit values share
+        # one compiled program (mixed-MaxCheck slot pools)
+        active = _active(no_better, ptr) & (it < t_limit)        # (Q,)
 
         # ---- pop best B unexpanded entries --------------------------------
         sel_score = jnp.where(expanded[:, :L], MAX_DIST, cand_d)
@@ -473,19 +540,49 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
             [jnp.take_along_axis(all_exp, mpos, axis=1),
              jnp.zeros((Q, 1), bool)], axis=1)
 
-        no_better = jnp.where(frontier_worse,
-                              jnp.where(active, no_better + 1, no_better),
-                              0)
+        # non-live rows FREEZE their counter (see _walk_machine docstring:
+        # resetting it on a non-worse frontier made a tripped row's fate
+        # depend on the rest of the batch)
+        no_better = jnp.where(active,
+                              jnp.where(frontier_worse, no_better + 1, 0),
+                              no_better)
         if use_spares:
             # a fresh tree re-seed resets the stall counter (the reference
             # continues its loop after SearchTrees rather than breaking)
             no_better = jnp.where(trigger, 0, no_better)
         return cand_ids, cand_d, expanded, visited, no_better, ptr, it + 1
 
-    state = (cand_ids, cand_d, expanded, visited, no_better, ptr,
-             jnp.int32(0))
-    cand_ids, cand_d, *_ = jax.lax.while_loop(cond, body, state)
+    return body, row_alive
 
+
+def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
+          k: int, L: int, B: int, t_limit, metric: int, base: int,
+          nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0,
+          data_score=None, nbr_vecs=None, nbr_sq=None):
+    """Monolithic walk: run the shared body under one `lax.while_loop`
+    until no row is alive, then finalize.  `t_limit` is a (Q,) traced
+    budget vector (iterations per row) — budgets no longer mint compiles,
+    only (L, B, k) do."""
+    body, row_alive = _walk_machine(
+        data, sqnorm, graph, queries, t_limit, k, L, B, metric, base,
+        nbp_limit, spare_ids=spare_ids, spare_d=spare_d, inject=inject,
+        data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+
+    def cond(state):
+        return jnp.any(row_alive(state))
+
+    state = _init_walk_state(cand_ids, cand_d, visited)
+    cand_ids, cand_d, *_ = jax.lax.while_loop(cond, body, state)
+    rerank = data_score is not None and data_score.dtype != data.dtype
+    return _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d,
+                     min(k, L), metric, base, rerank)
+
+
+def _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d, k_eff: int,
+              metric: int, base: int, rerank: bool):
+    """Walk epilogue shared by the monolithic kernels and the scheduler's
+    retire path: optional exact f32 re-rank of the L-pool, tombstone
+    filter, final top-k."""
     if rerank:
         # exact f32 re-rank of the final L-pool: one (Q, L, D) gather —
         # about the cost of a single loop iteration's candidate gather
@@ -502,6 +599,49 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
     final_ids = jnp.take_along_axis(cand_ids, fpos, axis=1)
     final_ids = jnp.where(final_d < MAX_DIST, final_ids, -1)
     return final_d, final_ids.astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "S", "metric", "base", "nbp_limit",
+                     "inject"))
+def _beam_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
+                         cand_d, expanded, visited, no_better, ptr, it,
+                         k: int, L: int, B: int, S: int, metric: int,
+                         base: int, nbp_limit: int, inject: int = 0,
+                         spare_ids=None, spare_d=None, data_score=None,
+                         nbr_vecs=None, nbr_sq=None):
+    """Segmented walk: at most S iterations of the SAME body the
+    monolithic walk runs, over loop-carried state passed in and returned
+    intact — the device half of the continuous-batching walk
+    (algo/scheduler.py).  Returns the updated 7-tuple plus the per-row
+    `alive` flag; a row with alive=False is in the absorbing done state
+    (retire it — its pool is final).  Empty slots are encoded as rows
+    with t_limit=0 (never alive, body is a no-op on them)."""
+    body, row_alive = _walk_machine(
+        data, sqnorm, graph, queries, t_limit, k, L, B, metric, base,
+        nbp_limit, spare_ids=spare_ids, spare_d=spare_d, inject=inject,
+        data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+
+    def cond(carry):
+        seg, state = carry
+        return (seg < S) & jnp.any(row_alive(state))
+
+    def sbody(carry):
+        seg, state = carry
+        return seg + 1, body(state)
+
+    state = (cand_ids, cand_d, expanded, visited, no_better, ptr, it)
+    _, state = jax.lax.while_loop(cond, sbody, (jnp.int32(0), state))
+    return state + (row_alive(state),)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k_eff", "metric", "base", "rerank"))
+def _beam_finalize_kernel(data, sqnorm, deleted, queries, cand_ids, cand_d,
+                          k_eff: int, metric: int, base: int, rerank: bool):
+    return _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d,
+                     k_eff, metric, base, rerank)
 
 
 class GraphSearchEngine:
@@ -571,10 +711,133 @@ class GraphSearchEngine:
         changes, which must not pay a full snapshot rebuild."""
         self.deleted = jnp.asarray(deleted[:self.n])
 
+    # ---- walk configuration / scheduler surface ---------------------------
+
+    def walk_plan(self, k: int, max_check: int, beam_width: int = 16,
+                  pool_size: Optional[int] = None, nbp_limit: int = 3
+                  ) -> Tuple[int, int, int, int, int]:
+        """(k_eff, L, B, T, limit): the static walk configuration for a
+        budget — THE single formula shared by search() and the slot
+        scheduler (algo/scheduler.py keys its pools on (k_eff, L, B,
+        limit); T rides per-row as `t_limit`, so budgets that agree on
+        the rest share a pool AND a compiled program)."""
+        k_eff = min(k, self.n)
+        L = beam_pool_size(k_eff, max_check, self.n, pool_size)
+        B = beam_width_for(beam_width, max_check, L)
+        T = max(1, -(-max_check // B))
+        # continuous no-better-propagation limit: maxCheck/64 pops in the
+        # reference (WorkSpace.h:191), aggregated B pops per iteration here
+        limit = max(nbp_limit, (max_check // 64) // B, 1)
+        return k_eff, L, B, T, limit
+
+    def chunk_size(self) -> int:
+        """Largest per-program query batch the visited-bitset budget
+        allows (packed bitset: 4 bytes per 32 ids -> N/8 bytes/query)."""
+        return max(1, min(_VISITED_BUDGET // max(self.n // 8, 1), 1024))
+
+    def seed_state(self, queries: jax.Array, L: int,
+                   seeds: Optional[jax.Array] = None) -> dict:
+        """Seed a fresh walk state for `queries` (already device-shaped
+        (Q, D)): the dict of loop-carried arrays plus the per-row spare
+        queues and the queries themselves — everything a segment needs
+        besides the engine snapshot.  The scheduler compacts/refills these
+        arrays between segments; `run_segment` consumes them verbatim."""
+        if seeds is None:
+            cand_ids, cand_d, visited, spare_ids, spare_d = \
+                _beam_seed_kernel(self.pivot_ids, self.pivot_vecs,
+                                  self.pivot_mask, queries, L,
+                                  int(self.metric))
+        else:
+            cand_ids, cand_d, visited = _beam_seed_seeded_kernel(
+                self.data, self.sqnorm, seeds, queries, L,
+                int(self.metric), self.base)
+            spare_ids = spare_d = None
+        cand_ids, cand_d, expanded, visited, no_better, ptr, it = \
+            _init_walk_state(cand_ids, cand_d, visited)
+        return {"queries": queries, "cand_ids": cand_ids, "cand_d": cand_d,
+                "expanded": expanded, "visited": visited,
+                "no_better": no_better, "ptr": ptr, "it": it,
+                "spare_ids": spare_ids, "spare_d": spare_d}
+
+    def run_segment(self, state: dict, t_limit: jax.Array, k_eff: int,
+                    L: int, B: int, nbp_limit: int, S: int,
+                    inject: int = 0) -> Tuple[dict, jax.Array]:
+        """Advance every row of `state` by at most S walk iterations;
+        returns (new state, (Q,) alive).  Rows with alive=False are done
+        (absorbing) — their pool is final and `finalize` may retire them."""
+        spare_ids = state["spare_ids"]
+        out = _beam_segment_kernel(
+            self.data, self.sqnorm, self.graph, state["queries"], t_limit,
+            state["cand_ids"], state["cand_d"], state["expanded"],
+            state["visited"], state["no_better"], state["ptr"], state["it"],
+            k_eff, L, B, S, int(self.metric), self.base, nbp_limit,
+            inject=inject if spare_ids is not None else 0,
+            spare_ids=spare_ids, spare_d=state["spare_d"],
+            data_score=self.data_score, nbr_vecs=self.nbr_vecs,
+            nbr_sq=self.nbr_sq)
+        new = dict(state)
+        (new["cand_ids"], new["cand_d"], new["expanded"], new["visited"],
+         new["no_better"], new["ptr"], new["it"], alive) = out
+        return new, alive
+
+    def finalize(self, state: dict, k_eff: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Rerank + tombstone-filter + top-k over the state's pools;
+        identical epilogue to the monolithic kernels."""
+        rerank = (self.data_score is not None
+                  and self.data_score.dtype != self.data.dtype)
+        d, ids = _beam_finalize_kernel(
+            self.data, self.sqnorm, self.deleted, state["queries"],
+            state["cand_ids"], state["cand_d"], k_eff, int(self.metric),
+            self.base, rerank)
+        return np.asarray(d), np.asarray(ids)
+
+    def _search_segmented(self, queries: np.ndarray,
+                          seeds: Optional[np.ndarray], k_eff: int, L: int,
+                          B: int, T: int, limit: int, inject: int,
+                          chunk: int, S: int
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        """search() via repeated fixed-S segments (BeamSegmentIters) —
+        the checkpoint/resume execution of the same walk, bit-identical
+        to the monolithic kernels (tests/test_beam_segmented.py pins it).
+        No refill here; the slot scheduler adds that on top."""
+        nq, D = queries.shape
+        out_d = np.zeros((nq, k_eff), np.float32)
+        out_i = np.zeros((nq, k_eff), np.int32)
+        for start in range(0, nq, chunk):
+            q = queries[start:start + chunk]
+            nqc = q.shape[0]
+            q_pad = query_bucket(nqc, chunk)
+            if q_pad != nqc:
+                q = np.concatenate([q, np.zeros((q_pad - nqc, D), q.dtype)])
+            s = None
+            if seeds is not None:
+                s = seeds[start:start + nqc].astype(np.int32, copy=False)
+                if q_pad != nqc:
+                    s = np.concatenate(
+                        [s, np.full((q_pad - nqc, s.shape[1]), -1,
+                                    np.int32)])
+                s = jnp.asarray(s)
+            state = self.seed_state(jnp.asarray(q), L, seeds=s)
+            # pad rows get t_limit 0: never alive, bit-frozen no-ops
+            t_limit = np.zeros((q_pad,), np.int32)
+            t_limit[:nqc] = T
+            t_limit = jnp.asarray(t_limit)
+            while True:
+                state, alive = self.run_segment(state, t_limit, k_eff, L,
+                                                B, limit, S, inject=inject)
+                if not bool(np.asarray(jnp.any(alive))):
+                    break
+            d, ids = self.finalize(state, k_eff)
+            out_d[start:start + nqc] = d[:nqc]
+            out_i[start:start + nqc] = ids[:nqc]
+        return out_d, out_i
+
     def search(self, queries: np.ndarray, k: int, max_check: int = 2048,
                beam_width: int = 16, pool_size: Optional[int] = None,
                nbp_limit: int = 3, seeds: Optional[np.ndarray] = None,
-               dynamic_pivots: int = 4
+               dynamic_pivots: int = 4,
+               segment_iters: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched search; returns ((Q, k) dists, (Q, k) int32 ids),
         ascending, -1 / MAX_DIST padded.
@@ -583,36 +846,40 @@ class GraphSearchEngine:
         with per-query seed ids (KDT tree-descent seeding), -1 padded.
         `dynamic_pivots` = spare pivots injected per mid-walk re-seed
         (reference NumberOfOtherDynamicPivots); 0 disables re-seeding.
+        `segment_iters` > 0 runs the walk as fixed-size compiled segments
+        of that many iterations (state checkpointed between segments)
+        instead of one monolithic while-loop — same results bit for bit.
         """
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
         nq = queries.shape[0]
-        k_eff = min(k, self.n)
-        L = beam_pool_size(k_eff, max_check, self.n, pool_size)
-        B = beam_width_for(beam_width, max_check, L)
-        T = max(1, -(-max_check // B))
-        # continuous no-better-propagation limit: maxCheck/64 pops in the
-        # reference (WorkSpace.h:191), aggregated B pops per iteration here
-        limit = max(nbp_limit, (max_check // 64) // B, 1)
-
-        # packed bitset: 4 bytes per 32 ids -> N/8 bytes per query
-        chunk = max(1, min(_VISITED_BUDGET // max(self.n // 8, 1), 1024))
+        k_eff, L, B, T, limit = self.walk_plan(k, max_check, beam_width,
+                                               pool_size, nbp_limit)
+        chunk = self.chunk_size()
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
         D = queries.shape[1]
+        if segment_iters:
+            d, ids = self._search_segmented(
+                queries, seeds, k_eff, L, B, T, limit, dynamic_pivots,
+                chunk, int(segment_iters))
+            out_d[:, :k_eff] = d
+            out_i[:, :k_eff] = ids
+            return out_d, out_i
         if nq <= chunk:
             q_pad = query_bucket(nq, chunk)
             q = queries
             if q_pad != nq:
                 q = np.concatenate(
                     [q, np.zeros((q_pad - nq, D), q.dtype)])
+            t_limit = jnp.full((q_pad,), T, jnp.int32)
             if seeds is None:
                 d, ids = _beam_search_kernel(
                     self.data, self.sqnorm, self.graph, self.deleted,
                     self.pivot_ids, self.pivot_vecs, self.pivot_mask,
-                    jnp.asarray(q),
-                    k_eff, L, B, T, int(self.metric), self.base, limit,
+                    jnp.asarray(q), t_limit,
+                    k_eff, L, B, int(self.metric), self.base, limit,
                     inject=dynamic_pivots, data_score=self.data_score,
                     nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
             else:
@@ -623,8 +890,8 @@ class GraphSearchEngine:
                                     np.int32)])
                 d, ids = _beam_search_seeded_kernel(
                     self.data, self.sqnorm, self.graph, self.deleted,
-                    jnp.asarray(s), jnp.asarray(q),
-                    k_eff, L, B, T, int(self.metric), self.base, limit,
+                    jnp.asarray(s), jnp.asarray(q), t_limit,
+                    k_eff, L, B, int(self.metric), self.base, limit,
                     data_score=self.data_score,
                     nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
             out_d[:, :k_eff] = np.asarray(d)[:nq]
@@ -638,12 +905,13 @@ class GraphSearchEngine:
         if m * chunk != nq:
             q = np.concatenate(
                 [q, np.zeros((m * chunk - nq, D), q.dtype)])
+        t_limit = jnp.full((chunk,), T, jnp.int32)
         if seeds is None:
             d, ids = _beam_search_chunked(
                 self.data, self.sqnorm, self.graph, self.deleted,
                 self.pivot_ids, self.pivot_vecs, self.pivot_mask,
-                jnp.asarray(q.reshape(m, chunk, D)),
-                k_eff, L, B, T, int(self.metric), self.base, limit,
+                jnp.asarray(q.reshape(m, chunk, D)), t_limit,
+                k_eff, L, B, int(self.metric), self.base, limit,
                 inject=dynamic_pivots, data_score=self.data_score,
                 nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
         else:
@@ -655,8 +923,8 @@ class GraphSearchEngine:
             d, ids = _beam_search_seeded_chunked(
                 self.data, self.sqnorm, self.graph, self.deleted,
                 jnp.asarray(s.reshape(m, chunk, -1)),
-                jnp.asarray(q.reshape(m, chunk, D)),
-                k_eff, L, B, T, int(self.metric), self.base, limit,
+                jnp.asarray(q.reshape(m, chunk, D)), t_limit,
+                k_eff, L, B, int(self.metric), self.base, limit,
                 data_score=self.data_score,
                 nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
         d = np.asarray(d).reshape(m * chunk, -1)

@@ -88,14 +88,35 @@ class KDTIndex(BKTIndex):
         return partition_from_kdtree(self._tree, self._n,
                                      self.params.dense_cluster_size)
 
+    def _scheduler_submit(self, queries: np.ndarray, k: int,
+                          max_check: int) -> list:
+        # per-query kd-tree descent seeds ride along with each submit; the
+        # scheduler pools KDT queries by their seed width (one collect per
+        # (budget, forest) configuration — _backtrack_for)
+        p = self.params
+        seeds = self._seeds_for(queries, max_check)
+        sched = self._get_scheduler()
+        return [sched.submit(queries[i], k, max_check,
+                             beam_width=getattr(p, "beam_width", 16),
+                             nbp_limit=p.no_better_propagation_limit,
+                             seeds=seeds[i])
+                for i in range(queries.shape[0])]
+
     def _engine_search(self, queries: np.ndarray, k: int, max_check: int
                        ) -> Tuple[np.ndarray, np.ndarray]:
         p = self.params
+        if int(getattr(p, "continuous_batching", 0)):
+            from sptag_tpu.algo.scheduler import gather_futures
+
+            return gather_futures(
+                self._scheduler_submit(queries, k, max_check), k)
         seeds = self._seeds_for(queries, max_check)
+        seg = int(getattr(p, "beam_segment_iters", 0))
         return self._get_engine().search(
             queries, k, max_check=max_check,
             beam_width=getattr(p, "beam_width", 16),
-            nbp_limit=p.no_better_propagation_limit, seeds=seeds)
+            nbp_limit=p.no_better_propagation_limit, seeds=seeds,
+            segment_iters=seg or None)
 
     def _load_tree(self, path: str) -> KDTree:
         p = self.params

@@ -22,6 +22,7 @@ import errno
 import os
 import shutil
 import threading
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple, Type, Union
 
@@ -293,6 +294,39 @@ class VectorIndex(abc.ABC):
                 f"query dim {queries.shape[1]} != index dim {self.feature_dim}")
         queries = self._prepare_query(queries)
         return self._search_batch(queries, k, max_check, search_mode)
+
+    def submit_batch(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None,
+                     search_mode: Optional[str] = None) -> List["Future"]:
+        """Per-query futures over a (Q, D) block — the streaming-capable
+        serve surface (serve/service.py execute_batch's on_ready path).
+        Each future resolves to `(dists (k,), ids (k,))` with search_batch's
+        padding contract.
+
+        The base implementation executes the whole batch synchronously and
+        returns already-resolved futures, so every index is submittable;
+        graph indexes with ContinuousBatching=1 override it to resolve
+        futures AS QUERIES RETIRE from the slot scheduler
+        (algo/scheduler.py) — that is what lets a server stream responses
+        at per-query rather than whole-batch granularity."""
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        futs: List[Future] = []
+        try:
+            dists, ids = self.search_batch(queries, k, max_check=max_check,
+                                           search_mode=search_mode)
+        except Exception as e:                           # noqa: BLE001
+            for _ in range(queries.shape[0]):
+                f: Future = Future()
+                f.set_exception(e)
+                futs.append(f)
+            return futs
+        for row in range(ids.shape[0]):
+            f = Future()
+            f.set_result((dists[row], ids[row]))
+            futs.append(f)
+        return futs
 
     def _prepare_query(self, queries: np.ndarray) -> np.ndarray:
         """Queries are normalized for cosine, like the reference harness does

@@ -145,6 +145,23 @@ _COMMON_TAIL_SPECS = [
     # final pool is re-ranked in exact f32), "f32" elsewhere.  Explicit
     # "bf16"/"f32" forces either.
     _spec("beam_score_dtype", str, "auto", "BeamScoreDtype"),
+    # TPU-only: run the beam walk as fixed-size compiled SEGMENTS of this
+    # many iterations with the loop-carried state checkpointed between
+    # them (algo/engine.py), instead of one monolithic while-loop.
+    # Results are bit-identical either way; segmenting is what lets the
+    # slot scheduler retire converged queries early.  0 = monolithic for
+    # direct searches; the scheduler then picks ~T/4 per pool itself.
+    _spec("beam_segment_iters", int, 0, "BeamSegmentIters"),
+    # TPU-only, opt-in: route beam searches through the continuous-
+    # batching slot scheduler (algo/scheduler.py) — converged queries
+    # retire between segments and freed slots refill from a pending
+    # queue, so device time tracks the MEAN per-query iteration count
+    # instead of the max (a MaxCheck straggler no longer convoys the
+    # batch) and the serve tier streams per-query results as they finish
+    _spec("continuous_batching", int, 0, "ContinuousBatching"),
+    # TPU-only: slot capacity per scheduler pool (clamped to the engine's
+    # visited-bitset chunk budget); quantized to the QUERY_BUCKETS ladder
+    _spec("beam_slots", int, 1024, "BeamSlots"),
 ]
 
 _FILE_SPECS = [

@@ -182,9 +182,11 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                      pmask_s, q_s):
         n_local = data_s.shape[0]
         shard = jax.lax.axis_index(SHARD_AXIS)
+        t_limit = jnp.full((q_s.shape[0],), T, jnp.int32)
         d, ids = _beam_search_kernel(
             data_s, sqnorm_s, graph_s, deleted_s, pids_s[0], pvecs_s[0],
-            pmask_s[0], q_s, k_local, L, B, T, metric, base, nbp_limit)
+            pmask_s[0], q_s, t_limit, k_local, L, B, metric, base,
+            nbp_limit)
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 

@@ -43,7 +43,8 @@ class SearchServer:
                  max_connections: int = 256,
                  drain_timeout_s: float = 15.0,
                  metrics_port: Optional[int] = None,
-                 slow_query_threshold_ms: Optional[float] = None):
+                 slow_query_threshold_ms: Optional[float] = None,
+                 max_response_tasks: int = 8):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -74,6 +75,20 @@ class SearchServer:
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=8 * max_batch)
         self._server: Optional[asyncio.AbstractServer] = None
         self._batcher_task: Optional[asyncio.Task] = None
+        # response handoff (ISSUE 4 satellite): encoding + draining a
+        # batch's responses runs in a SEPARATE task so the batcher
+        # assembles and executes batch N+1 while batch N's responses
+        # drain.  The semaphore bounds in-flight response batches — a
+        # slow drain backpressures the batcher instead of queueing
+        # unbounded encoded responses.
+        self._response_sem = asyncio.Semaphore(max(1, max_response_tasks))
+        self._response_tasks: set = set()
+        # per-query streamed sends are bounded too: past this many live
+        # response tasks a query's response falls back to the batch-tail
+        # task (which rides the semaphore) instead of spawning — without
+        # it a slow-reading client accumulates one task + encoded body
+        # per streamed query across every batch in its drain window
+        self._max_stream_tasks = max_batch
 
     # ------------------------------------------------------------- lifecycle
 
@@ -105,6 +120,8 @@ class SearchServer:
             self._metrics_http = None
         if self._batcher_task:
             self._batcher_task.cancel()
+        for task in list(self._response_tasks):
+            task.cancel()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -292,10 +309,24 @@ class SearchServer:
             texts.append(query.query if query is not None else "")
             trace.record("server.queue_wait", t_assembled - t_enq)
         loop = asyncio.get_event_loop()
+        # per-query streaming (continuous batching): the executor invokes
+        # on_ready from ITS thread as individual queries finish; each
+        # marshals onto the loop and sends immediately — a fast query's
+        # response leaves while stragglers are still walking, instead of
+        # at whole-batch granularity.  Every on_ready lands on the loop
+        # BEFORE run_in_executor's completion wakes this coroutine
+        # (call_soon_threadsafe is FIFO), so `streamed` is complete when
+        # the batch tail below reads it.
+        streamed: set = set()
+
+        def on_ready(i, result):
+            loop.call_soon_threadsafe(self._stream_response, batch[i],
+                                      result, t_assembled, streamed, i)
         try:
             def run_batch():
                 with trace.span("server.execute_batch"):
-                    return self.executor.execute_batch(texts)
+                    return self.executor.execute_batch(texts,
+                                                       on_ready=on_ready)
             results = await loop.run_in_executor(None, run_batch)
         except Exception:
             metrics.inc("server.batch_failures")
@@ -303,41 +334,93 @@ class SearchServer:
             results = [wire.RemoteSearchResult(
                 wire.ResultStatus.FailedExecute, [])] * len(batch)
         t_executed = time.perf_counter()
-        for (cid, header, query, t_enq), result in zip(batch, results):
-            if query is None:
-                result = wire.RemoteSearchResult(
-                    wire.ResultStatus.FailedExecute, [])
-            # echo the request id so the caller (client or aggregator) can
-            # match the response to its trace
-            rid = query.request_id if query is not None else ""
-            result.request_id = rid
-            with trace.span("server.encode"):
-                body = result.pack()
-            resp = wire.PacketHeader(
-                wire.PacketType.SearchResponse,
-                wire.PacketProcessStatus.Ok, len(body), cid,
-                header.resource_id)
-            t_send0 = time.perf_counter()
-            with trace.span("server.drain"):
-                await self._send(cid, resp.pack() + body)
-            metrics.inc("server.responses")
-            now = time.perf_counter()
-            total = now - t_enq
-            trace.record("server.request", total)
-            thresh = self.slow_query_threshold_ms
-            if thresh > 0 and total * 1000.0 >= thresh:
-                token = metrics.set_request_id(rid)
-                try:
-                    log.warning(
-                        "slow query rid=%s total=%.2fms queue=%.2fms "
-                        "execute=%.2fms send=%.2fms results=%d",
-                        rid or "-", total * 1000.0,
-                        (t_assembled - t_enq) * 1000.0,
-                        (t_executed - t_assembled) * 1000.0,
-                        (now - t_send0) * 1000.0,
-                        sum(len(r.ids) for r in result.results))
-                finally:
-                    metrics.reset_request_id(token)
+        # response handoff (bounded, counted): the batcher returns to
+        # assembling batch N+1 while this batch's responses encode+drain
+        # in their own task
+        await self._spawn_response_task(
+            self._respond_batch(batch, results, streamed, t_assembled,
+                                t_executed))
+
+    def _stream_response(self, entry, result, t_assembled: float,
+                         streamed: set, i: int) -> None:
+        """Loop-thread half of the streaming path: mark the query as
+        delivered and send its response in its own (tracked) task.
+        NOT marking it (over the task cap) is always safe — the batch
+        tail sends whatever was not streamed."""
+        if len(self._response_tasks) >= self._max_stream_tasks:
+            metrics.inc("server.stream_overflows")
+            return
+        streamed.add(i)
+        metrics.inc("server.streamed_responses")
+        task = asyncio.ensure_future(
+            self._respond_one(entry, result, t_assembled,
+                              time.perf_counter()))
+        self._track_response_task(task)
+
+    async def _spawn_response_task(self, coro) -> None:
+        await self._response_sem.acquire()
+        task = asyncio.ensure_future(coro)
+        task.add_done_callback(lambda _t: self._response_sem.release())
+        self._track_response_task(task)
+
+    def _track_response_task(self, task: asyncio.Task) -> None:
+        self._response_tasks.add(task)
+        metrics.set_gauge("server.response_tasks",
+                          len(self._response_tasks))
+
+        def _done(t: asyncio.Task) -> None:
+            self._response_tasks.discard(t)
+            metrics.set_gauge("server.response_tasks",
+                              len(self._response_tasks))
+            if not t.cancelled() and t.exception() is not None:
+                metrics.inc("server.response_task_errors")
+                log.error("response task failed: %r", t.exception())
+        task.add_done_callback(_done)
+
+    async def _respond_batch(self, batch, results, streamed: set,
+                             t_assembled: float, t_executed: float) -> None:
+        for i, (entry, result) in enumerate(zip(batch, results)):
+            if i in streamed:
+                continue           # already sent by the streaming path
+            await self._respond_one(entry, result, t_assembled, t_executed)
+
+    async def _respond_one(self, entry, result, t_assembled: float,
+                           t_executed: float) -> None:
+        cid, header, query, t_enq = entry
+        if query is None or result is None:
+            result = wire.RemoteSearchResult(
+                wire.ResultStatus.FailedExecute, [])
+        # echo the request id so the caller (client or aggregator) can
+        # match the response to its trace
+        rid = query.request_id if query is not None else ""
+        result.request_id = rid
+        with trace.span("server.encode"):
+            body = result.pack()
+        resp = wire.PacketHeader(
+            wire.PacketType.SearchResponse,
+            wire.PacketProcessStatus.Ok, len(body), cid,
+            header.resource_id)
+        t_send0 = time.perf_counter()
+        with trace.span("server.drain"):
+            await self._send(cid, resp.pack() + body)
+        metrics.inc("server.responses")
+        now = time.perf_counter()
+        total = now - t_enq
+        trace.record("server.request", total)
+        thresh = self.slow_query_threshold_ms
+        if thresh > 0 and total * 1000.0 >= thresh:
+            token = metrics.set_request_id(rid)
+            try:
+                log.warning(
+                    "slow query rid=%s total=%.2fms queue=%.2fms "
+                    "execute=%.2fms send=%.2fms results=%d",
+                    rid or "-", total * 1000.0,
+                    (t_assembled - t_enq) * 1000.0,
+                    (t_executed - t_assembled) * 1000.0,
+                    (now - t_send0) * 1000.0,
+                    sum(len(r.ids) for r in result.results))
+            finally:
+                metrics.reset_request_id(token)
 
 
 def run_interactive(context: ServiceContext) -> None:

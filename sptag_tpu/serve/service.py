@@ -520,10 +520,80 @@ class SearchExecutor:
                 res.metas if parsed.extract_metadata else None))
         return out
 
-    def execute_batch(self, query_texts: List[str]
+    def _run_group_streaming(self, parsed, results, name: str, k: int,
+                             with_meta: bool, max_check, search_mode,
+                             idxs: List[int], on_ready) -> None:
+        """Single-index group via per-query futures (VectorIndex
+        .submit_batch): each query's result is built and handed to
+        `on_ready(i, result)` AS ITS FUTURE RESOLVES — with a continuous-
+        batching index that is per-query retire order from the slot
+        scheduler, so the caller streams responses while stragglers are
+        still walking.  Indexes without a scheduler resolve everything at
+        once (base submit_batch) and on_ready degrades to batch
+        granularity.  `on_ready` runs on THIS thread; failures are not
+        streamed (they ride the returned results list)."""
+        import concurrent.futures as cf
+
+        index = self.context.indexes[name]
+        vecs = []
+        ok: List[int] = []
+        for i in idxs:
+            v = parsed[i].extract_vector(
+                parsed[i].data_type or index.value_type,
+                self.context.settings.vector_separator)
+            if v is None or v.shape[-1] != index.feature_dim:
+                results[i] = RemoteSearchResult(
+                    ResultStatus.FailedExecute, [])
+            else:
+                vecs.append(v)
+                ok.append(i)
+        if not ok:
+            return
+        try:
+            futs = index.submit_batch(
+                np.stack(vecs), k, max_check=max_check,
+                search_mode=self._sanitize_search_mode(parsed[ok[0]],
+                                                       index))
+        except Exception:                                # noqa: BLE001
+            metrics.inc("service.search_errors")
+            log.exception("streamed batch submit failed on index %s", name)
+            for i in ok:
+                results[i] = RemoteSearchResult(
+                    ResultStatus.FailedExecute, [])
+            return
+        by_fut = {f: i for f, i in zip(futs, ok)}
+        for f in cf.as_completed(futs):
+            i = by_fut[f]
+            e = f.exception()
+            if e is not None:
+                metrics.inc("service.search_errors")
+                log.error("streamed search failed on index %s: %r",
+                          name, e)
+                results[i] = RemoteSearchResult(
+                    ResultStatus.FailedExecute, [])
+                continue
+            dists, ids = f.result()
+            metas = (metas_for(index.metadata, ids) if with_meta else None)
+            r = RemoteSearchResult(ResultStatus.Success, [IndexSearchResult(
+                name, [int(v) for v in ids], [float(d) for d in dists],
+                metas)])
+            results[i] = r
+            metrics.inc("service.streamed_results")
+            try:
+                on_ready(i, r)
+            except Exception:                            # noqa: BLE001
+                log.exception("on_ready callback failed")
+
+    def execute_batch(self, query_texts: List[str], on_ready=None
                       ) -> List[RemoteSearchResult]:
         """Coalesced execution: groups parsed queries by (index set, k,
-        meta) and runs each group's vectors as ONE device batch."""
+        meta) and runs each group's vectors as ONE device batch.
+
+        `on_ready(i, result)`: optional streaming callback, invoked on the
+        EXECUTING thread as individual queries finish (single-index groups
+        only — multi-index fan-outs keep batch granularity).  Every result
+        is still present in the returned list; the caller tracks which
+        indices it already consumed via the callback."""
         parsed = [parse_query(t) for t in query_texts]
         results: List[Optional[RemoteSearchResult]] = [None] * len(parsed)
         groups: Dict[tuple, List[int]] = {}
@@ -543,6 +613,16 @@ class SearchExecutor:
                 for i in idxs:
                     results[i] = RemoteSearchResult(
                         ResultStatus.FailedExecute, [])
+                continue
+            if (on_ready is not None and len(sel) == 1
+                    and hasattr(self.context.indexes[sel[0]],
+                                "submit_batch")):
+                # duck-typed serving surfaces (parallel/sharded.py's
+                # ServingAdapter) expose only search/search_batch — they
+                # keep the classic whole-batch path below
+                self._run_group_streaming(parsed, results, sel[0], k,
+                                          with_meta, max_check,
+                                          search_mode, idxs, on_ready)
                 continue
             for name in sel:
                 index = self.context.indexes[name]

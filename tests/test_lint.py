@@ -180,6 +180,28 @@ def test_gl201_all_scalars_static_clean():
     assert lint_one(src, select=["GL201"]) == []
 
 
+def test_gl201_segment_kernel_budget_discipline():
+    """ISSUE 4: the segmented walk's compile-key contract — iteration
+    BUDGETS ride as traced arrays (t_limit) while only shape-defining
+    ints (L, B, S) are static, so mixed-MaxCheck slot pools share one
+    compiled program.  A budget demoted to a plain scalar param is
+    exactly the recompile-per-value hazard GL201 exists for; this pins
+    both directions so the kernel shape buckets stay retrace-clean."""
+    clean = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit,"
+        " static_argnames=('L', 'B', 'S'))\n"
+        "def segment(state, t_limit, L: int, B: int, S: int):\n"
+        "    return state\n"
+    )
+    assert lint_one(clean, select=["GL201"]) == []
+    hazard = clean.replace("('L', 'B', 'S')", "('L', 'B')")
+    found = lint_one(hazard, select=["GL201"])
+    assert rules_of(found) == ["GL201"]
+    assert "S" in found[0].message
+
+
 def test_gl202_fstring_in_jitted_body_flagged():
     src = (
         "import jax\n"

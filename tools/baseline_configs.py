@@ -227,7 +227,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
     else:
-        platform, err, _ = probe_accelerator(budget_s=600)
+        platform, err, _, _cached = probe_accelerator(budget_s=600)
         if platform is None:
             import jax
             jax.config.update("jax_platforms", "cpu")

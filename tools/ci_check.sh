@@ -18,6 +18,14 @@ if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
+# the ISSUE 4 correctness gate, standalone and first: the segmented walk
+# (and the scheduler built on it) must return bit-identical results to
+# the monolithic walk — if this fails, nothing else about the beam
+# numbers means anything
+echo "== beam segmented-vs-monolithic parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_beam_segmented.py -q \
+    -p no:cacheprovider -k "parity or segment_param"
+
 echo "== tier-1 pytest (CPU backend) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
