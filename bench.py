@@ -885,6 +885,13 @@ def run_bench():
         # the recompile guard's xla.backend_compile spans) so the perf
         # trajectory records the distribution, not just stage totals.
         result["trace"] = trace.report()
+        # flight-recorder accounting (ISSUE 5): enabled flag + recorded/
+        # dropped event counts, so a bench run that turned the ring on
+        # (Index.FlightRecorder passthrough) records whether the ring
+        # overflowed — an overflowed ring means the dump is a suffix of
+        # the run, not the whole story
+        from sptag_tpu.utils import flightrec
+        result["flight"] = flightrec.counters()
     except Exception as e:                               # noqa: BLE001
         import traceback
         result["error"] = repr(e)[:300]

@@ -172,7 +172,19 @@ class BKTIndex(VectorIndex):
     # parameters whose value is BAKED into a materialized engine snapshot:
     # changing one must invalidate the engine or the setting is a silent
     # no-op until the next unrelated mutation
-    _ENGINE_PARAMS = frozenset({"beampackedneighbors", "beamscoredtype"})
+    _ENGINE_PARAMS = frozenset({"beampackedneighbors", "beamscoredtype",
+                                # the sample rate is baked into the
+                                # engine at _make_engine time: without
+                                # invalidation a set_parameter on a warm
+                                # index would be a silent no-op
+                                "flightdevicesamplerate"})
+    # process-wide recorder knobs: applied DIRECTLY to flightrec at
+    # set_parameter time (each maps to its own configure field, so
+    # setting one never clobbers the others) — they are not baked into
+    # the engine snapshot, and invalidating the engine for a dump-dir
+    # string would force XLA recompiles for nothing
+    _FLIGHT_PARAMS = frozenset({"flightrecorder", "flightrecorderevents",
+                                "flightdumponslowquery"})
     # baked into the materialized DENSE snapshot (replication layout and
     # cluster partition); DenseQueryGroup/DenseUnionFactor are read live
     # at each search and need no invalidation
@@ -180,15 +192,42 @@ class BKTIndex(VectorIndex):
 
     def set_parameter(self, name: str, value: str) -> bool:
         ok = super().set_parameter(name, value)
-        if ok and name.lower() in self._ENGINE_PARAMS:
+        low = name.lower()
+        if ok and low in self._ENGINE_PARAMS:
             with self._lock:
                 self._engine = None
-        if ok and name.lower() in self._DENSE_PARAMS:
+        if ok and low in self._DENSE_PARAMS:
             with self._lock:
                 self._dense = None
+        if ok and low in self._FLIGHT_PARAMS:
+            from sptag_tpu.utils import flightrec
+
+            p = self.params
+            flightrec.configure(
+                enabled=(bool(int(getattr(p, "flight_recorder", 0)))
+                         if low == "flightrecorder" else None),
+                max_events=(int(getattr(p, "flight_recorder_events", 0))
+                            or None
+                            if low == "flightrecorderevents" else None),
+                dump_dir=(getattr(p, "flight_dump_on_slow_query", "")
+                          if low == "flightdumponslowquery" else None))
         return ok
 
     def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:
+        p = self.params
+        if int(getattr(p, "flight_recorder", 0)):
+            # index-level FlightRecorder=1 is the OFFLINE-run surface
+            # (builder/searcher/bench CLIs with Index.Param passthrough):
+            # enable the process ring when the engine materializes, so a
+            # run with no [Service] config still records
+            from sptag_tpu.utils import flightrec
+
+            flightrec.configure(
+                enabled=True,
+                max_events=int(getattr(p, "flight_recorder_events", 0))
+                or None,
+                dump_dir=getattr(p, "flight_dump_on_slow_query", "")
+                or None)
         return GraphSearchEngine(self._host[:self._n], graph,
                                  self._pivot_ids(), self._deleted[:self._n],
                                  self.dist_calc_method, self.base,
@@ -196,7 +235,10 @@ class BKTIndex(VectorIndex):
                                      self.params, "beam_score_dtype", "auto"),
                                  packed_neighbors=bool(int(getattr(
                                      self.params, "beam_packed_neighbors",
-                                     0))))
+                                     0))),
+                                 device_sample_rate=float(getattr(
+                                     self.params,
+                                     "flight_device_sample_rate", 0.0)))
 
     def _get_engine(self) -> GraphSearchEngine:
         if self._dirty or self._engine is None:
@@ -545,15 +587,18 @@ class BKTIndex(VectorIndex):
         return sched
 
     def _scheduler_submit(self, queries: np.ndarray, k: int,
-                          max_check: int) -> list:
+                          max_check: int,
+                          rids: Optional[list] = None) -> list:
         """Submit prepared queries to the slot scheduler; KDT overrides to
-        attach its per-query kd-tree seeds."""
+        attach its per-query kd-tree seeds.  `rids` (one per query) tag
+        the scheduler's flight-recorder events and per-rid stats."""
         p = self.params
         sched = self._get_scheduler()
         return [sched.submit(queries[i], k, max_check,
                              beam_width=getattr(p, "beam_width", 16),
                              nbp_limit=p.no_better_propagation_limit,
-                             dynamic_pivots=p.other_dynamic_pivots)
+                             dynamic_pivots=p.other_dynamic_pivots,
+                             rid=rids[i] if rids else "")
                 for i in range(queries.shape[0])]
 
     def _engine_search(self, queries: np.ndarray, k: int, max_check: int
@@ -579,11 +624,13 @@ class BKTIndex(VectorIndex):
 
     def submit_batch(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
-                     search_mode: Optional[str] = None) -> list:
+                     search_mode: Optional[str] = None,
+                     rids: Optional[list] = None) -> list:
         """Streaming submit (core/index.py contract): with
         ContinuousBatching=1 and a beam-resolved mode, futures resolve AS
         QUERIES RETIRE from the slot scheduler; otherwise falls back to
-        the synchronous base implementation."""
+        the synchronous base implementation.  `rids` (one per query)
+        flow into the scheduler for flight-recorder attribution."""
         p = self.params
         mc = max_check if max_check is not None else p.max_check
         mode = search_mode or getattr(p, "search_mode", "beam")
@@ -606,7 +653,8 @@ class BKTIndex(VectorIndex):
         from sptag_tpu.algo.scheduler import pad_result_row
 
         out = []
-        for inner in self._scheduler_submit(queries, min(k, self._n), mc):
+        for inner in self._scheduler_submit(queries, min(k, self._n), mc,
+                                            rids=rids):
             outer: Future = Future()
 
             def _pad(f, outer=outer):

@@ -58,6 +58,7 @@ together, and each run's last element scatter-writes `existing | run_or`.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -66,7 +67,7 @@ import numpy as np
 
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
-from sptag_tpu.utils import query_bucket
+from sptag_tpu.utils import flightrec, metrics, query_bucket
 
 MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
@@ -654,7 +655,8 @@ class GraphSearchEngine:
                  pivot_ids: np.ndarray, deleted: Optional[np.ndarray],
                  metric: DistCalcMethod, base: int,
                  score_dtype: str = "auto",
-                 packed_neighbors: bool = False):
+                 packed_neighbors: bool = False,
+                 device_sample_rate: float = 0.0):
         n = data.shape[0]
         assert graph.shape[0] == n, (graph.shape, n)
         self.n = n
@@ -705,6 +707,14 @@ class GraphSearchEngine:
             g = jnp.maximum(self.graph, 0)
             self.nbr_vecs = src[g]
             self.nbr_sq = self.sqnorm[g]
+        # device-time attribution (FlightDeviceSampleRate): every Nth
+        # segment dispatch is timed to completion (block_until_ready) and
+        # fed to the flight recorder + the engine.segment_device_ns
+        # histogram, separating device time from host overhead.  The
+        # sample gate is a deterministic counter (no RNG on the hot path,
+        # reproducible traces); 0 disables.
+        self.device_sample_rate = max(0.0, float(device_sample_rate))
+        self._seg_dispatches = 0
 
     def set_deleted(self, deleted: np.ndarray) -> None:
         """Swap only the tombstone mask — mutation path for delete-only
@@ -766,6 +776,13 @@ class GraphSearchEngine:
         returns (new state, (Q,) alive).  Rows with alive=False are done
         (absorbing) — their pool is final and `finalize` may retire them."""
         spare_ids = state["spare_ids"]
+        sample = False
+        if self.device_sample_rate > 0:
+            self._seg_dispatches += 1
+            every = (1 if self.device_sample_rate >= 1.0
+                     else max(1, int(round(1.0 / self.device_sample_rate))))
+            sample = (self._seg_dispatches % every) == 0
+        t0 = time.monotonic_ns() if sample else 0
         out = _beam_segment_kernel(
             self.data, self.sqnorm, self.graph, state["queries"], t_limit,
             state["cand_ids"], state["cand_d"], state["expanded"],
@@ -775,6 +792,18 @@ class GraphSearchEngine:
             spare_ids=spare_ids, spare_d=state["spare_d"],
             data_score=self.data_score, nbr_vecs=self.nbr_vecs,
             nbr_sq=self.nbr_sq)
+        if sample:
+            # dispatch-to-completion wall time: the kernel call returns as
+            # soon as XLA enqueues, so only a sampled block_until_ready
+            # observes the DEVICE time of a segment.  Values are
+            # nanoseconds (the _ns suffix contract; consume mean via
+            # _sum/_count — the log buckets are second-scaled).
+            jax.block_until_ready(out)
+            dev_ns = time.monotonic_ns() - t0
+            metrics.observe("engine.segment_device_ns", dev_ns)
+            flightrec.record("engine", "segment_device", dur_ns=dev_ns,
+                             payload={"rows": int(state["queries"].shape[0]),
+                                      "iters": S})
         new = dict(state)
         (new["cand_ids"], new["cand_d"], new["expanded"], new["visited"],
          new["no_better"], new["ptr"], new["it"], alive) = out

@@ -89,7 +89,8 @@ class KDTIndex(BKTIndex):
                                      self.params.dense_cluster_size)
 
     def _scheduler_submit(self, queries: np.ndarray, k: int,
-                          max_check: int) -> list:
+                          max_check: int,
+                          rids: Optional[list] = None) -> list:
         # per-query kd-tree descent seeds ride along with each submit; the
         # scheduler pools KDT queries by their seed width (one collect per
         # (budget, forest) configuration — _backtrack_for)
@@ -99,7 +100,8 @@ class KDTIndex(BKTIndex):
         return [sched.submit(queries[i], k, max_check,
                              beam_width=getattr(p, "beam_width", 16),
                              nbp_limit=p.no_better_propagation_limit,
-                             seeds=seeds[i])
+                             seeds=seeds[i],
+                             rid=rids[i] if rids else "")
                 for i in range(queries.shape[0])]
 
     def _engine_search(self, queries: np.ndarray, k: int, max_check: int

@@ -52,7 +52,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from sptag_tpu.utils import locksan, metrics, query_bucket
+from sptag_tpu.utils import flightrec, locksan, metrics, query_bucket
 
 log = logging.getLogger(__name__)
 
@@ -93,14 +93,24 @@ def gather_futures(futs, k: int) -> Tuple[np.ndarray, np.ndarray]:
 
 
 class _Item:
-    __slots__ = ("query", "seeds", "t_limit", "future", "t_enq")
+    __slots__ = ("query", "seeds", "t_limit", "future", "t_enq", "rid",
+                 "slot_wait", "segments", "refills")
 
-    def __init__(self, query, seeds, t_limit, future, t_enq):
+    def __init__(self, query, seeds, t_limit, future, t_enq, rid=""):
         self.query = query
         self.seeds = seeds
         self.t_limit = t_limit
         self.future = future
         self.t_enq = t_enq
+        # flight-recorder attribution (ISSUE 5): the request id this
+        # query rides under, plus the per-query lifecycle numbers the
+        # slow-query log and flight dump both report — time queued before
+        # a slot opened, device segments resident, refill batches that
+        # joined the pool while resident
+        self.rid = rid
+        self.slot_wait = 0.0
+        self.segments = 0
+        self.refills = 0
 
 
 class _SlotPool:
@@ -208,9 +218,12 @@ class BeamSlotScheduler:
     def submit(self, query: np.ndarray, k: int, max_check: int,
                beam_width: int = 16, pool_size: Optional[int] = None,
                nbp_limit: int = 3, dynamic_pivots: int = 4,
-               seeds: Optional[np.ndarray] = None) -> Future:
+               seeds: Optional[np.ndarray] = None,
+               rid: str = "") -> Future:
         """Queue one query; the future resolves to (dists, ids) — the
-        same values `engine.search` would return for it, bit for bit."""
+        same values `engine.search` would return for it, bit for bit.
+        `rid` tags the query's flight-recorder events and per-rid stats
+        (slot-wait / segments / refills) for the slow-query log."""
         k_eff, L, B, T, limit = self._engine.walk_plan(
             k, max_check, beam_width, pool_size, nbp_limit)
         seeds_row = None
@@ -224,7 +237,10 @@ class BeamSlotScheduler:
         key = (k_eff, L, B, limit, inject, seed_width)
         fut: Future = Future()
         item = _Item(np.asarray(query).reshape(-1), seeds_row,
-                     T, fut, time.perf_counter())
+                     T, fut, time.perf_counter(), rid=rid)
+        if flightrec.enabled():
+            flightrec.record("scheduler", "pending", rid,
+                             payload={"max_check": max_check})
         with self._cv:
             if (self._stopped or self._draining
                     or self._worker_error is not None):
@@ -239,7 +255,8 @@ class BeamSlotScheduler:
     def search_batch(self, queries: np.ndarray, k: int, max_check: int,
                      beam_width: int = 16, pool_size: Optional[int] = None,
                      nbp_limit: int = 3, dynamic_pivots: int = 4,
-                     seeds: Optional[np.ndarray] = None
+                     seeds: Optional[np.ndarray] = None,
+                     rids: Optional[List[str]] = None
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Submit a whole (Q, D) batch and wait; engine.search's output
         contract ((Q, k) dists/ids, MAX_DIST / -1 padded)."""
@@ -250,7 +267,8 @@ class BeamSlotScheduler:
                             beam_width=beam_width, pool_size=pool_size,
                             nbp_limit=nbp_limit,
                             dynamic_pivots=dynamic_pivots,
-                            seeds=None if seeds is None else seeds[i])
+                            seeds=None if seeds is None else seeds[i],
+                            rid=rids[i] if rids else "")
                 for i in range(queries.shape[0])]
         return gather_futures(futs, k)
 
@@ -371,8 +389,21 @@ class BeamSlotScheduler:
 
         engine = self._engine
         now = time.perf_counter()
+        rec = flightrec.enabled()
         # ---- resize (grow for intake / compact a drained pool) ----------
         target = pool.target_capacity(len(incoming))
+        residents = pool.live_count()
+        if incoming and residents:
+            # refill: a pool that already had live rows takes on a fresh
+            # intake batch — count it against every RESIDENT query
+            # (newcomers join after) for per-rid attribution
+            for e in pool.entries:
+                if e is not None:
+                    e.refills += 1
+            if rec:
+                flightrec.record("scheduler", "refill",
+                                 payload={"count": len(incoming),
+                                          "live": residents})
         if incoming and pool.capacity == 0:
             # first allocation needs dtype/width templates: seed one
             # bucket first, then allocate from it
@@ -381,23 +412,42 @@ class BeamSlotScheduler:
             self._insert(pool, incoming, seeded)
         else:
             if target != pool.capacity:
+                if rec and target < pool.capacity and residents:
+                    flightrec.record("scheduler", "compact",
+                                     payload={"from": pool.capacity,
+                                              "to": target})
                 pool._alloc(target, pool.state)
             if incoming:
                 seeded = self._seed_bucket(pool, incoming)
                 self._insert(pool, incoming, seeded)
         for item in incoming:
-            metrics.observe("scheduler.slot_wait", now - item.t_enq)
+            item.slot_wait = now - item.t_enq
+            metrics.observe("scheduler.slot_wait", item.slot_wait)
+            if rec:
+                flightrec.record("scheduler", "slot_assign", item.rid,
+                                 dur_ns=int(item.slot_wait * 1e9))
         metrics.set_gauge("scheduler.occupancy",
                           pool.live_count() / max(pool.capacity, 1))
         if not pool.live_count():
             return
         # ---- one segment on device --------------------------------------
+        t_seg0 = time.monotonic_ns() if rec else 0
         state = {name: (jnp.asarray(arr) if arr is not None else None)
                  for name, arr in pool.state.items()}
         new_state, alive = engine.run_segment(
             state, jnp.asarray(pool.t_limit), pool.k_eff, pool.L, pool.B,
             pool.nbp_limit, pool.seg_iters, inject=pool.inject)
         metrics.inc("scheduler.segments")
+        live_now = 0
+        for e in pool.entries:
+            if e is not None:
+                e.segments += 1
+                live_now += 1
+        if rec:
+            flightrec.record("scheduler", "segment",
+                             dur_ns=time.monotonic_ns() - t_seg0,
+                             payload={"live": live_now,
+                                      "capacity": pool.capacity})
         alive_np = np.asarray(alive)
         done = [i for i, e in enumerate(pool.entries)
                 if e is not None and not alive_np[i]]
@@ -417,14 +467,33 @@ class BeamSlotScheduler:
                    for name in ("queries", "cand_ids", "cand_d")}
             d, ids = engine.finalize(sub, pool.k_eff)
             t_done = time.perf_counter()
-            for j, i in enumerate(done):
-                item = pool.entries[i]
+            items = [pool.entries[i] for i in done]
+            for i in done:
                 pool.entries[i] = None
+            # publish EVERY observation for the retiring queries BEFORE
+            # resolving any future (ISSUE 5 satellite): a caller sampling
+            # metrics or flight stats at result time must find this
+            # query's numbers already recorded — previously the retired
+            # counter landed after the futures, so completion-triggered
+            # dumps undercounted the very query that triggered them
+            metrics.inc("scheduler.retired", len(done))
+            for item in items:
                 metrics.observe("scheduler.query_s", t_done - item.t_enq)
+                if rec:
+                    flightrec.record(
+                        "scheduler", "retire", item.rid,
+                        dur_ns=int((t_done - item.t_enq) * 1e9),
+                        payload={"segments": item.segments,
+                                 "refills": item.refills})
+                if item.rid:
+                    flightrec.note_query_stats(
+                        item.rid,
+                        slot_wait_ms=round(item.slot_wait * 1000.0, 3),
+                        segments=item.segments, refills=item.refills)
+            for j, item in enumerate(items):
                 if not item.future.done():
                     item.future.set_result((d[j].copy(), ids[j].copy()))
             self._blank(pool, done)
-            metrics.inc("scheduler.retired", len(done))
         metrics.set_gauge("scheduler.occupancy",
                           pool.live_count() / max(pool.capacity, 1))
 

@@ -297,11 +297,14 @@ class VectorIndex(abc.ABC):
 
     def submit_batch(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
-                     search_mode: Optional[str] = None) -> List["Future"]:
+                     search_mode: Optional[str] = None,
+                     rids: Optional[List[str]] = None) -> List["Future"]:
         """Per-query futures over a (Q, D) block — the streaming-capable
         serve surface (serve/service.py execute_batch's on_ready path).
         Each future resolves to `(dists (k,), ids (k,))` with search_batch's
-        padding contract.
+        padding contract.  `rids` (one request id per query, optional) is
+        attribution-only: scheduler-backed overrides tag their flight
+        events with it; the synchronous base path ignores it.
 
         The base implementation executes the whole batch synchronously and
         returns already-resolved futures, so every index is submittable;

@@ -162,6 +162,24 @@ _COMMON_TAIL_SPECS = [
     # TPU-only: slot capacity per scheduler pool (clamped to the engine's
     # visited-bitset chunk budget); quantized to the QUERY_BUCKETS ladder
     _spec("beam_slots", int, 1024, "BeamSlots"),
+    # flight recorder (utils/flightrec.py, ISSUE 5).  The recorder is
+    # PROCESS-wide; these index-level registrations are the offline-run
+    # surface (index_builder / index_searcher / bench pass them through
+    # like any Index.Param) and the INI-parity mirror of the [Service]
+    # settings the serve tiers read.  FlightRecorder=1 enables the ring
+    # when the index materializes its engine; FlightRecorderEvents sizes
+    # it (0 = module default); FlightDumpOnSlowQuery names the ringed
+    # auto-dump directory the serve tier writes on slow/error requests.
+    _spec("flight_recorder", int, 0, "FlightRecorder"),
+    _spec("flight_recorder_events", int, 0, "FlightRecorderEvents"),
+    # fraction of engine segment dispatches timed to completion
+    # (block_until_ready) for device-time attribution: events land in the
+    # flight ring and the engine.segment_device_ns histogram, separating
+    # device time from host overhead.  0 disables; 1 times every segment
+    # (sampling is a deterministic 1-in-round(1/rate) counter, so traces
+    # are reproducible).
+    _spec("flight_device_sample_rate", float, 0.0, "FlightDeviceSampleRate"),
+    _spec("flight_dump_on_slow_query", str, "", "FlightDumpOnSlowQuery"),
 ]
 
 _FILE_SPECS = [

@@ -38,7 +38,7 @@ from typing import List, Optional, Tuple
 
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
-from sptag_tpu.utils import metrics, trace
+from sptag_tpu.utils import flightrec, metrics, trace
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -176,7 +176,10 @@ class AggregatorContext:
                  metrics_port: int = 0,
                  metrics_host: str = "127.0.0.1",
                  slow_query_threshold_ms: float = 0.0,
-                 trace_requests: bool = True):
+                 trace_requests: bool = True,
+                 flight_recorder: bool = False,
+                 flight_recorder_events: int = 0,
+                 flight_dump_on_slow_query: str = ""):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -194,6 +197,12 @@ class AggregatorContext:
         # reference-exact minor-version-0 bodies; existing wire/text ids
         # still ride through untouched
         self.trace_requests = trace_requests
+        # flight recorder (utils/flightrec.py, ISSUE 5) — [Service]
+        # parity with the shard tier: ring on/off, ring size, ringed
+        # auto-dump dir on slow/errored requests
+        self.flight_recorder = flight_recorder
+        self.flight_recorder_events = flight_recorder_events
+        self.flight_dump_on_slow_query = flight_dump_on_slow_query
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -220,6 +229,13 @@ class AggregatorContext:
             trace_requests=reader.get_parameter(
                 "Service", "TraceRequests", "1").lower() in
             ("1", "true", "on", "yes"),
+            flight_recorder=reader.get_parameter(
+                "Service", "FlightRecorder", "0").lower() in
+            ("1", "true", "on", "yes"),
+            flight_recorder_events=int(reader.get_parameter(
+                "Service", "FlightRecorderEvents", "0")),
+            flight_dump_on_slow_query=reader.get_parameter(
+                "Service", "FlightDumpOnSlowQuery", ""),
         )
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
@@ -245,6 +261,11 @@ class AggregatorService:
         if self.context.metrics_port or \
                 self.context.slow_query_threshold_ms > 0:
             metrics.install_request_id_logging()
+        if self.context.flight_recorder:
+            flightrec.configure(
+                enabled=True,
+                max_events=self.context.flight_recorder_events or None,
+                dump_dir=self.context.flight_dump_on_slow_query or None)
         if self.context.metrics_port:
             # bind first: a metrics-port clash must fail start() before
             # backend connections, the reconnect task, or the listen
@@ -363,14 +384,16 @@ class AggregatorService:
                     await writer.drain()
                 elif t == wire.PacketType.SearchRequest:
                     metrics.inc("aggregator.requests")
+                    rec = flightrec.enabled()
                     t0 = time.perf_counter()
                     body, rid = self._ensure_request_id(body)
                     with trace.span("aggregator.scatter_gather"):
-                        result = await self._scatter_gather(body)
+                        result = await self._scatter_gather(body, rid)
                     # prefer the id echoed back by a shard (proof the trace
                     # traversed a backend); fall back to the edge-minted one
                     result.request_id = result.request_id or rid
                     rbody = result.pack()
+                    t_send0 = time.perf_counter() if rec else 0.0
                     writer.write(wire.PacketHeader(
                         wire.PacketType.SearchResponse,
                         wire.PacketProcessStatus.Ok, len(rbody),
@@ -379,8 +402,24 @@ class AggregatorService:
                     await writer.drain()
                     total = time.perf_counter() - t0
                     trace.record("aggregator.request", total)
+                    if rec:
+                        flightrec.record(
+                            "aggregator", "send", rid,
+                            dur_ns=int((time.perf_counter() - t_send0)
+                                       * 1e9))
+                        flightrec.record(
+                            "aggregator", "request", rid,
+                            dur_ns=int(total * 1e9),
+                            payload={"status": int(result.status)})
                     thresh = self.context.slow_query_threshold_ms
-                    if thresh > 0 and total * 1000.0 >= thresh:
+                    slow = thresh > 0 and total * 1000.0 >= thresh
+                    if rec and self.context.flight_dump_on_slow_query \
+                            and (slow or result.status
+                                 != wire.ResultStatus.Success):
+                        asyncio.get_event_loop().run_in_executor(
+                            None, flightrec.dump_to_file,
+                            "slow" if slow else "error", rid)
+                    if slow:
                         try:
                             # the status byte is backend-supplied and may
                             # be outside the enum ("hostile peers send
@@ -427,10 +466,12 @@ class AggregatorService:
         query.request_id = wire.new_request_id()
         return query.pack(), query.request_id
 
-    async def _scatter_gather(self, body: bytes) -> wire.RemoteSearchResult:
+    async def _scatter_gather(self, body: bytes, rid: str = ""
+                              ) -> wire.RemoteSearchResult:
         """Fan out to every Connected server; flat-merge the per-index
         lists; degrade status on timeout/network failure
-        (AggregatorService.cpp:206-366)."""
+        (AggregatorService.cpp:206-366).  `rid` tags the per-shard
+        fan-out and merge flight events."""
         targets = [(i, s) for i, s in enumerate(self.context.servers)
                    if s.connected]
         metrics.set_gauge("aggregator.connected_backends", len(targets))
@@ -438,8 +479,10 @@ class AggregatorService:
             metrics.inc("aggregator.no_backend")
             return wire.RemoteSearchResult(wire.ResultStatus.FailedNetwork,
                                            [])
-        tasks = [self._query_one(i, s, body) for i, s in targets]
+        tasks = [self._query_one(i, s, body, rid) for i, s in targets]
         replies = await asyncio.gather(*tasks)
+        rec = flightrec.enabled()
+        t_merge0 = time.monotonic_ns() if rec else 0
         merged = wire.RemoteSearchResult(wire.ResultStatus.Success, [])
         for status, results, shard_rid in replies:
             if status != wire.ResultStatus.Success:
@@ -462,11 +505,32 @@ class AggregatorService:
                 rel_tol=self.context.merge_rel_tol,
                 replica_groups=([s.replica_group for _, s in targets]
                                 if declared else None))
+        if rec:
+            flightrec.record("aggregator", "merge", rid,
+                             dur_ns=time.monotonic_ns() - t_merge0,
+                             payload={"backends": len(targets)})
         return merged
 
-    async def _query_one(self, idx: int, server: RemoteServer, body: bytes):
+    async def _query_one(self, idx: int, server: RemoteServer, body: bytes,
+                         req_id: str = ""):
         rid = server.next_rid
         server.next_rid += 1
+        rec = flightrec.enabled()
+        t_fan0 = time.monotonic_ns() if rec else 0
+
+        def fanout_event(status: int) -> None:
+            # every exit of this fan-out — success, backend-gone,
+            # timeout, socket error — records its span: the
+            # error-triggered auto-dump must contain the span of exactly
+            # the backend that broke, not every OTHER one
+            if rec:
+                flightrec.record(
+                    "aggregator", "fanout", req_id,
+                    dur_ns=time.monotonic_ns() - t_fan0,
+                    payload={"backend": "%s:%d" % (server.address,
+                                                   server.port),
+                             "status": int(status)})
+
         header = wire.PacketHeader(wire.PacketType.SearchRequest,
                                    wire.PacketProcessStatus.Ok, len(body),
                                    0, rid)
@@ -479,6 +543,7 @@ class AggregatorService:
                     # lock; writer is gone and our future already failed
                     server.pending.pop(rid, None)
                     metrics.inc("aggregator.backend_failures")
+                    fanout_event(wire.ResultStatus.FailedNetwork)
                     return wire.ResultStatus.FailedNetwork, [], ""
                 server.writer.write(header.pack() + body)
                 await server.writer.drain()
@@ -496,18 +561,22 @@ class AggregatorService:
                 result = None
             if result is None:
                 metrics.inc("aggregator.malformed_backend_body")
+                fanout_event(wire.ResultStatus.FailedNetwork)
                 return wire.ResultStatus.FailedNetwork, [], ""
+            fanout_event(result.status)
             return result.status, result.results, result.request_id
         except asyncio.TimeoutError:
             # the connection stays up and aligned — the reader task will
             # drop the late reply when it arrives (no resource_id match)
             server.pending.pop(rid, None)
             metrics.inc("aggregator.backend_timeouts")
+            fanout_event(wire.ResultStatus.Timeout)
             return wire.ResultStatus.Timeout, [], ""
         except OSError:
             server.pending.pop(rid, None)
             server.drop()
             metrics.inc("aggregator.backend_failures")
+            fanout_event(wire.ResultStatus.FailedNetwork)
             return wire.ResultStatus.FailedNetwork, [], ""
 
 

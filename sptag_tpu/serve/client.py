@@ -29,11 +29,12 @@ from __future__ import annotations
 import concurrent.futures
 import socket
 import threading
+import time
 from typing import List, Optional
 
 from sptag_tpu.serve import wire
 from sptag_tpu.serve.protocol import request_id_of
-from sptag_tpu.utils import locksan
+from sptag_tpu.utils import flightrec, locksan
 
 
 class AnnClient:
@@ -152,6 +153,8 @@ class AnnClient:
         client with trace_requests=False for reference-exact bytes)."""
         req_id = request_id or request_id_of(query) or \
             (wire.new_request_id() if self.trace_requests else "")
+        rec = flightrec.enabled()
+        t_send0 = time.monotonic_ns() if rec else 0
         if self._sock is None:
             try:
                 self.connect()
@@ -181,6 +184,12 @@ class AnnClient:
                     if rhead.packet_type == wire.PacketType.SearchResponse \
                             and rhead.resource_id == rid:
                         result = wire.RemoteSearchResult.unpack(rbody)
+                        if rec:
+                            # the client edge's "send" span: request out
+                            # to response in — the flow arrow's origin
+                            flightrec.record(
+                                "client", "send", req_id,
+                                dur_ns=time.monotonic_ns() - t_send0)
                         return result if result is not None else \
                             wire.RemoteSearchResult(
                                 wire.ResultStatus.FailedNetwork, [])
@@ -342,6 +351,8 @@ class PipelinedAnnClient:
                request_id: Optional[str] = None) -> wire.RemoteSearchResult:
         req_id = request_id or request_id_of(query) or \
             (wire.new_request_id() if self.trace_requests else "")
+        rec = flightrec.enabled()
+        t_send0 = time.monotonic_ns() if rec else 0
         if self._sock is None:
             try:
                 self.connect()
@@ -383,6 +394,9 @@ class PipelinedAnnClient:
             return wire.RemoteSearchResult(
                 wire.ResultStatus.FailedNetwork, [])
         result = wire.RemoteSearchResult.unpack(payload)
+        if rec:
+            flightrec.record("client", "send", req_id,
+                             dur_ns=time.monotonic_ns() - t_send0)
         return result if result is not None else \
             wire.RemoteSearchResult(wire.ResultStatus.FailedNetwork, [])
 

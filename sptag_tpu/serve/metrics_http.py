@@ -10,6 +10,10 @@ these when their `MetricsPort` is set:
   indexes + sample counts for a server, backend connectivity for an
   aggregator); HTTP 200 when ``status`` is ``ok``, 503 otherwise, so load
   balancers can act on the code alone.
+* ``GET /debug/flight`` — the flight recorder's ring
+  (utils/flightrec.py) as Chrome trace-event JSON, loadable directly in
+  Perfetto / chrome://tracing.  Always answers 200; with the recorder
+  off the trace is empty and ``otherData.counters.enabled`` is 0.
 
 Port semantics: 0 = disabled (the owner never constructs this), a
 negative port binds OS-ephemeral (tests read the bound port back from
@@ -30,7 +34,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
-from sptag_tpu.utils import metrics
+from sptag_tpu.utils import flightrec, metrics
 
 log = logging.getLogger(__name__)
 
@@ -55,6 +59,11 @@ class MetricsHttpServer:
                     if self.path.split("?")[0] == "/metrics":
                         body = metrics.render_prometheus().encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
+                        code = 200
+                    elif self.path.split("?")[0] == "/debug/flight":
+                        body = json.dumps(
+                            flightrec.export_chrome_trace()).encode()
+                        ctype = "application/json"
                         code = 200
                     elif self.path.split("?")[0] == "/healthz":
                         try:

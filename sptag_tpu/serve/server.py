@@ -24,7 +24,7 @@ from typing import Dict, Optional, Tuple
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
-from sptag_tpu.utils import metrics, trace
+from sptag_tpu.utils import flightrec, metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -44,7 +44,10 @@ class SearchServer:
                  drain_timeout_s: float = 15.0,
                  metrics_port: Optional[int] = None,
                  slow_query_threshold_ms: Optional[float] = None,
-                 max_response_tasks: int = 8):
+                 max_response_tasks: int = 8,
+                 flight_recorder: Optional[bool] = None,
+                 flight_dump_dir: Optional[str] = None,
+                 flight_tier: str = "server"):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -57,6 +60,18 @@ class SearchServer:
         self.slow_query_threshold_ms = (
             slow_query_threshold_ms if slow_query_threshold_ms is not None
             else context.settings.slow_query_threshold_ms)
+        # flight recorder (ISSUE 5): the recorder itself is process-wide
+        # (utils/flightrec.py); this server contributes events under
+        # `flight_tier` — tests running several tiers in one process give
+        # each a distinct tier so the exported trace keeps one Perfetto
+        # process per tier
+        self.flight_recorder = (
+            flight_recorder if flight_recorder is not None
+            else context.settings.flight_recorder)
+        self.flight_dump_dir = (
+            flight_dump_dir if flight_dump_dir is not None
+            else context.settings.flight_dump_on_slow_query)
+        self.flight_tier = flight_tier
         self._metrics_http: Optional[MetricsHttpServer] = None
         # reference parity: ConnectionManager hands out at most 256
         # connection slots (/root/reference/AnnService/inc/Socket/
@@ -100,6 +115,12 @@ class SearchServer:
             # the slow-query log wants request-id-stamped records even
             # with the HTTP endpoint disabled
             metrics.install_request_id_logging()
+        if self.flight_recorder:
+            flightrec.configure(
+                enabled=True,
+                max_events=self.context.settings.flight_recorder_events
+                or None,
+                dump_dir=self.flight_dump_dir or None)
         if self.metrics_port:
             # bind the metrics listener FIRST: an EADDRINUSE here must
             # fail start() before the serve socket accepts or the batcher
@@ -243,6 +264,8 @@ class SearchServer:
             await self._send(cid, resp.pack())
         elif t == wire.PacketType.SearchRequest:
             metrics.inc("server.requests")
+            rec = flightrec.enabled()
+            t_dec0 = time.monotonic_ns() if rec else 0
             with trace.span("server.decode"):
                 query = wire.RemoteQuery.unpack(body)
             if query is None:
@@ -258,10 +281,20 @@ class SearchServer:
                 # it rides into every log line and response — bound it
                 # like the text channel does
                 query.request_id = query.request_id[:64]
+            if rec:
+                flightrec.record(
+                    self.flight_tier, "decode",
+                    query.request_id if query is not None else "",
+                    dur_ns=time.monotonic_ns() - t_dec0)
             try:
                 self._queue.put_nowait((cid, header, query,
                                         time.perf_counter()))
                 metrics.set_gauge("server.queue_depth", self._queue.qsize())
+                if rec:
+                    flightrec.record(
+                        self.flight_tier, "enqueue",
+                        query.request_id if query is not None else "",
+                        payload={"depth": self._queue.qsize()})
             except asyncio.QueueFull:
                 # shed load at the edge rather than buffering unboundedly;
                 # the client sees a definitive, well-formed FailedExecute
@@ -304,10 +337,17 @@ class SearchServer:
         t_assembled = time.perf_counter()
         metrics.set_gauge("server.queue_depth", self._queue.qsize())
         metrics.set_gauge("server.last_batch_size", len(batch))
+        rec = flightrec.enabled()
         texts = []
+        rids = []
         for cid, header, query, t_enq in batch:
             texts.append(query.query if query is not None else "")
+            rids.append(query.request_id if query is not None else "")
             trace.record("server.queue_wait", t_assembled - t_enq)
+            if rec:
+                flightrec.record(
+                    self.flight_tier, "queue_wait", rids[-1],
+                    dur_ns=int((t_assembled - t_enq) * 1e9))
         loop = asyncio.get_event_loop()
         # per-query streaming (continuous batching): the executor invokes
         # on_ready from ITS thread as individual queries finish; each
@@ -326,7 +366,8 @@ class SearchServer:
             def run_batch():
                 with trace.span("server.execute_batch"):
                     return self.executor.execute_batch(texts,
-                                                       on_ready=on_ready)
+                                                       on_ready=on_ready,
+                                                       rids=rids)
             results = await loop.run_in_executor(None, run_batch)
         except Exception:
             metrics.inc("server.batch_failures")
@@ -334,9 +375,18 @@ class SearchServer:
             results = [wire.RemoteSearchResult(
                 wire.ResultStatus.FailedExecute, [])] * len(batch)
         t_executed = time.perf_counter()
+        if rec:
+            flightrec.record(
+                self.flight_tier, "execute",
+                dur_ns=int((t_executed - t_assembled) * 1e9),
+                payload={"batch": len(batch)})
         # response handoff (bounded, counted): the batcher returns to
         # assembling batch N+1 while this batch's responses encode+drain
         # in their own task
+        if rec:
+            flightrec.record(self.flight_tier, "handoff",
+                             payload={"batch": len(batch),
+                                      "streamed": len(streamed)})
         await self._spawn_response_task(
             self._respond_batch(batch, results, streamed, t_assembled,
                                 t_executed))
@@ -394,8 +444,13 @@ class SearchServer:
         # match the response to its trace
         rid = query.request_id if query is not None else ""
         result.request_id = rid
+        rec = flightrec.enabled()
+        t_enc0 = time.monotonic_ns() if rec else 0
         with trace.span("server.encode"):
             body = result.pack()
+        if rec:
+            flightrec.record(self.flight_tier, "encode", rid,
+                             dur_ns=time.monotonic_ns() - t_enc0)
         resp = wire.PacketHeader(
             wire.PacketType.SearchResponse,
             wire.PacketProcessStatus.Ok, len(body), cid,
@@ -407,20 +462,42 @@ class SearchServer:
         now = time.perf_counter()
         total = now - t_enq
         trace.record("server.request", total)
+        if rec:
+            flightrec.record(self.flight_tier, "drain", rid,
+                             dur_ns=int((now - t_send0) * 1e9))
+            flightrec.record(self.flight_tier, "request", rid,
+                             dur_ns=int(total * 1e9),
+                             payload={"status": int(result.status)})
         thresh = self.slow_query_threshold_ms
-        if thresh > 0 and total * 1000.0 >= thresh:
+        slow = thresh > 0 and total * 1000.0 >= thresh
+        if slow:
+            # slow-query enrichment (ISSUE 5 satellite): the scheduler's
+            # per-rid numbers — slot wait, resident segments, refill
+            # batches — logged alongside the per-stage timings, so the
+            # log line and a flight dump of the same query agree
+            st = flightrec.query_stats(rid) if rid else None
+            sched = ("slot_wait=%.2fms segments=%d refills=%d" % (
+                st.get("slot_wait_ms", 0.0), st.get("segments", 0),
+                st.get("refills", 0))) if st else "sched=-"
             token = metrics.set_request_id(rid)
             try:
                 log.warning(
                     "slow query rid=%s total=%.2fms queue=%.2fms "
-                    "execute=%.2fms send=%.2fms results=%d",
+                    "execute=%.2fms send=%.2fms %s results=%d",
                     rid or "-", total * 1000.0,
                     (t_assembled - t_enq) * 1000.0,
                     (t_executed - t_assembled) * 1000.0,
-                    (now - t_send0) * 1000.0,
+                    (now - t_send0) * 1000.0, sched,
                     sum(len(r.ids) for r in result.results))
             finally:
                 metrics.reset_request_id(token)
+        if self.flight_dump_dir and rec and (
+                slow or result.status != wire.ResultStatus.Success):
+            # auto-dump the ring for post-mortem (FlightDumpOnSlowQuery);
+            # file IO runs off the event loop, the dump dir is ringed
+            asyncio.get_event_loop().run_in_executor(
+                None, flightrec.dump_to_file,
+                "slow" if slow else "error", rid)
 
 
 def run_interactive(context: ServiceContext) -> None:

@@ -89,6 +89,16 @@ class ServiceSettings:
     # (queue wait + execute + send) reaches this many ms is logged with
     # its request id, per-stage timings and result count; 0 disables
     slow_query_threshold_ms: float = 0.0
+    # flight recorder (utils/flightrec.py, ISSUE 5): per-query timeline
+    # ring exported as Chrome trace JSON (GET /debug/flight on the
+    # metrics listener).  Off by default — off costs one flag test per
+    # stage and the serve bytes stay identical.  FlightRecorderEvents
+    # sizes the ring (0 = module default); FlightDumpOnSlowQuery names a
+    # directory that receives a ringed auto-dump whenever the slow-query
+    # log fires or a request errors (empty disables dumps).
+    flight_recorder: bool = False
+    flight_recorder_events: int = 0
+    flight_dump_on_slow_query: str = ""
     # runtime lock sanitizer (utils/locksan.py): when on, locks created
     # from here on (index writer locks, client locks, thread pools) are
     # wrapped to detect lock-order inversions at runtime; the watchdog
@@ -144,6 +154,13 @@ class ServiceContext:
                 "Service", "MetricsHost", "127.0.0.1"),
             slow_query_threshold_ms=float(reader.get_parameter(
                 "Service", "SlowQueryThresholdMs", "0")),
+            flight_recorder=reader.get_parameter(
+                "Service", "FlightRecorder", "0").lower() in
+            ("1", "true", "on", "yes"),
+            flight_recorder_events=int(reader.get_parameter(
+                "Service", "FlightRecorderEvents", "0")),
+            flight_dump_on_slow_query=reader.get_parameter(
+                "Service", "FlightDumpOnSlowQuery", ""),
             lock_sanitizer=reader.get_parameter(
                 "Service", "LockSanitizer", "0").lower() in
             ("1", "true", "on", "yes", "strict"),
@@ -522,7 +539,8 @@ class SearchExecutor:
 
     def _run_group_streaming(self, parsed, results, name: str, k: int,
                              with_meta: bool, max_check, search_mode,
-                             idxs: List[int], on_ready) -> None:
+                             idxs: List[int], on_ready,
+                             rids: Optional[List[str]] = None) -> None:
         """Single-index group via per-query futures (VectorIndex
         .submit_batch): each query's result is built and handed to
         `on_ready(i, result)` AS ITS FUTURE RESOLVES — with a continuous-
@@ -553,7 +571,8 @@ class SearchExecutor:
             futs = index.submit_batch(
                 np.stack(vecs), k, max_check=max_check,
                 search_mode=self._sanitize_search_mode(parsed[ok[0]],
-                                                       index))
+                                                       index),
+                rids=[rids[i] if rids else "" for i in ok])
         except Exception:                                # noqa: BLE001
             metrics.inc("service.search_errors")
             log.exception("streamed batch submit failed on index %s", name)
@@ -584,7 +603,8 @@ class SearchExecutor:
             except Exception:                            # noqa: BLE001
                 log.exception("on_ready callback failed")
 
-    def execute_batch(self, query_texts: List[str], on_ready=None
+    def execute_batch(self, query_texts: List[str], on_ready=None,
+                      rids: Optional[List[str]] = None
                       ) -> List[RemoteSearchResult]:
         """Coalesced execution: groups parsed queries by (index set, k,
         meta) and runs each group's vectors as ONE device batch.
@@ -593,7 +613,11 @@ class SearchExecutor:
         EXECUTING thread as individual queries finish (single-index groups
         only — multi-index fan-outs keep batch granularity).  Every result
         is still present in the returned list; the caller tracks which
-        indices it already consumed via the callback."""
+        indices it already consumed via the callback.
+
+        `rids` (one request id per query, optional) rides into scheduler-
+        backed submit_batch paths so flight-recorder events and per-rid
+        slot stats attribute to the wire request id."""
         parsed = [parse_query(t) for t in query_texts]
         results: List[Optional[RemoteSearchResult]] = [None] * len(parsed)
         groups: Dict[tuple, List[int]] = {}
@@ -622,7 +646,8 @@ class SearchExecutor:
                 # keep the classic whole-batch path below
                 self._run_group_streaming(parsed, results, sel[0], k,
                                           with_meta, max_check,
-                                          search_mode, idxs, on_ready)
+                                          search_mode, idxs, on_ready,
+                                          rids=rids)
                 continue
             for name in sel:
                 index = self.context.indexes[name]

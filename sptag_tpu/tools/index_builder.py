@@ -65,8 +65,15 @@ def main(argv=None) -> int:
                         help="print the span report (count/total/max/"
                         "p50/p90/p99 per build stage, incl. XLA compile "
                         "spans) as JSON on exit")
+    parser.add_argument("--flight-dump", default=None, metavar="PATH",
+                        help="enable the flight recorder and write its "
+                        "ring as Chrome-trace JSON (Perfetto-loadable; "
+                        "same artifact the serving tier exports) on exit")
     args = parser.parse_args(argv)
     pin_platform(args.platform)
+    if args.flight_dump:
+        from sptag_tpu.utils import flightrec
+        flightrec.configure(enabled=True)
 
     value_type = enum_from_string(VectorValueType, args.vectortype)
     options = ReaderOptions(value_type=value_type,
@@ -106,6 +113,11 @@ def main(argv=None) -> int:
 
         from sptag_tpu.utils import trace
         print(json.dumps(trace.report(), indent=2, sort_keys=True))
+    if args.flight_dump:
+        from sptag_tpu.utils import flightrec
+        flightrec.write_trace(args.flight_dump,
+                              other_data={"tool": "index_builder"})
+        log.info("flight trace written to %s", args.flight_dump)
     return 0
 
 

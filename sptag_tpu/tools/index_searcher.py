@@ -80,8 +80,17 @@ def main(argv=None) -> int:
                         help="print the span report (count/total/max/"
                         "p50/p90/p99, incl. XLA compile spans) as JSON "
                         "after the sweep")
+    parser.add_argument("--flight-dump", default=None, metavar="PATH",
+                        help="enable the flight recorder and write its "
+                        "ring as Chrome-trace JSON (Perfetto-loadable; "
+                        "same artifact the serving tier exports) on "
+                        "exit.  Pair with Index.FlightDeviceSampleRate "
+                        "for sampled device-time attribution")
     args = parser.parse_args(argv)
     pin_platform(args.platform)
+    if args.flight_dump:
+        from sptag_tpu.utils import flightrec
+        flightrec.configure(enabled=True)
 
     index = load_index(args.index)
     for name, value in params:
@@ -130,6 +139,11 @@ def main(argv=None) -> int:
     if args.trace_report:
         import json
         print(json.dumps(trace.report(), indent=2, sort_keys=True))
+    if args.flight_dump:
+        from sptag_tpu.utils import flightrec
+        flightrec.write_trace(args.flight_dump,
+                              other_data={"tool": "index_searcher"})
+        log.info("flight trace written to %s", args.flight_dump)
     return 0
 
 

@@ -42,14 +42,15 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _reset_telemetry_registries():
-    """Start every test with empty trace-span and metrics registries —
-    both are process-global, so without this a span/counter assertion in
-    one test would see every earlier test's serving traffic (and the
-    suite's pass/fail would depend on execution order)."""
-    from sptag_tpu.utils import metrics, trace
+    """Start every test with empty trace-span, metrics and flight-recorder
+    registries — all are process-global, so without this a span/counter/
+    event assertion in one test would see every earlier test's serving
+    traffic (and the suite's pass/fail would depend on execution order)."""
+    from sptag_tpu.utils import flightrec, metrics, trace
 
     trace.reset()
     metrics.reset()
+    flightrec.reset()
     yield
 
 

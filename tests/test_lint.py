@@ -485,6 +485,46 @@ def test_gl602_literal_and_from_import_forms():
     assert rules_of(lint_one(dirty, select=["GL602"])) == ["GL602"]
 
 
+def test_gl603_dynamic_flight_kind_flagged():
+    """Flight-event `kind` strings are the cardinality-bounded surface
+    (the export keys tracks off them): f-strings, concatenation and
+    per-call variables are flagged like GL601/602 names."""
+    src = (
+        "from sptag_tpu.utils import flightrec\n"
+        "def stage(name, rid):\n"
+        "    flightrec.record('server', f'stage.{name}', rid)\n"
+        "    with flightrec.span('server', name, rid):\n"
+        "        pass\n"
+    )
+    found = lint_one(src, select=["GL603"])
+    assert rules_of(found) == ["GL603"]
+    assert len(found) == 2
+    assert "kind" in found[0].message
+
+
+def test_gl603_literal_kind_and_dynamic_tier_clean():
+    """Literal / module-constant kinds pass; the TIER argument and
+    payload values are out of scope (a per-instance tier label like
+    server_a is a deployment choice, not unbounded cardinality), as are
+    the keyword form and the from-import form with literals."""
+    src = (
+        "from sptag_tpu.utils import flightrec\n"
+        "from sptag_tpu.utils.flightrec import record\n"
+        "KIND = 'segment_device'\n"
+        "def stage(tier, rid, n):\n"
+        "    flightrec.record(tier, 'decode', rid)\n"
+        "    flightrec.record(tier, KIND, rid, payload={'n': n})\n"
+        "    record(tier, kind='retire', rid=rid)\n"
+    )
+    assert lint_one(src, select=["GL603"]) == []
+    dirty = (
+        "from sptag_tpu.utils.flightrec import record\n"
+        "def stage(tier, kind, rid):\n"
+        "    record(tier, kind, rid)\n"
+    )
+    assert rules_of(lint_one(dirty, select=["GL603"])) == ["GL603"]
+
+
 # ---------------------------------------------------------------------------
 # baseline machinery + the tier-1 repo gate
 # ---------------------------------------------------------------------------
@@ -530,7 +570,7 @@ def test_every_rule_has_an_id_and_description():
         "GL301", "GL302",
         "GL401", "GL402",
         "GL501",
-        "GL601", "GL602",
+        "GL601", "GL602", "GL603",
         "GL701", "GL702", "GL703", "GL704",
     }
     assert all(ALL_RULES[r] for r in ALL_RULES)

@@ -83,14 +83,29 @@ def test_builder_and_searcher_cli(tmp_path):
     qtsv = str(tmp_path / "queries.tsv")
     _write_tsv(qtsv, qs, [b""] * len(qs))
 
+    flight_path = str(tmp_path / "flight.json")
     rc = index_searcher.main([
         "-x", out, "-q", qtsv, "-r", truth_path, "-k", "5",
-        "-m", "256", "-o", str(tmp_path / "results.txt")])
+        "-m", "256", "-o", str(tmp_path / "results.txt"),
+        "--flight-dump", flight_path,
+        "Index.SearchMode=beam", "Index.BeamSegmentIters=2",
+        "Index.FlightDeviceSampleRate=1"])
     assert rc == 0
     lines = open(str(tmp_path / "results.txt")).read().splitlines()
     assert len(lines) == 40
     first = [int(t) for t in lines[0].split()]
     assert first[0] == 0      # self-query
+    # --flight-dump (ISSUE 5 satellite): the offline run writes the SAME
+    # Perfetto artifact the serving tier exports, with sampled engine
+    # device time from the segmented walk
+    import json as jsonmod
+    with open(flight_path) as f:
+        trace = jsonmod.load(f)
+    assert trace["otherData"]["tool"] == "index_searcher"
+    names = {ev["name"] for ev in trace["traceEvents"]}
+    assert "segment_device" in names
+    assert any(e["kind"] == "segment_device" and e["dur_ns"] > 0
+               for e in trace["flightEvents"])
 
 
 def test_calc_recall():

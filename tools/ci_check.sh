@@ -26,6 +26,13 @@ echo "== beam segmented-vs-monolithic parity (standalone) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_beam_segmented.py -q \
     -p no:cacheprovider -k "parity or segment_param"
 
+# the ISSUE 5 observability gate, standalone: with FlightRecorder=off
+# (the default) the serve tier's wire bytes stay byte-identical to the
+# reference layout and the hot path performs zero recorder work
+echo "== flight recorder off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_flightrec.py -q \
+    -p no:cacheprovider -k "off_parity"
+
 echo "== tier-1 pytest (CPU backend) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

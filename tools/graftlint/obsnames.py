@@ -17,6 +17,12 @@ Rules:
   (`metrics.counter/gauge/histogram/inc/set_gauge/observe/
   counter_value/histogram_or_none`) is not a string literal or
   module-level string constant.
+* GL603 — the `kind` argument of a flight-recorder call
+  (`flightrec.record(tier, kind, ...)` / `flightrec.span(tier, kind,
+  ...)`) is not a string literal or module-level string constant: the
+  Chrome-trace export keys tracks off the kind and the ring never
+  expires a name, so kinds are a bounded taxonomy by the same
+  cardinality argument as GL601/602.
 
 Calls are resolved through import aliases (`from sptag_tpu.utils import
 trace` / `import sptag_tpu.utils.metrics as metrics` / from-imports of the
@@ -37,14 +43,23 @@ RULES = {
              "names make metric cardinality unbounded",
     "GL602": "metrics registry name is not a string literal — dynamic "
              "names make metric cardinality unbounded",
+    "GL603": "flight-recorder event kind is not a string literal — "
+             "dynamic kinds make the event taxonomy unbounded",
 }
 
 _TRACE_MODULE = "sptag_tpu.utils.trace"
 _METRICS_MODULE = "sptag_tpu.utils.metrics"
+_FLIGHT_MODULE = "sptag_tpu.utils.flightrec"
 
 _TRACE_FNS = {"span", "record"}
 _METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
                 "observe", "counter_value", "histogram_or_none"}
+_FLIGHT_FNS = {"record", "span"}
+
+#: per-rule (positional index, keyword name) of the argument that must
+#: be a bounded string — GL60x's lint surface
+_NAME_ARG = {"GL601": (0, "name"), "GL602": (0, "name"),
+             "GL603": (1, "kind")}
 
 
 def _module_str_constants(mod: ModuleInfo) -> Set[str]:
@@ -71,6 +86,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL601"
         if full == _METRICS_MODULE and func.attr in _METRICS_FNS:
             return "GL602"
+        if full == _FLIGHT_MODULE and func.attr in _FLIGHT_FNS:
+            return "GL603"
         return None
     if isinstance(func, ast.Name):
         target = mod.from_imports.get(func.id, "")
@@ -79,14 +96,17 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL601"
         if modpath == _METRICS_MODULE and sym in _METRICS_FNS:
             return "GL602"
+        if modpath == _FLIGHT_MODULE and sym in _FLIGHT_FNS:
+            return "GL603"
     return None
 
 
-def _name_arg(call: ast.Call) -> Optional[ast.AST]:
-    if call.args:
-        return call.args[0]
+def _name_arg(call: ast.Call, rule: str) -> Optional[ast.AST]:
+    pos, kwname = _NAME_ARG[rule]
+    if len(call.args) > pos:
+        return call.args[pos]
     for kw in call.keywords:
-        if kw.arg == "name":
+        if kw.arg == kwname:
             return kw.value
     return None
 
@@ -128,13 +148,14 @@ def _check_module(mod: ModuleInfo) -> List[Finding]:
         rule = _rule_for_call(node, mod)
         if rule is None:
             continue
-        arg = _name_arg(node)
+        arg = _name_arg(node, rule)
         if arg is None or _is_bounded(arg, constants):
             continue
         fn_name = _dotted(node.func) or "<call>"
+        what = "kind" if rule == "GL603" else "name"
         out.append(Finding(
             rule, mod.relpath, node.lineno,
-            f"`{fn_name}` name is {_describe(arg)} — use a string "
+            f"`{fn_name}` {what} is {_describe(arg)} — use a string "
             "literal (or a module-level str constant) so metric "
             "cardinality stays bounded", enclosing(node.lineno)))
     return out
