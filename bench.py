@@ -534,6 +534,35 @@ def recall_at_k(ids_all, truth, k):
         for i in range(len(truth))]))
 
 
+def _roofline_add(result, label, qps, est, batch_q, dtype="f32"):
+    """Record one LEDGER-derived roofline row (flat/dense/beam/int8)
+    under result["roofline"]["rows"][label].
+
+    Per-query work comes from the cost ledger (utils/costmodel.py) at
+    the stage's actual kernel shapes; peaks come from the capability
+    registry (utils/roofline.py — static table on TPU, disk-cached
+    measured micro-probe elsewhere), so bench carries ZERO chip
+    constants and the rows exist on every platform (ISSUE 6).  A
+    roofline failure never erases the measured QPS it annotates."""
+    try:
+        from sptag_tpu.utils import roofline as rl
+
+        cap = rl.capability(probe=True)
+        block = result.setdefault("roofline", {})
+        block.setdefault("peaks", {
+            "device_kind": cap.device_kind,
+            "source": cap.source,
+            "peak_flops_f32": cap.peak_flops_f32,
+            "peak_flops_bf16": cap.peak_flops_bf16,
+            "hbm_gbps": (round(cap.hbm_gbps, 2)
+                         if cap.hbm_gbps else None)})
+        block.setdefault("rows", {})[label] = rl.roofline_row(
+            est.family, est.flops / batch_q, est.hbm_bytes / batch_q,
+            qps, cap, dtype=dtype)
+    except Exception as e:                               # noqa: BLE001
+        result.setdefault("roofline_errors", {})[label] = repr(e)[:200]
+
+
 def run_bench():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     budget_s = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
@@ -615,7 +644,7 @@ def run_bench():
         enable_compile_cache()
 
         import sptag_tpu as sp
-        from sptag_tpu.utils import trace
+        from sptag_tpu.utils import costmodel, trace
 
         # 4096 queries: the tunneled backend costs ~60 ms per synced round
         # trip, so throughput is only visible with enough queries in flight
@@ -648,6 +677,12 @@ def run_bench():
                 "flat_recall_sample": recall_at_k(
                     flat_ids[:50], sample_truth, k),
             })
+            n_pad = ((n + 127) // 128) * 128      # FLAT's _ROW_PAD layout
+            _roofline_add(
+                result, "flat", result["flat_qps"],
+                costmodel.estimate("flat.scan", Q=len(queries), N=n_pad,
+                                   D=data.shape[1], k=k),
+                len(queries))
             del flat
         checkpoint()
 
@@ -688,31 +723,19 @@ def run_bench():
 
         checkpoint()
 
-        # roofline accounting (SURVEY §7 hard part #2): per-query work of
-        # the dense path = center scoring (2*C*D flops) + candidate scoring
-        # (2*MaxCheck*D flops, MaxCheck*D*4 bytes of block reads).  Utils
-        # vs v5e peaks (197 Tf/s bf16 MXU, 819 GB/s HBM) say whether the
-        # engine is compute-, bandwidth-, or (here) round-trip-bound.
+        # roofline accounting (SURVEY §7 hard part #2), now LEDGER-driven
+        # (ISSUE 6): the dense path's per-query work comes from the
+        # registered dense.scan formula at the index's real partition
+        # shapes, and peaks from the capability registry — the old
+        # hand-rolled block with hard-coded v5e constants is gone.
         try:
             dense = index._get_dense()
             mc = int(index.params.max_check)
-            d_dim = data.shape[1]
-            flops_q = 2.0 * (dense.num_clusters + mc) * d_dim
-            bytes_q = float(mc * d_dim * 4)
-            result["roofline"] = {
-                "flops_per_query": int(flops_q),
-                "hbm_bytes_per_query": int(bytes_q),
-                "achieved_gflops": round(qps * flops_q / 1e9, 2),
-                "achieved_gbps": round(qps * bytes_q / 1e9, 2),
-            }
-            if platform == "tpu":
-                # peak fractions only make sense against the chip that ran
-                result["roofline"].update({
-                    "mxu_util_pct_f32peak": round(
-                        100.0 * qps * flops_q / 49e12, 4),
-                    "hbm_util_pct": round(
-                        100.0 * qps * bytes_q / 819e9, 2),
-                })
+            P = dense.cluster_size
+            nprobe = int(np.clip(-(-mc // P), 1, dense.num_clusters))
+            _roofline_add(result, "dense", qps, costmodel.estimate(
+                "dense.scan", Q=batch, C=dense.num_clusters, P=P,
+                D=data.shape[1], nprobe=nprobe, k=k), batch)
         except Exception:                                # noqa: BLE001
             pass
 
@@ -745,6 +768,27 @@ def run_bench():
                     "int8_group_effective": getattr(
                         idx8, "last_group_effective", None),
                 })
+                try:
+                    d8 = idx8._get_dense()
+                    mc8 = int(idx8.params.max_check)
+                    P8, C8 = d8.cluster_size, d8.num_clusters
+                    np8 = int(np.clip(-(-mc8 // P8), 1, C8))
+                    ge = int(getattr(idx8, "last_group_effective", 0)
+                             or 0)
+                    if ge > 1:
+                        est8 = costmodel.estimate(
+                            "dense.grouped", Q=len(queries8), C=C8,
+                            P=P8, D=data8.shape[1], nprobe=np8,
+                            U=min(4 * np8, C8), G=ge, k=k, itemsize=1)
+                    else:
+                        est8 = costmodel.estimate(
+                            "dense.scan", Q=len(queries8), C=C8, P=P8,
+                            D=data8.shape[1], nprobe=np8, k=k,
+                            itemsize=1)
+                    _roofline_add(result, "int8", qps8, est8,
+                                  len(queries8), dtype="int8")
+                except Exception:                        # noqa: BLE001
+                    pass
             except Exception as e:                       # noqa: BLE001
                 result["int8_error"] = repr(e)[:300]
             checkpoint()
@@ -851,6 +895,24 @@ def run_bench():
                     "beam_graph": beam_graph,
                     "beam_queries": qcount,
                 })
+                try:
+                    # per-query work = budget iterations x the one-row
+                    # walk-body cost (the beam.segment ledger family) —
+                    # a budget-bound upper estimate: nbp early exits do
+                    # less, so %-of-peak is a floor on headroom
+                    eng_b = beam_index._get_engine()
+                    _, _, B_b, T_b, _ = eng_b.walk_plan(
+                        k, 2048,
+                        getattr(beam_index.params, "beam_width", 16))
+                    est1 = eng_b.walk_iter_cost(1, B_b)
+                    from sptag_tpu.utils.costmodel import CostEstimate
+                    _roofline_add(
+                        result, "beam", qps_b,
+                        CostEstimate("beam.segment", est1.flops * T_b,
+                                     est1.hbm_bytes * T_b),
+                        1, dtype=eng_b.score_dtype_name())
+                except Exception:                        # noqa: BLE001
+                    pass
                 checkpoint()
                 # continuous-batching comparison (ISSUE 4 acceptance): a
                 # MIXED-MaxCheck workload served (a) monolithically —

@@ -177,7 +177,10 @@ class BKTIndex(VectorIndex):
                                 # engine at _make_engine time: without
                                 # invalidation a set_parameter on a warm
                                 # index would be a silent no-op
-                                "flightdevicesamplerate"})
+                                "flightdevicesamplerate",
+                                # capability (incl. probe permission) is
+                                # resolved at engine materialization
+                                "rooflineprobe"})
     # process-wide recorder knobs: applied DIRECTLY to flightrec at
     # set_parameter time (each maps to its own configure field, so
     # setting one never clobbers the others) — they are not baked into
@@ -213,6 +216,16 @@ class BKTIndex(VectorIndex):
                           if low == "flightdumponslowquery" else None))
         return ok
 
+    def _retrack_devmem(self) -> None:
+        # DeviceBytesLedger re-enabled on a warm index: re-register the
+        # materialized snapshots (disable dropped their entries); slot
+        # pools re-track on their next resize
+        with self._lock:
+            if self._engine is not None:
+                self._engine.register_devmem()
+            if self._dense is not None:
+                self._dense.register_devmem()
+
     def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:
         p = self.params
         if int(getattr(p, "flight_recorder", 0)):
@@ -238,7 +251,9 @@ class BKTIndex(VectorIndex):
                                      0))),
                                  device_sample_rate=float(getattr(
                                      self.params,
-                                     "flight_device_sample_rate", 0.0)))
+                                     "flight_device_sample_rate", 0.0)),
+                                 roofline_probe=bool(int(getattr(
+                                     self.params, "roofline_probe", 0))))
 
     def _get_engine(self) -> GraphSearchEngine:
         if self._dirty or self._engine is None:

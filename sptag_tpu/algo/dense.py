@@ -36,7 +36,7 @@ import numpy as np
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.ops import pallas_kernels
-from sptag_tpu.utils import query_bucket, round_up
+from sptag_tpu.utils import costmodel, devmem, query_bucket, round_up
 
 MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
@@ -486,6 +486,64 @@ def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
     return jax.lax.map(body, queries3)
 
 
+# ---------------------------------------------------------------------------
+# cost-ledger entries (utils/costmodel.py; graftlint GL605)
+# ---------------------------------------------------------------------------
+
+def _dense_scan_cost(Q, C, P, D, nprobe, k, itemsize=4, **_):
+    """Per-query kernel: (Q, C) center matmul, top-nprobe cut, block
+    gather, (Q, nprobe*P) candidate contraction, masked top-k.  Bytes:
+    the gathered (Q, nprobe, P, D) candidate tensor is written then
+    re-read by the scoring einsum (2x), plus the full block-layout
+    operand of the gather and the (Q, nprobe*P) score-matrix traffic."""
+    M = Q * nprobe * P
+    flops = (costmodel.matmul_flops(Q, C, D)      # center scoring
+             + 2.0 * M * D                        # candidate scoring
+             + 10.0 * M                           # mask/dedup/top-k ensemble
+             + 2.0 * D * (Q + C))                 # norms
+    nbytes = (2.0 * M * D * itemsize              # gather out + einsum read
+              + C * P * D * itemsize              # gather operand
+              + C * D * 4 + C * 4                 # centroids
+              + Q * D * itemsize
+              + 8.0 * M * 4                       # ids/sq/mask/top-k traffic
+              + Q * k * 8)
+    return flops, nbytes
+
+
+def _dense_chunked_cost(M_chunks, Q, C, P, D, nprobe, k, itemsize=4, **_):
+    f, b = _dense_scan_cost(Q, C, P, D, nprobe, k, itemsize)
+    return M_chunks * f, M_chunks * b
+
+
+def _dense_grouped_cost(Q, C, P, D, nprobe, U, G, k, itemsize=4, **_):
+    """Grouped kernel: every query scores its group's U-block union —
+    (Q/G)*U grid steps of (G, D) x (D, P) contractions."""
+    NG = max(1, Q // max(G, 1))
+    M = NG * U * P * G                            # scored candidates
+    flops = (costmodel.matmul_flops(Q, C, D)
+             + 2.0 * M * D
+             + 12.0 * M                           # union rank/scan/top-k
+             + 2.0 * D * (Q + C))
+    nbytes = (2.0 * NG * U * P * D * itemsize + C * P * D * itemsize
+              + C * D * 4 + Q * D * itemsize + 8.0 * M * 4 + Q * k * 8)
+    return flops, nbytes
+
+
+def _dense_grouped_chunked_cost(M_chunks, Q, C, P, D, nprobe, U, G, k,
+                                itemsize=4, **_):
+    f, b = _dense_grouped_cost(Q, C, P, D, nprobe, U, G, k, itemsize)
+    return M_chunks * f, M_chunks * b
+
+
+costmodel.register("dense.scan", _dense_search_kernel, _dense_scan_cost)
+costmodel.register("dense.scan_chunked", _dense_search_chunked,
+                   _dense_chunked_cost)
+costmodel.register("dense.grouped", _dense_search_grouped_kernel,
+                   _dense_grouped_cost)
+costmodel.register("dense.grouped_chunked", _dense_search_grouped_chunked,
+                   _dense_grouped_chunked_cost)
+
+
 @functools.lru_cache(maxsize=8)
 def _replica_scores(metric: int, extra: int):
     """jitted (chunk, D) x (C, D) closure-assignment scorer: distances to
@@ -687,6 +745,20 @@ class DenseTreeSearcher:
         self.deleted = jnp.asarray(deleted[:self.n])
         self.last_effective_group = 0     # set by search(); diagnostic only
         self._demotions = set()
+        self.register_devmem()
+
+    def register_devmem(self) -> None:
+        """(Re-)register the block layout's resident bytes under a
+        dtype-split component (the int8-resident shards of the tiered-
+        HBM plan account separately from f32 blocks); called at build
+        and on DeviceBytesLedger re-enable."""
+        lay_bytes = (self.data_perm.nbytes + self.member_ids.nbytes
+                     + self.member_sq.nbytes + self.centroids.nbytes
+                     + self.cent_sq.nbytes + self.deleted.nbytes)
+        if self.data_perm.dtype == jnp.dtype(jnp.int8):
+            devmem.track("int8_blocks", self, lay_bytes)
+        else:
+            devmem.track("dense_blocks", self, lay_bytes)
 
     def set_deleted(self, deleted: np.ndarray) -> None:
         """Swap only the tombstone mask (delete-only mutation path)."""

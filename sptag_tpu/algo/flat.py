@@ -35,7 +35,7 @@ from sptag_tpu.core.types import (
 )
 from sptag_tpu.io import format as fmt
 from sptag_tpu.ops import distance as dist_ops
-from sptag_tpu.utils import round_up
+from sptag_tpu.utils import costmodel, devmem, round_up
 
 _ROW_PAD = 128      # pad corpus rows to multiples of this (TPU lane width)
 _QUERY_BUCKETS = (1, 8, 32, 128, 512)
@@ -156,6 +156,56 @@ def _flat_sketch_kernel(data, sqnorm, invalid, sketches, mean, queries,
     return dists, ids.astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# cost-ledger entries (utils/costmodel.py; graftlint GL605)
+# ---------------------------------------------------------------------------
+
+def _flat_scan_cost(Q, N, D, k, itemsize=4, **_):
+    """Exact scan: one (Q, D) x (N, D) contraction + norms + masked
+    top-k.  Bytes: corpus + queries + norms/tombstones in, results out,
+    plus the materialized (Q, N) score matrix's mask/neg/top-k traffic
+    (the SCAN_MATRIX_TRAFFIC calibration)."""
+    flops = (costmodel.matmul_flops(Q, N, D) + 2.0 * D * (Q + N)
+             + 2.0 * Q * N)
+    nbytes = (N * D * itemsize + Q * D * itemsize + N * 4 + N + Q * k * 8
+              + costmodel.SCAN_MATRIX_TRAFFIC * Q * N * 4)
+    return flops, nbytes
+
+
+def _flat_sketch_cost(Q, N, W, R, D, k, itemsize=4, **_):
+    """Sketch prefilter: XOR+popcount Hamming scan over (N, W) packed
+    words, top-R shortlist, exact re-rank of the gathered R rows."""
+    flops = (3.0 * Q * N * W                    # xor + popcount + add
+             + costmodel.topk_flops(Q, N)       # shortlist top-R
+             + costmodel.matmul_flops(Q, R, D)  # exact re-rank
+             + costmodel.topk_flops(Q, R))
+    nbytes = (N * W * 4 + Q * W * 4
+              + costmodel.SCAN_MATRIX_TRAFFIC * Q * N * 4
+              + 2.0 * Q * R * D * itemsize      # gather out + re-read
+              + N * D * itemsize                # gather operand
+              + Q * k * 8)
+    return flops, nbytes
+
+
+def _sketch_cal_cost(S, N, W, D, k, itemsize=4, **_):
+    """Calibration = one exact scan + one Hamming scan over S samples."""
+    f1, b1 = _flat_scan_cost(S, N, D, k, itemsize)
+    flops = f1 + 3.0 * S * N * W
+    nbytes = b1 + N * W * 4 + costmodel.SCAN_MATRIX_TRAFFIC * S * N * 4
+    return flops, nbytes
+
+
+def _pack_bits_cost(R, D, **_):
+    return 3.0 * R * D, R * D * 4 + R * ((D + 31) // 32) * 4
+
+
+costmodel.register("flat.scan", _flat_search_kernel, _flat_scan_cost)
+costmodel.register("flat.sketch_scan", _flat_sketch_kernel,
+                   _flat_sketch_cost)
+costmodel.register("flat.sketch_cal", _sketch_cal_kernel, _sketch_cal_cost)
+costmodel.register("flat.pack_bits", _pack_sign_bits, _pack_bits_cost)
+
+
 @register_algo
 class FlatIndex(VectorIndex):
     algo = IndexAlgoType.FLAT
@@ -234,6 +284,20 @@ class FlatIndex(VectorIndex):
 
     # ---- device snapshot --------------------------------------------------
 
+    def _retrack_devmem(self) -> None:
+        # DeviceBytesLedger re-enabled on a warm index: re-register the
+        # live snapshot/sketch (disable dropped their entries)
+        with self._lock:
+            if self._device is not None:
+                data_d, sqnorm_d, invalid_d = self._device
+                devmem.track("corpus", data_d,
+                             data_d.nbytes + sqnorm_d.nbytes
+                             + invalid_d.nbytes)
+            if self._sketch is not None:
+                packed, mean = self._sketch[1], self._sketch[2]
+                devmem.track("sketch", packed,
+                             packed.nbytes + mean.nbytes)
+
     def _snapshot(self):
         if not self._dirty and self._device is not None:
             return self._device
@@ -250,7 +314,13 @@ class FlatIndex(VectorIndex):
             invalid[:self._n] = self._deleted[:self._n]
             data_d = jnp.asarray(data)
             sqnorm_d = dist_ops.row_sqnorms(data_d)
-            self._device = (data_d, sqnorm_d, jnp.asarray(invalid))
+            invalid_d = jnp.asarray(invalid)
+            self._device = (data_d, sqnorm_d, invalid_d)
+            # device-memory ledger: the corpus snapshot's resident bytes,
+            # owned by the data array itself — a snapshot rebuild drops
+            # the old entry when the old arrays are collected
+            devmem.track("corpus", data_d,
+                         data_d.nbytes + sqnorm_d.nbytes + invalid_d.nbytes)
             self._sketch = None          # derived; rebuilt on demand
             self._dirty = False
             return self._device
@@ -273,6 +343,7 @@ class FlatIndex(VectorIndex):
             mean = ((f * live[:, None]).sum(0)
                     / jnp.maximum(live.sum(), 1.0))
             packed = _PACK_JIT(f - mean[None, :])
+            devmem.track("sketch", packed, packed.nbytes + mean.nbytes)
             # cal_r starts None: the auto-shortlist path calibrates it
             # OUTSIDE this lock via _ensure_calibrated (the O(64*N)
             # exact scan + compiles must not stall concurrent searches);

@@ -52,7 +52,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from sptag_tpu.utils import flightrec, locksan, metrics, query_bucket
+from sptag_tpu.utils import devmem, flightrec, locksan, metrics, query_bucket
 
 log = logging.getLogger(__name__)
 
@@ -132,6 +132,18 @@ class _SlotPool:
         self.entries: List[Optional[_Item]] = []
         self.state: Dict[str, np.ndarray] = {}
         self.t_limit = np.zeros((0,), np.int32)
+        self._iter_cost1 = None      # lazy one-row walk-iteration cost
+
+    def iter_cost1(self):
+        """Ledger cost of ONE walk iteration for ONE query in this pool
+        (slow-query roofline attribution); None when the engine predates
+        the cost ledger or the family is unregistered."""
+        if self._iter_cost1 is None:
+            try:
+                self._iter_cost1 = self.engine.walk_iter_cost(1, self.B)
+            except Exception:                             # noqa: BLE001
+                self._iter_cost1 = False
+        return self._iter_cost1 or None
 
     # ---- state plumbing ---------------------------------------------------
 
@@ -171,6 +183,13 @@ class _SlotPool:
         self.t_limit = np.zeros((capacity,), np.int32)
         self.entries = [None] * capacity
         self.capacity = capacity
+        # device-memory ledger: the pool's slot-state footprint (these
+        # arrays round-trip through the device every segment); re-tracked
+        # at every grow/compact so the gauge follows occupancy
+        devmem.track("slot_pool", self,
+                     sum(a.nbytes for a in self.state.values()
+                         if a is not None) + self.t_limit.nbytes,
+                     host=True)
         self._blank_rows(slice(None))
         if old_entries:
             src = [i for i, e in enumerate(old_entries) if e is not None]
@@ -313,6 +332,7 @@ class BeamSlotScheduler:
             for pool in self._pools.values():
                 leftovers.extend(e for e in pool.entries if e is not None)
                 pool.entries = [None] * pool.capacity
+                devmem.untrack(pool)
         for item in leftovers:
             if not item.future.done():
                 item.future.set_exception(
@@ -333,7 +353,12 @@ class BeamSlotScheduler:
                 with self._cv:
                     while not self._stopped and not self._has_work_locked():
                         if self._draining:
-                            return        # retired + drained: exit clean
+                            # retired + drained: release the pools' ledger
+                            # entries eagerly — the scheduler object may
+                            # be referenced long after its last query
+                            for pool in self._pools.values():
+                                devmem.untrack(pool)
+                            return        # exit clean
                         self._cv.wait(timeout=1.0)
                     if self._stopped:
                         return
@@ -468,6 +493,13 @@ class BeamSlotScheduler:
             d, ids = engine.finalize(sub, pool.k_eff)
             t_done = time.perf_counter()
             items = [pool.entries[i] for i in done]
+            # per-query roofline attribution (ISSUE 6 satellite): the
+            # row's own iteration count x the one-row ledger cost over
+            # its RESIDENT time classifies a slow query as compute-,
+            # bandwidth- or scheduling-bound right in the log line
+            iters_done = [int(pool.state["it"][i]) for i in done]
+            cost1 = pool.iter_cost1()
+            cap = getattr(engine, "_capability", None)
             for i in done:
                 pool.entries[i] = None
             # publish EVERY observation for the retiring queries BEFORE
@@ -477,7 +509,7 @@ class BeamSlotScheduler:
             # counter landed after the futures, so completion-triggered
             # dumps undercounted the very query that triggered them
             metrics.inc("scheduler.retired", len(done))
-            for item in items:
+            for j, item in enumerate(items):
                 metrics.observe("scheduler.query_s", t_done - item.t_enq)
                 if rec:
                     flightrec.record(
@@ -486,10 +518,24 @@ class BeamSlotScheduler:
                         payload={"segments": item.segments,
                                  "refills": item.refills})
                 if item.rid:
-                    flightrec.note_query_stats(
-                        item.rid,
+                    stats = dict(
                         slot_wait_ms=round(item.slot_wait * 1000.0, 3),
                         segments=item.segments, refills=item.refills)
+                    if cost1 is not None:
+                        it_n = iters_done[j]
+                        exec_s = max(t_done - item.t_enq - item.slot_wait,
+                                     1e-9)
+                        q_flops = cost1.flops * it_n
+                        q_bytes = cost1.hbm_bytes * it_n
+                        stats["gflops"] = round(q_flops / exec_s / 1e9, 3)
+                        stats["iters"] = it_n
+                        if cap is not None:
+                            pct = cap.pct_of_peak(
+                                q_flops / exec_s, q_bytes / exec_s,
+                                engine.score_dtype_name())
+                            if pct is not None:
+                                stats["pct_peak"] = round(pct, 4)
+                    flightrec.note_query_stats(item.rid, **stats)
             for j, item in enumerate(items):
                 if not item.future.done():
                     item.future.set_result((d[j].copy(), ids[j].copy()))

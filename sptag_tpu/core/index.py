@@ -187,7 +187,28 @@ class VectorIndex(abc.ABC):
         return base_of(self.value_type)
 
     def set_parameter(self, name: str, value: str) -> bool:
-        return self.params.set_param(name, value)
+        ok = self.params.set_param(name, value)
+        if ok and name.lower() == "devicebytesledger":
+            # process-wide device-memory ledger flag (utils/devmem.py):
+            # applied directly, for EVERY index family — a registry-only
+            # write would be a silent no-op on a warm index
+            from sptag_tpu.utils import devmem
+
+            enabled = bool(int(getattr(self.params,
+                                       "device_bytes_ledger", 1)))
+            devmem.configure(enabled=enabled)
+            if enabled:
+                # RE-enable on a warm index: disabling dropped every
+                # entry, and snapshots only track at build time — re-
+                # register the live ones so gauges come back without a
+                # rebuild (slot pools re-track on their next resize)
+                self._retrack_devmem()
+        return ok
+
+    def _retrack_devmem(self) -> None:
+        """Re-register this index's live device allocations with the
+        memory ledger (subclass hook; called when DeviceBytesLedger is
+        re-enabled on a warm index).  Default: nothing tracked."""
 
     def get_parameter(self, name: str) -> Optional[str]:
         return self.params.get_param(name)

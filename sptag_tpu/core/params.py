@@ -180,6 +180,18 @@ _COMMON_TAIL_SPECS = [
     # are reproducible).
     _spec("flight_device_sample_rate", float, 0.0, "FlightDeviceSampleRate"),
     _spec("flight_dump_on_slow_query", str, "", "FlightDumpOnSlowQuery"),
+    # roofline observability (ISSUE 6, utils/roofline.py): permit the
+    # disk-cached measured micro-probe (matmul peak + copy bandwidth) on
+    # cpu/gpu/unknown device kinds, so %-of-peak gauges exist off-TPU.
+    # Known TPU generations resolve from the static capability table
+    # either way; 0 (default) never runs probe device work.  Baked into
+    # the engine snapshot (it resolves capability at materialization).
+    _spec("roofline_probe", int, 0, "RooflineProbe"),
+    # device-memory ledger (utils/devmem.py): 0 disables the resident-
+    # bytes accounting behind memory.device_bytes / GET /debug/memory.
+    # Process-wide, applied at set_parameter time; the ledger never
+    # touches the request path, so serve bytes are identical either way
+    _spec("device_bytes_ledger", int, 1, "DeviceBytesLedger"),
 ]
 
 _FILE_SPECS = [
@@ -369,4 +381,7 @@ class FlatParams(ParamSet):
         # would exceed the 8192 cap, recall suffers and the remedy is an
         # explicit SketchRerank or disabling the prefilter
         _spec("sketch_rerank", int, 0, "SketchRerank"),
+        # roofline/memory observability knobs; see _COMMON_TAIL_SPECS
+        _spec("roofline_probe", int, 0, "RooflineProbe"),
+        _spec("device_bytes_ledger", int, 1, "DeviceBytesLedger"),
     ]

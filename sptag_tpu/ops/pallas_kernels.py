@@ -27,6 +27,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from sptag_tpu.utils import costmodel
+
 _INTERPRET = False        # tests may flip this to run on CPU
 _DISABLED = False         # set when a kernel fails to compile on the backend
 _GROUP_DISABLED = False   # grouped kernel only (per-query kernel stays live)
@@ -216,3 +218,30 @@ def group_block_dots(data_perm: jax.Array, queries: jax.Array,
         interpret=interpret,
     )(union_c, queries, data_perm)
     return out.reshape(NG, U, G, P)
+
+
+# ---------------------------------------------------------------------------
+# cost-ledger entries (utils/costmodel.py; graftlint GL605).  The Pallas
+# kernels stream blocks through VMEM, so bytes here are the TRUE block
+# traffic (no materialized intermediate) — the whole point of the DMA
+# formulation (DESIGN.md §12).
+# ---------------------------------------------------------------------------
+
+def _probe_block_cost(Q, nprobe, P, D, itemsize=4, **_):
+    flops = 2.0 * Q * nprobe * P * D
+    nbytes = (Q * nprobe * P * D * itemsize + Q * D * itemsize
+              + Q * nprobe * P * 4)
+    return flops, nbytes
+
+
+def _group_block_cost(NG, U, G, P, D, itemsize=4, **_):
+    flops = 2.0 * NG * U * G * P * D
+    nbytes = (NG * U * P * D * itemsize + NG * G * D * itemsize
+              + NG * U * G * P * 4)
+    return flops, nbytes
+
+
+costmodel.register("pallas.probe_block_dots", probe_block_dots,
+                   _probe_block_cost)
+costmodel.register("pallas.group_block_dots", group_block_dots,
+                   _group_block_cost)

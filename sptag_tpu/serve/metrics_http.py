@@ -14,6 +14,15 @@ these when their `MetricsPort` is set:
   (utils/flightrec.py) as Chrome trace-event JSON, loadable directly in
   Perfetto / chrome://tracing.  Always answers 200; with the recorder
   off the trace is empty and ``otherData.counters.enabled`` is 0.
+* ``GET /debug/memory`` — the device-memory ledger (utils/devmem.py):
+  per-component resident bytes plus the ``jax.live_arrays()``
+  cross-check, so "what is holding the HBM" is one curl away.
+
+The /metrics exposition also carries the flight recorder's health
+counters (ring drops, dump errors, auto-dump rate-limit hits) as
+``flight_*`` gauges — they existed in ``flightrec.counters()`` but were
+invisible to scraping (ISSUE 6 satellite closing a PR-5 gap) — and the
+ledger's ``memory_device_bytes{component=…}`` gauges.
 
 Port semantics: 0 = disabled (the owner never constructs this), a
 negative port binds OS-ephemeral (tests read the bound port back from
@@ -34,9 +43,24 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
-from sptag_tpu.utils import flightrec, metrics
+from sptag_tpu.utils import devmem, flightrec, metrics
 
 log = logging.getLogger(__name__)
+
+
+def publish_flight_gauges() -> None:
+    """Mirror flightrec.counters() into the metrics registry at scrape
+    time — gauges rather than counters because the recorder's numbers
+    reset with configure()/reset() and a Prometheus counter must never
+    go backwards.  Names are literal (GL602)."""
+    c = flightrec.counters()
+    metrics.set_gauge("flight.enabled", c.get("enabled", 0))
+    metrics.set_gauge("flight.recorded", c.get("recorded", 0))
+    metrics.set_gauge("flight.dropped", c.get("dropped", 0))
+    metrics.set_gauge("flight.threads", c.get("threads", 0))
+    metrics.set_gauge("flight.dump_errors", c.get("dump_errors", 0))
+    metrics.set_gauge("flight.dump_ratelimited",
+                      c.get("dump_ratelimited", 0))
 
 
 class MetricsHttpServer:
@@ -57,8 +81,14 @@ class MetricsHttpServer:
             def do_GET(self):                            # noqa: N802
                 try:
                     if self.path.split("?")[0] == "/metrics":
-                        body = metrics.render_prometheus().encode()
+                        publish_flight_gauges()
+                        body = (metrics.render_prometheus()
+                                + devmem.render_prometheus()).encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
+                        code = 200
+                    elif self.path.split("?")[0] == "/debug/memory":
+                        body = json.dumps(devmem.snapshot()).encode()
+                        ctype = "application/json"
                         code = 200
                     elif self.path.split("?")[0] == "/debug/flight":
                         body = json.dumps(

@@ -479,6 +479,15 @@ class SearchServer:
             sched = ("slot_wait=%.2fms segments=%d refills=%d" % (
                 st.get("slot_wait_ms", 0.0), st.get("segments", 0),
                 st.get("refills", 0))) if st else "sched=-"
+            if st and "gflops" in st:
+                # roofline attribution (ISSUE 6 satellite): achieved
+                # GFLOP/s and %-of-peak over the query's own segments
+                # classify the slowness — low pct at high gflops means
+                # bandwidth-bound, low both with high slot_wait means
+                # scheduling-bound, high pct means genuinely compute-big
+                sched += " gflops=%.2f" % st["gflops"]
+                if "pct_peak" in st:
+                    sched += " pct_peak=%.3f" % st["pct_peak"]
             token = metrics.set_request_id(rid)
             try:
                 log.warning(

@@ -84,6 +84,7 @@ _ring_dropped = 0       # central-ring evictions (written under _reg_lock)
 _retired_recorded = 0
 _retired_dropped = 0
 _dump_errors = 0
+_dump_ratelimited = 0
 _dump_seq = 0
 _last_dump_mono = 0.0
 
@@ -165,7 +166,7 @@ def reset() -> None:
     """Restore defaults and drop everything (test isolation; wired into
     tests/conftest.py's autouse telemetry reset)."""
     global _enabled, _max_events, _dump_dir, _dump_max_files
-    global _epoch, _ring, _ring_dropped, _dump_errors
+    global _epoch, _ring, _ring_dropped, _dump_errors, _dump_ratelimited
     global _retired_recorded, _retired_dropped, _last_dump_mono
     global _dump_min_interval_s
     with _reg_lock:
@@ -181,6 +182,7 @@ def reset() -> None:
         _retired_recorded = 0
         _retired_dropped = 0
         _dump_errors = 0
+        _dump_ratelimited = 0
         _last_dump_mono = 0.0
     with _stats_lock:
         _query_stats.clear()
@@ -197,8 +199,10 @@ def counters() -> Dict[str, int]:
                 + sum(b.dropped for b in _buffers))
         threads = len(_buffers)
         derr = _dump_errors
+        drate = _dump_ratelimited
     return {"enabled": int(_enabled), "recorded": rec, "dropped": drop,
-            "threads": threads, "dump_errors": derr}
+            "threads": threads, "dump_errors": derr,
+            "dump_ratelimited": drate}
 
 
 # ---------------------------------------------------------------------------
@@ -392,24 +396,35 @@ def dump_to_file(reason: str, rid: str = "") -> Optional[str]:
     RINGED: at most `dump_max_files` `flight-*.json` files are kept,
     oldest deleted first — a slow-query storm cannot fill the disk.
     Returns the written path, or None when disabled/unconfigured."""
-    global _dump_seq, _dump_errors, _last_dump_mono
+    global _dump_seq, _dump_errors, _last_dump_mono, _dump_ratelimited
     if not _enabled or not _dump_dir:
         return None
     with _reg_lock:
         # rate limit: a failing batch fires one dump per response — the
         # ring barely changes between them, and serializing it 1024
-        # times would steal executor threads mid-incident
+        # times would steal executor threads mid-incident.  Hits are
+        # counted (and scraped as flight.dump_ratelimited) so a "why is
+        # the dump dir thin" post-mortem has its answer.
         now_mono = time.monotonic()
         if _dump_min_interval_s > 0 and \
                 now_mono - _last_dump_mono < _dump_min_interval_s:
+            _dump_ratelimited += 1
             return None
         _last_dump_mono = now_mono
         _dump_seq += 1
         seq = _dump_seq
     name = f"flight-{os.getpid()}-{seq:06d}.json"
     path = os.path.join(_dump_dir, name)
-    trace = export_chrome_trace(
-        other_data={"reason": reason, "rid": rid})
+    other: dict = {"reason": reason, "rid": rid}
+    if rid:
+        # per-query roofline attribution rides the dump (ISSUE 6): the
+        # scheduler's note_query_stats carries achieved GFLOP/s and
+        # %-of-peak, so the payload classifies the slow query without
+        # cross-referencing the log
+        st = query_stats(rid)
+        if st:
+            other["query_stats"] = dict(st)
+    trace = export_chrome_trace(other_data=other)
     try:
         os.makedirs(_dump_dir, exist_ok=True)
         with open(path, "w") as f:

@@ -46,11 +46,12 @@ def _reset_telemetry_registries():
     registries — all are process-global, so without this a span/counter/
     event assertion in one test would see every earlier test's serving
     traffic (and the suite's pass/fail would depend on execution order)."""
-    from sptag_tpu.utils import flightrec, metrics, trace
+    from sptag_tpu.utils import devmem, flightrec, metrics, trace
 
     trace.reset()
     metrics.reset()
     flightrec.reset()
+    devmem.reset()
     yield
 
 

@@ -37,6 +37,11 @@ UINT8_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
                              "ref_built_bkt_uint8cos_2000x16.tar.gz")
 
 
+# tiered suite (ISSUE 6 satellite, VERDICT §7): the A/B reference
+# fixture LADDERS are the suite's biggest compile sink (both
+# directions x four value types); nightly tier
+pytestmark = pytest.mark.slow
+
 @pytest.fixture(scope="module")
 def ref_index(tmp_path_factory):
     root = tmp_path_factory.mktemp("ab_ref")

@@ -72,6 +72,7 @@ def test_dense_only_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(d0, d1, rtol=1e-6)
 
 
+@pytest.mark.slow   # 8-device mesh build (tiered suite, ISSUE 6)
 def test_dense_only_sharded_mesh():
     """BuildGraph=0 flows through the mesh build: dense search works over
     8 shards, beam refuses — the 8-shard dense-only program is exactly

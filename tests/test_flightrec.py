@@ -41,7 +41,7 @@ def test_recorder_off_is_zero_work():
         pass
     c = flightrec.counters()
     assert c == {"enabled": 0, "recorded": 0, "dropped": 0, "threads": 0,
-                 "dump_errors": 0}
+                 "dump_errors": 0, "dump_ratelimited": 0}
     assert flightrec.collect() == []
 
 
@@ -555,6 +555,7 @@ def test_flight_off_parity_serve_bytes_and_zero_work():
         assert got == expected
         c = flightrec.counters()
         assert c == {"enabled": 0, "recorded": 0, "dropped": 0,
-                     "threads": 0, "dump_errors": 0}
+                     "threads": 0, "dump_errors": 0,
+                     "dump_ratelimited": 0}
     finally:
         t.stop()

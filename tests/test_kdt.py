@@ -166,6 +166,7 @@ def test_kdt_dense_mode_recall():
     assert i_beam.shape == (8, k)
 
 
+@pytest.mark.slow   # 50k x d100 build: the module's one big fixture
 def test_kdt_maxcheck_sweep_monotone_50k():
     """Recall-vs-budget monotonicity for the KDT beam path on a 50k
     uniform corpus — guards the up-front backtrack-budget approximation of

@@ -526,6 +526,113 @@ def test_gl603_literal_kind_and_dynamic_tier_clean():
 
 
 # ---------------------------------------------------------------------------
+# GL605 cost-ledger coverage (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_gl605_unregistered_jit_kernel_flagged():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def _my_kernel(x):\n"
+        "    return x\n"
+    )
+    found = lint_one(src, select=["GL605"])
+    assert rules_of(found) == ["GL605"]
+    assert "cost-ledger" in found[0].message
+
+
+def test_gl605_registered_kernel_clean():
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "from sptag_tpu.utils import costmodel\n"
+        "@functools.partial(jax.jit, static_argnames=('k',))\n"
+        "def _my_kernel(x, k):\n"
+        "    return x\n"
+        "def _cost(Q, k, **_):\n"
+        "    return 2.0 * Q, 4.0 * Q\n"
+        "costmodel.register('my.kernel', _my_kernel, _cost)\n"
+    )
+    assert lint_one(src, select=["GL605"]) == []
+
+
+def test_gl605_out_of_scope_module_not_flagged():
+    """The rule scopes to algo//ops — a jit helper in serve/ or utils/
+    is not a device kernel family."""
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def helper(x):\n"
+        "    return x\n"
+    )
+    assert lint_one(src, path="sptag_tpu/serve/snippet.py",
+                    select=["GL605"]) == []
+    assert lint_one(src, path="sptag_tpu/utils/snippet.py",
+                    select=["GL605"]) == []
+
+
+def test_gl605_cross_module_registration_satisfies_dispatch():
+    """jax.jit(other_module.fn) is satisfied by fn's registration in its
+    DEFINING module — the ledger is project-wide."""
+    sources = {
+        "sptag_tpu/ops/distance2.py": (
+            "from sptag_tpu.utils import costmodel\n"
+            "def row_fn(x):\n"
+            "    return x\n"
+            "def _cost(N, **_):\n"
+            "    return N, N\n"
+            "costmodel.register('d.row', row_fn, _cost)\n"),
+        "sptag_tpu/algo/engine2.py": (
+            "import jax\n"
+            "from sptag_tpu.ops import distance2 as dist_ops\n"
+            "sq = jax.jit(dist_ops.row_fn)\n"),
+    }
+    from tools.graftlint.runner import lint_sources as ls
+
+    assert ls(sources, select=["GL605"]) == []
+
+
+def test_gl605_jit_dispatch_of_unregistered_import_flagged():
+    src = (
+        "import jax\n"
+        "from sptag_tpu.ops import distance as dist_ops\n"
+        "_J = jax.jit(dist_ops.mystery_fn)\n"
+    )
+    found = lint_one(src, select=["GL605"])
+    assert rules_of(found) == ["GL605"]
+    assert "mystery_fn" in found[0].message
+
+
+def test_gl605_dynamic_family_name_flagged():
+    """A registered kernel with a NON-LITERAL family name still fails:
+    the ledger never expires a family (GL6xx cardinality)."""
+    src = (
+        "import jax\n"
+        "from sptag_tpu.utils import costmodel\n"
+        "@jax.jit\n"
+        "def _k(x):\n"
+        "    return x\n"
+        "name = 'fam'\n"
+        "costmodel.register(name, _k, lambda **s: (1.0, 1.0))\n"
+    )
+    found = lint_one(src, select=["GL605"])
+    assert rules_of(found) == ["GL605"]
+    assert "string literal" in found[0].message
+    # the family-literal hygiene applies OUTSIDE algo//ops too — the
+    # ledger is project-wide and never expires a family name
+    serve_src = (
+        "from sptag_tpu.utils import costmodel\n"
+        "def _k(x):\n"
+        "    return x\n"
+        "name = 'fam'\n"
+        "costmodel.register(name, _k, lambda **s: (1.0, 1.0))\n"
+    )
+    found = lint_one(serve_src, path="sptag_tpu/serve/snippet.py",
+                     select=["GL605"])
+    assert rules_of(found) == ["GL605"]
+
+
+# ---------------------------------------------------------------------------
 # baseline machinery + the tier-1 repo gate
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,228 @@
+"""Device-memory ledger (ISSUE 6): ownership/weakref semantics, the
+index-lifecycle consistency with jax.live_arrays(), and slot-pool
+retirement."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.utils import devmem
+
+
+# ---------------------------------------------------------------------------
+# unit semantics
+# ---------------------------------------------------------------------------
+
+class _Owner:
+    pass
+
+
+def test_track_untrack_and_component_totals():
+    a, b = _Owner(), _Owner()
+    devmem.track("corpus", a, 1000)
+    devmem.track("graph", a, 50)
+    devmem.track("corpus", b, 200)
+    assert devmem.component_bytes() == {"corpus": 1200, "graph": 50}
+    assert devmem.total_bytes() == 1250
+    devmem.untrack(a, "graph")
+    assert devmem.component_bytes() == {"corpus": 1200}
+    devmem.untrack(a)
+    assert devmem.component_bytes() == {"corpus": 200}
+
+
+def test_retrack_replaces_size():
+    a = _Owner()
+    devmem.track("slot_pool", a, 100)
+    devmem.track("slot_pool", a, 700)      # pool grew
+    assert devmem.component_bytes() == {"slot_pool": 700}
+
+
+def test_owner_death_releases_bytes():
+    a = _Owner()
+    devmem.track("corpus", a, 4096)
+    assert devmem.total_bytes() == 4096
+    del a
+    gc.collect()
+    assert devmem.total_bytes() == 0
+
+
+def test_disabled_ledger_is_a_noop():
+    devmem.configure(enabled=False)
+    try:
+        devmem.track("corpus", _Owner(), 123)
+        assert devmem.component_bytes() == {}
+    finally:
+        devmem.configure(enabled=True)
+
+
+def test_disabling_drops_live_entries():
+    """DeviceBytesLedger=0 on a warm process must not freeze gauges at
+    their pre-disable sizes: disabling clears the accounting."""
+    a = _Owner()
+    devmem.track("corpus", a, 4096)
+    devmem.configure(enabled=False)
+    try:
+        assert devmem.component_bytes() == {}
+        assert devmem.snapshot(with_live_arrays=False) == {
+            "enabled": False, "components": {},
+            "ledger_total_bytes": 0, "ledger_device_bytes": 0}
+    finally:
+        devmem.configure(enabled=True)
+
+
+def test_prometheus_rendering_carries_component_label():
+    a = _Owner()
+    devmem.track("dense_blocks", a, 12345)
+    b = _Owner()
+    devmem.track("slot_pool", b, 5000, host=True)
+    text = devmem.render_prometheus()
+    assert 'sptag_tpu_memory_device_bytes{component="dense_blocks"} 12345' \
+        in text
+    assert 'sptag_tpu_memory_device_bytes{component="slot_pool"} 5000' \
+        in text
+    assert "# TYPE sptag_tpu_memory_device_bytes gauge" in text
+    # the _ledger total is DEVICE bytes only (agrees with /debug/memory
+    # and may be compared against HBM capacity); host entries get _host
+    assert "sptag_tpu_memory_device_bytes_ledger 12345" in text
+    assert "sptag_tpu_memory_device_bytes_host 5000" in text
+
+
+def test_snapshot_cross_checks_live_arrays():
+    import jax.numpy as jnp
+
+    arr = jnp.ones((256, 4), jnp.float32)
+    devmem.track("corpus", arr, arr.nbytes)
+    snap = devmem.snapshot()
+    assert snap["components"]["corpus"] == arr.nbytes
+    assert snap["ledger_device_bytes"] <= snap["live_arrays_bytes"]
+    assert snap["untracked_bytes"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# index lifecycle: build -> add -> delete -> save -> load
+# ---------------------------------------------------------------------------
+
+def _flat_corpus_bytes(idx):
+    data_d, sqnorm_d, invalid_d = idx._snapshot()
+    return data_d.nbytes + sqnorm_d.nbytes + invalid_d.nbytes
+
+
+def test_flat_lifecycle_ledger_tracks_snapshots(tmp_path):
+    """The corpus component follows the live snapshot exactly through
+    build -> add -> delete -> save -> load, and the ledger total stays
+    bounded by jax.live_arrays() (the ground-truth cross-check)."""
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((100, 16)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+    idx.search_batch(data[:2], 3)          # materialize the snapshot
+    assert devmem.component_bytes()["corpus"] == _flat_corpus_bytes(idx)
+
+    idx.add(rng.standard_normal((40, 16)).astype(np.float32))
+    idx.search_batch(data[:2], 3)          # rebuild (dirty)
+    gc.collect()                           # old snapshot retires via GC
+    assert devmem.component_bytes()["corpus"] == _flat_corpus_bytes(idx)
+
+    idx.delete(data[3:4])
+    idx.search_batch(data[:2], 3)
+    gc.collect()
+    assert devmem.component_bytes()["corpus"] == _flat_corpus_bytes(idx)
+
+    folder = str(tmp_path / "saved")
+    assert idx.save_index(folder) == sp.ErrorCode.Success
+    del idx
+    gc.collect()
+    assert "corpus" not in devmem.component_bytes()
+
+    idx2 = sp.load_index(folder)
+    idx2.search_batch(data[:2], 3)
+    assert devmem.component_bytes()["corpus"] == _flat_corpus_bytes(idx2)
+
+    snap = devmem.snapshot()
+    assert snap["ledger_device_bytes"] <= snap["live_arrays_bytes"]
+
+
+def test_ledger_reenable_retracks_live_snapshots():
+    """DeviceBytesLedger 0 -> 1 on a WARM index repopulates the gauges
+    from the live snapshots (disable dropped every entry)."""
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((64, 8)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+    idx.search_batch(data[:1], 3)
+    assert devmem.component_bytes().get("corpus", 0) > 0
+    idx.set_parameter("DeviceBytesLedger", "0")
+    assert devmem.component_bytes() == {}
+    idx.set_parameter("DeviceBytesLedger", "1")
+    assert devmem.component_bytes()["corpus"] == _flat_corpus_bytes(idx)
+
+
+@pytest.fixture(scope="module")
+def bkt_cb_index():
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((120, 8)).astype(np.float32)
+    idx = sp.create_instance("BKT", "Float")
+    for p, v in [("DistCalcMethod", "L2"), ("BKTKmeansK", "4"),
+                 ("TPTNumber", "2"), ("TPTLeafSize", "16"),
+                 ("NeighborhoodSize", "8"), ("CEF", "32"),
+                 ("RefineIterations", "0"), ("SearchMode", "beam"),
+                 ("MaxCheck", "64"), ("BeamSegmentIters", "2"),
+                 ("ContinuousBatching", "1")]:
+        assert idx.set_parameter(p, v), p
+    idx.build(data)
+    yield idx, data
+    idx.close()
+
+
+def test_bkt_engine_components_register(bkt_cb_index):
+    idx, data = bkt_cb_index
+    # force a fresh engine snapshot: the autouse devmem reset wiped any
+    # entries a previously-materialized engine registered
+    with idx._lock:
+        idx._engine = None
+    eng = idx._get_engine()
+    comp = devmem.component_bytes()
+    assert comp["graph"] == eng.graph.nbytes
+    assert comp["tree"] == (eng.pivot_ids.nbytes + eng.pivot_vecs.nbytes
+                            + eng.pivot_mask.nbytes)
+    assert comp["corpus"] >= eng.data.nbytes
+
+
+def test_slot_pool_bytes_retire_with_retire(bkt_cb_index):
+    """Scheduler slot pools appear in the ledger while resident and are
+    released by retire() once the worker drains (the acceptance of the
+    memory-ledger satellite)."""
+    idx, data = bkt_cb_index
+    futs = idx.submit_batch(data[:4], 3)
+    for f in futs:
+        f.result()
+    assert devmem.component_bytes().get("slot_pool", 0) > 0
+    sched = idx._scheduler
+    assert sched is not None
+    sched.retire()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if devmem.component_bytes().get("slot_pool", 0) == 0:
+            break
+        time.sleep(0.05)
+    assert devmem.component_bytes().get("slot_pool", 0) == 0
+
+
+def test_int8_dense_blocks_component():
+    rng = np.random.default_rng(2)
+    data = rng.integers(-40, 40, (96, 16)).astype(np.int8)
+    idx = sp.create_instance("BKT", "Int8")
+    for p, v in [("DistCalcMethod", "Cosine"), ("BKTKmeansK", "4"),
+                 ("BuildGraph", "0"), ("BKTLeafSize", "16"),
+                 ("DenseClusterSize", "32"), ("SearchMode", "dense")]:
+        assert idx.set_parameter(p, v), p
+    idx.build(data)
+    idx.search_batch(data[:2].astype(np.int8), 3)
+    comp = devmem.component_bytes()
+    assert comp.get("int8_blocks", 0) > 0
+    assert "dense_blocks" not in comp

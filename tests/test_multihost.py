@@ -9,6 +9,7 @@ owned by the OTHER process.
 """
 
 import os
+import pytest
 import subprocess
 import sys
 import textwrap
@@ -61,6 +62,10 @@ _WORKER = textwrap.dedent("""
     print(f"proc {pid} OK hits={hits} dense={dhits}", flush=True)
 """)
 
+
+# tiered suite (ISSUE 6 satellite, VERDICT §7): multi-PROCESS mesh
+# bring-up — minutes of jax.distributed startup per test; nightly tier
+pytestmark = pytest.mark.slow
 
 def _free_port() -> int:
     import socket

@@ -19,6 +19,10 @@ PARAMS = {"BKTNumber": 1, "BKTKmeansK": 8, "TPTNumber": 4,
           "MaxCheck": 1024}
 
 
+# tiered suite (ISSUE 6 satellite, VERDICT §7): sharded BKT mesh builds
+# (10k-row fixtures x 8 virtual devices); nightly tier
+pytestmark = pytest.mark.slow
+
 def _corpus(n=4000, d=24, nq=64, seed=3):
     rng = np.random.default_rng(seed)
     centers = rng.standard_normal((32, d)).astype(np.float32) * 3.0

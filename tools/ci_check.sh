@@ -33,6 +33,15 @@ echo "== flight recorder off: serve byte parity (standalone) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_flightrec.py -q \
     -p no:cacheprovider -k "off_parity"
 
+# the ISSUE 6 observability gate, standalone: the cost ledger's
+# registered FLOPs/bytes formulas for the flat, dense and beam-segment
+# kernels must agree with XLA's own Compiled.cost_analysis() within
+# ±15% on the CPU backend — if this fails, every roofline %-of-peak
+# number the system publishes is untrustworthy
+echo "== cost ledger vs XLA cost_analysis (standalone, CPU) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel.py -q \
+    -p no:cacheprovider -k "crosscheck"
+
 echo "== tier-1 pytest (CPU backend) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

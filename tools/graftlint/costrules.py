@@ -1,0 +1,163 @@
+"""GL605 — cost-ledger coverage of device kernels.
+
+The roofline-observability subsystem (ISSUE 6) only works if EVERY
+device kernel has a registered analytic cost formula
+(utils/costmodel.py): an unregistered kernel silently runs outside the
+achieved-FLOP/s accounting, so "chip utilization" quietly regresses to
+"chip utilization of the kernels someone remembered".  GL605 is the
+static backstop:
+
+* every jit root under ``algo/`` / ``ops/`` (decorated ``@jax.jit`` /
+  ``functools.partial(jax.jit, ...)``, or a ``jax.jit(f)`` call site)
+  must be the kernel argument of a ``costmodel.register(<family>,
+  <kernel>, <formula>)`` call somewhere in the project;
+* ``jax.jit(other_module.fn)`` dispatch sites are satisfied by a
+  registration of ``fn`` in any module (the registry is project-wide);
+* a ``costmodel.register`` whose family argument is not a string
+  literal is flagged too — the ledger keys series off family names and
+  never expires one (the GL6xx cardinality argument).
+
+Escape hatch: a justified baseline entry (tools/graftlint/baseline.toml)
+— for kernels that genuinely sit outside the roofline story (build-time
+closures whose shapes never reach a perf report), with the justification
+saying WHY.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.graftlint.core import Finding, ModuleInfo, Project, _dotted
+
+RULES = {
+    "GL605": "device kernel has no cost-ledger entry — register an "
+             "analytic FLOPs/bytes formula (utils/costmodel.py) or "
+             "justify the exemption in the baseline",
+}
+
+_COSTMODEL_MODULE = "sptag_tpu.utils.costmodel"
+
+#: path fragments that scope the rule: the device-kernel packages
+_SCOPED = ("algo/", "ops/")
+
+
+def _is_register_call(call: ast.Call, mod: ModuleInfo) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (mod.resolve_head(func.value.id) == _COSTMODEL_MODULE
+                and func.attr == "register")
+    if isinstance(func, ast.Name):
+        return mod.from_imports.get(func.id, "") == \
+            _COSTMODEL_MODULE + ".register"
+    return False
+
+
+def _registered_names(project: Project) -> Set[str]:
+    """Project-wide set of kernel function names bound to a ledger entry
+    (the second argument of every costmodel.register call)."""
+    out: Set[str] = set()
+    for mod in project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not _is_register_call(node, mod):
+                continue
+            if len(node.args) >= 2:
+                target = node.args[1]
+                if isinstance(target, ast.Name):
+                    out.add(target.id)
+                elif isinstance(target, ast.Attribute):
+                    out.add(target.attr)
+    return out
+
+
+def _is_jax_jit_func(node: ast.AST, mod: ModuleInfo) -> bool:
+    d = _dotted(node)
+    if d is None:
+        return False
+    head, _, rest = d.partition(".")
+    full = mod.resolve_head(head)
+    if full is not None:
+        d = full + ("." + rest if rest else "")
+    return d.endswith("jax.jit") or (
+        d == "jit" and mod.from_imports.get("jit", "").endswith("jax.jit"))
+
+
+def _in_scope(mod: ModuleInfo) -> bool:
+    return any(frag in mod.relpath for frag in _SCOPED)
+
+
+def _enclosing(mod: ModuleInfo, lineno: int) -> str:
+    best, best_line = "", -1
+    for fn in mod.functions:
+        end = getattr(fn.node, "end_lineno", fn.node.lineno)
+        if fn.node.lineno <= lineno <= end and fn.node.lineno > best_line:
+            best, best_line = fn.qualname, fn.node.lineno
+    return best
+
+
+def check(project: Project) -> List[Finding]:
+    registered = _registered_names(project)
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        # register-call hygiene (part 3) applies EVERYWHERE the ledger
+        # is fed from — the registry is project-wide and never expires a
+        # family name
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not _is_register_call(node, mod):
+                continue
+            fam = node.args[0] if node.args else None
+            if fam is not None and not (
+                    isinstance(fam, ast.Constant)
+                    and isinstance(fam.value, str)):
+                out.append(Finding(
+                    "GL605", mod.relpath, node.lineno,
+                    "costmodel.register family name is not a string "
+                    "literal — the ledger never expires a family, so "
+                    "dynamic names make its cardinality unbounded",
+                    _enclosing(mod, node.lineno)))
+        if not _in_scope(mod):
+            # kernel-coverage checks (parts 1-2) scope to the device-
+            # kernel packages only
+            continue
+        seen_lines: Set[int] = set()
+        # 1) decorated jit roots must be registered by name
+        for fn in mod.functions:
+            if not fn.is_jit_root:
+                continue
+            if fn.name in registered:
+                continue
+            out.append(Finding(
+                "GL605", mod.relpath, fn.line,
+                f"jitted kernel `{fn.name}` has no cost-ledger entry — "
+                "costmodel.register a FLOPs/bytes formula so it appears "
+                "in roofline accounting (or baseline-justify it)",
+                fn.qualname))
+            seen_lines.add(fn.line)
+        # 2) jax.jit(<imported fn>) dispatch sites: the target must be
+        #    registered SOMEWHERE; local defs were covered above
+        local_names = {fn.name for fn in mod.functions}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not _is_jax_jit_func(node.func, mod):
+                continue
+            target = node.args[0]
+            name: Optional[str] = None
+            if isinstance(target, ast.Attribute):
+                name = target.attr
+            elif isinstance(target, ast.Name) and \
+                    target.id not in local_names:
+                name = target.id
+            if name is None or name in registered:
+                continue
+            if node.lineno in seen_lines:
+                continue
+            out.append(Finding(
+                "GL605", mod.relpath, node.lineno,
+                f"jax.jit dispatch of `{name}` has no cost-ledger entry "
+                "— register it in its defining module (or baseline-"
+                "justify it)", _enclosing(mod, node.lineno)))
+            seen_lines.add(node.lineno)
+    return out
