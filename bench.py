@@ -529,9 +529,12 @@ def timed_sweep(index, queries, k, batch, budget_s, repeats=3):
 
 
 def recall_at_k(ids_all, truth, k):
-    return float(np.mean([
-        len(set(ids_all[i]) & set(truth[i])) / k
-        for i in range(len(truth))]))
+    """Delegates to THE canonical recall definition (ISSUE 7 satellite):
+    utils/qualmon.py owns CalcRecall parity — bench, the IndexSearcher
+    CLI and the online estimator can no longer drift apart."""
+    from sptag_tpu.utils.qualmon import recall_at_k as _recall
+
+    return _recall(ids_all, truth, k)
 
 
 def _roofline_add(result, label, qps, est, batch_q, dtype="f32"):
@@ -702,6 +705,12 @@ def run_bench():
                                                     budget_s)
         recall = recall_at_k(ids_all, truth, k)
 
+        # recall-vs-QPS Pareto stage targets (ISSUE 7 satellite): the
+        # dense and beam engines sweep the SAME loaded headline index via
+        # stateless per-call overrides; int8 registers inside its stage
+        pareto_targets = [("dense", index, queries, truth, "dense"),
+                          ("beam", index, queries, truth, "beam")]
+
         result.update({
             "value": round(qps, 1),
             "vs_baseline": round(qps / cpu_qps, 2),
@@ -768,6 +777,8 @@ def run_bench():
                     "int8_group_effective": getattr(
                         idx8, "last_group_effective", None),
                 })
+                pareto_targets.append(("int8", idx8, queries8, truth8,
+                                       None))
                 try:
                     d8 = idx8._get_dense()
                     mc8 = int(idx8.params.max_check)
@@ -940,6 +951,50 @@ def run_bench():
                     del beam_index          # free the second corpus copy
             checkpoint()
 
+        # recall-vs-QPS Pareto stage (ISSUE 7 satellite): (MaxCheck,
+        # QPS, recall@10, Wilson CI) rows per engine from the canonical
+        # recall definition, under the PR-4 _stage_budget discipline —
+        # caps granted and points dropped are recorded, never silent.
+        # Stateless per-call overrides (max_check=/search_mode=) leave
+        # every index exactly as configured.
+        sb_par = _stage_budget(result, "pareto", budget_s, 180.0, 45.0)
+        if sb_par is not None:
+            from sptag_tpu.utils import qualmon
+
+            mcs = [int(t) for t in os.environ.get(
+                "BENCH_PARETO_MAXCHECKS", "256,1024,2048").split(",")]
+            pareto = {}
+            for label, idx_p, qs, tr, mode in pareto_targets:
+                rows = []
+                for mc in mcs:
+                    if _remaining(sb_par) < 15:
+                        result.setdefault("pareto_dropped", []).append(
+                            "%s@%d" % (label, mc))
+                        continue
+                    try:
+                        qn = min(len(qs), 512)
+                        idx_p.search_batch(qs[:qn], k, max_check=mc,
+                                           search_mode=mode)     # warm
+                        t0 = time.perf_counter()
+                        _, idsp = idx_p.search_batch(
+                            qs[:qn], k, max_check=mc, search_mode=mode)
+                        dt = time.perf_counter() - t0
+                        rec = recall_at_k(idsp, tr[:qn], k)
+                        lo, hi = qualmon.wilson(rec * qn * k, qn * k)
+                        rows.append({
+                            "max_check": mc,
+                            "qps": round(qn / dt, 1),
+                            "recall_at_10": round(rec, 4),
+                            "ci": [round(lo, 4), round(hi, 4)],
+                            "queries": qn,
+                        })
+                    except Exception as e:               # noqa: BLE001
+                        result.setdefault("pareto_errors", {})[
+                            "%s@%d" % (label, mc)] = repr(e)[:200]
+                if rows:
+                    pareto[label] = rows
+            result["quality_pareto"] = pareto
+            checkpoint()
 
         # host-span tracing report (utils/trace.py) — where the wall time
         # went, for the judge and for regression diffing.  The FULL report
@@ -952,8 +1007,11 @@ def run_bench():
         # (Index.FlightRecorder passthrough) records whether the ring
         # overflowed — an overflowed ring means the dump is a suffix of
         # the run, not the whole story
-        from sptag_tpu.utils import flightrec
+        from sptag_tpu.utils import flightrec, qualmon as _qualmon
         result["flight"] = flightrec.counters()
+        # quality-monitor accounting (ISSUE 7): sampling/shadow/drop
+        # counters next to the flight ring's, same rationale
+        result["quality"] = _qualmon.counters()
     except Exception as e:                               # noqa: BLE001
         import traceback
         result["error"] = repr(e)[:300]

@@ -637,6 +637,38 @@ class BKTIndex(VectorIndex):
             dynamic_pivots=p.other_dynamic_pivots,
             segment_iters=seg or None)
 
+    def _exact_scan(self, queries: np.ndarray, k: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Quality-monitor oracle (core/index.py exact_search_batch):
+        the exact FLAT/MXU scan over the engine snapshot's resident
+        corpus — zero extra HBM, and the graph/tree structures play no
+        part (this measures what the walk MISSED, so it must not share
+        the walk's blind spots)."""
+        return self._get_engine().exact_scan(queries, k)
+
+    def _health_payload(self) -> Optional[dict]:
+        """Graph navigability health (utils/qualmon.py graph_health):
+        degree histogram, sampled reciprocal-edge fraction, and the
+        fraction of live rows reachable from the tree seeds — the
+        numbers a budget-starved refine degrades first.  Scalars also
+        ride qualmon gauges so /metrics carries the time series."""
+        from sptag_tpu.utils import qualmon
+
+        if self._graph is None or self._graph.graph is None:
+            return None
+        n = self._n
+        health = qualmon.graph_health(self._graph.graph[:n],
+                                      self._deleted[:n], self._pivot_ids())
+        shard = getattr(self, "_quality_shard",
+                        type(self).__name__.lower())
+        qualmon.gauge("graph.mean_degree",
+                      health.get("degree_mean", 0.0), shard=shard)
+        qualmon.gauge("graph.reciprocal_fraction",
+                      health.get("reciprocal_fraction", 0.0), shard=shard)
+        qualmon.gauge("graph.reachable_fraction",
+                      health.get("reachable_fraction", 0.0), shard=shard)
+        return health
+
     def submit_batch(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
                      search_mode: Optional[str] = None,

@@ -845,6 +845,20 @@ class GraphSearchEngine:
         changes, which must not pay a full snapshot rebuild."""
         self.deleted = jnp.asarray(deleted[:self.n])
 
+    def exact_scan(self, queries: np.ndarray, k: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact FLAT/MXU top-k over THIS snapshot's corpus — the
+        quality monitor's ground-truth oracle for graph indexes
+        (utils/qualmon.py shadow path, via VectorIndex
+        .exact_search_batch).  Reuses the engine's already-resident
+        data/sqnorm/deleted arrays, so the shadow path costs zero extra
+        HBM, and rides the registered `flat.scan` kernel family — its
+        device work is ledger-attributed like every other dispatch."""
+        from sptag_tpu.algo.flat import exact_device_scan
+
+        return exact_device_scan(self.data, self.sqnorm, self.deleted,
+                                 queries, k, int(self.metric), self.base)
+
     # ---- walk configuration / scheduler surface ---------------------------
 
     def walk_plan(self, k: int, max_check: int, beam_width: int = 16,

@@ -74,6 +74,30 @@ def _flat_search_kernel(data, sqnorm, invalid, queries, k: int,
     return dists, ids
 
 
+def exact_device_scan(data_d, sqnorm_d, invalid_d, queries: np.ndarray,
+                      k: int, metric: int, base: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact masked scan at a bucketed query batch — THE ground-truth
+    oracle shared by FlatIndex and the graph indexes'
+    `exact_search_batch` (the quality monitor's shadow path,
+    utils/qualmon.py).  Always the exact kernel: ApproxTopK and
+    SketchPrefilter never apply here, whatever the index is configured
+    to serve with — an oracle that inherited the approximations it is
+    supposed to measure would be no oracle at all.  Rides the
+    registered `flat.scan` cost-ledger family (no new jit site)."""
+    q = queries.shape[0]
+    q_pad = _query_bucket(q)
+    if q_pad != q:
+        queries = np.concatenate(
+            [queries, np.zeros((q_pad - q, queries.shape[1]),
+                               queries.dtype)], axis=0)
+    k_eff = min(k, data_d.shape[0])
+    dists, ids = _flat_search_kernel(
+        data_d, sqnorm_d, invalid_d, jnp.asarray(queries), k_eff,
+        metric, base, approx=False)
+    return np.asarray(dists)[:q], np.asarray(ids)[:q]
+
+
 def _pack_sign_bits(centered: jax.Array) -> jax.Array:
     """(R, D) centered values -> (R, W) int32 packed sign bits, W =
     ceil(D/32).  Bit i of word w = sign(x[32w + i]) > 0; D is zero-padded
@@ -464,6 +488,15 @@ class FlatIndex(VectorIndex):
             dists = np.concatenate([dists, pad_d], axis=1)
             ids = np.concatenate([ids, pad_i], axis=1)
         return dists, ids
+
+    def _exact_scan(self, queries: np.ndarray, k: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Quality-monitor oracle (core/index.py exact_search_batch):
+        the cached device snapshot + the exact kernel, bypassing the
+        ApproxTopK / SketchPrefilter serving configuration."""
+        data_d, sqnorm_d, invalid_d = self._snapshot()
+        return exact_device_scan(data_d, sqnorm_d, invalid_d, queries, k,
+                                 int(self.dist_calc_method), self.base)
 
     # ---- refine / persistence ---------------------------------------------
 

@@ -518,9 +518,21 @@ class BeamSlotScheduler:
                         payload={"segments": item.segments,
                                  "refills": item.refills})
                 if item.rid:
+                    # iters vs t_budget is the quality monitor's triage
+                    # input (utils/qualmon.py classify_low_recall):
+                    # iters == budget means the walk was CUT OFF by
+                    # MaxCheck ("beam terminated early"), so both ride
+                    # the stats unconditionally, not only when the cost
+                    # ledger resolves
+                    # _replace=True: retire OWNS the query lifecycle —
+                    # a client-reused rid must not inherit the previous
+                    # query's verdict/roofline keys (flightrec merge
+                    # semantics; later annotators like qualmon merge)
                     stats = dict(
+                        _replace=True,
                         slot_wait_ms=round(item.slot_wait * 1000.0, 3),
-                        segments=item.segments, refills=item.refills)
+                        segments=item.segments, refills=item.refills,
+                        iters=iters_done[j], t_budget=int(item.t_limit))
                     if cost1 is not None:
                         it_n = iters_done[j]
                         exec_s = max(t_done - item.t_enq - item.slot_wait,
@@ -528,7 +540,6 @@ class BeamSlotScheduler:
                         q_flops = cost1.flops * it_n
                         q_bytes = cost1.hbm_bytes * it_n
                         stats["gflops"] = round(q_flops / exec_s / 1e9, 3)
-                        stats["iters"] = it_n
                         if cap is not None:
                             pct = cap.pct_of_peak(
                                 q_flops / exec_s, q_bytes / exec_s,

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import abc
 import errno
+import logging
 import os
 import shutil
 import threading
@@ -43,6 +44,8 @@ from sptag_tpu.core.vectorset import MetadataSet, VectorSet, metas_for
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.utils import locksan
 from sptag_tpu.utils.ini import IniReader
+
+log = logging.getLogger(__name__)
 
 # THE sentinel distance for empty/filtered result slots, shared with every
 # kernel module (ops/*, algo/*, graph/rng, parallel/*).  Must stay 3.4e38,
@@ -186,9 +189,17 @@ class VectorIndex(abc.ABC):
     def base(self) -> int:
         return base_of(self.value_type)
 
+    # quality-monitor knobs (utils/qualmon.py, ISSUE 7): process-wide,
+    # live-applied at set_parameter time for EVERY index family — the
+    # flight-recorder pattern; each maps to its own configure field so
+    # setting one never clobbers the others
+    _QUALITY_PARAMS = frozenset({"qualitysamplerate", "qualityrecallfloor",
+                                 "qualityshadowbudget", "qualitywindow"})
+
     def set_parameter(self, name: str, value: str) -> bool:
         ok = self.params.set_param(name, value)
-        if ok and name.lower() == "devicebytesledger":
+        low = name.lower()
+        if ok and low == "devicebytesledger":
             # process-wide device-memory ledger flag (utils/devmem.py):
             # applied directly, for EVERY index family — a registry-only
             # write would be a silent no-op on a warm index
@@ -203,6 +214,20 @@ class VectorIndex(abc.ABC):
                 # register the live ones so gauges come back without a
                 # rebuild (slot pools re-track on their next resize)
                 self._retrack_devmem()
+        if ok and low in self._QUALITY_PARAMS:
+            from sptag_tpu.utils import qualmon
+
+            p = self.params
+            qualmon.configure(
+                sample_rate=(float(getattr(p, "quality_sample_rate", 0.0))
+                             if low == "qualitysamplerate" else None),
+                recall_floor=(float(getattr(p, "quality_recall_floor", 0.0))
+                              if low == "qualityrecallfloor" else None),
+                shadow_budget_gflops=(
+                    float(getattr(p, "quality_shadow_budget", 0.0))
+                    if low == "qualityshadowbudget" else None),
+                window=(int(getattr(p, "quality_window", 0))
+                        if low == "qualitywindow" else None))
         return ok
 
     def _retrack_devmem(self) -> None:
@@ -277,6 +302,10 @@ class VectorIndex(abc.ABC):
             if ck is not None and not keep_checkpoint:
                 ck.clear()
                 self.last_checkpoint = None
+        # index-health metrics at every structural mutation (ISSUE 7):
+        # one flag test when off; the O(n) sweep runs on the shadow
+        # worker, never inline on the mutation path
+        self.publish_quality_health(background=True)
         return ErrorCode.Success
 
     def build_meta_mapping(self) -> None:
@@ -352,6 +381,118 @@ class VectorIndex(abc.ABC):
             futs.append(f)
         return futs
 
+    def _exact_scan(self, queries: np.ndarray, k: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact FLAT/MXU scan over this index's corpus (queries already
+        prepared) — subclass hook behind `exact_search_batch`.  FLAT
+        runs its cached snapshot; the graph indexes run their engine
+        snapshot's resident arrays (algo/engine.py exact_scan)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no exact-scan oracle")
+
+    def exact_search_batch(self, queries: np.ndarray, k: int = 10
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """Ground-truth exact top-k over this index's live corpus —
+        search_batch's contract ((Q, k) dists/ids, MAX_DIST / -1
+        padded, deleted rows excluded), but ALWAYS the exact masked
+        FLAT/MXU scan regardless of the configured search mode or any
+        approximation knobs.  This is the oracle the quality monitor's
+        shadow path replays sampled queries through (utils/qualmon.py),
+        and the in-process truth source for recall tests."""
+        if self.num_samples == 0:
+            raise RuntimeError("index is empty")
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.shape[1] != self.feature_dim:
+            raise ValueError(
+                f"query dim {queries.shape[1]} != index dim "
+                f"{self.feature_dim}")
+        queries = self._prepare_query(queries)
+        k_eff = min(k, self.num_samples)
+        dists, ids = self._exact_scan(queries, k_eff)
+        if dists.shape[1] < k:
+            q = dists.shape[0]
+            dists = np.concatenate(
+                [dists, np.full((q, k - dists.shape[1]), MAX_DIST,
+                                np.float32)], axis=1)
+            ids = np.concatenate(
+                [ids, np.full((q, k - ids.shape[1]), -1, np.int32)],
+                axis=1)
+        return dists, ids
+
+    # ---- quality health (utils/qualmon.py, ISSUE 7) -----------------------
+
+    def publish_quality_health(self, shard: Optional[str] = None,
+                               background: bool = False) -> None:
+        """Publish this index's health metrics to the quality monitor
+        (deleted fraction, sample count; graph indexes add degree /
+        reciprocity / reachability via `_health_payload`).  `shard`
+        names the series (a serving tier passes its index name and the
+        label sticks for later mutation-path republishes).  No-op with
+        the monitor off; never raises — health must not break serving
+        or mutation paths.
+
+        `background=True` (the mutation-path hooks) runs the sweep on
+        the quality monitor's shadow worker instead of the caller's
+        thread: `_health_payload` is O(n) host numpy (reciprocity
+        gather + reachability BFS over the whole graph) and must not be
+        paid inline per add/delete.  A pending-flag debounce coalesces
+        mutation storms into one sweep per queue drain — the job reads
+        CURRENT index state at run time, so the final state is always
+        the one published."""
+        from sptag_tpu.utils import qualmon
+
+        if shard is not None:
+            self._quality_shard = str(shard)
+        if not qualmon.enabled():
+            return
+        label = getattr(self, "_quality_shard",
+                        type(self).__name__.lower())
+        if background:
+            if getattr(self, "_health_job_pending", False):
+                return
+            self._health_job_pending = True
+
+            def job():
+                # label resolved at RUN time, like the index state: a
+                # debounced storm publishes the final label, not the
+                # one current when the pending job was queued
+                try:
+                    self._publish_health_now(
+                        getattr(self, "_quality_shard",
+                                type(self).__name__.lower()))
+                finally:
+                    self._health_job_pending = False
+            if not qualmon.submit(job):
+                self._health_job_pending = False
+            return
+        self._publish_health_now(label)
+
+    def _publish_health_now(self, label: str) -> None:
+        from sptag_tpu.utils import qualmon
+
+        try:
+            n = self.num_samples
+            payload = {"samples": int(n), "deleted": int(self.num_deleted)}
+            qualmon.gauge("index.samples", n, shard=label)
+            qualmon.gauge("index.deleted_fraction",
+                          (self.num_deleted / n) if n else 0.0,
+                          shard=label)
+            extra = self._health_payload()
+            if extra:
+                payload.update(extra)
+            qualmon.note_health(label, **payload)
+        except Exception:                                # noqa: BLE001
+            qualmon.inc("health_errors")
+            log.exception("quality health publish failed")
+
+    def _health_payload(self) -> Optional[dict]:
+        """Index-family health extras for /debug/quality (graph indexes
+        override with graph/reachability metrics).  Scalars worth a
+        time series should additionally ride `qualmon.gauge`."""
+        return None
+
     def _prepare_query(self, queries: np.ndarray) -> np.ndarray:
         """Queries are normalized for cosine, like the reference harness does
         at load (Utils::PrepareQuerys, CommonUtils.h:110-143)."""
@@ -398,6 +539,7 @@ class VectorIndex(abc.ABC):
                 # previously only applied to the first-add-as-build path,
                 # leaving delete_by_metadata dead after admin adds)
                 self.build_meta_mapping()
+        self.publish_quality_health(background=True)
         return ErrorCode.Success
 
     def delete(self, vectors) -> ErrorCode:
@@ -421,6 +563,8 @@ class VectorIndex(abc.ABC):
                             self._exact_distance(q, int(v)) <= DELETE_EPS:
                         self._delete_id(int(v))
                         found_any = True
+        if found_any:
+            self.publish_quality_health(background=True)
         return ErrorCode.Success if found_any else ErrorCode.VectorNotFound
 
     def _exact_distance(self, q: np.ndarray, vid: int) -> float:
@@ -462,6 +606,7 @@ class VectorIndex(abc.ABC):
     def refine_index(self) -> ErrorCode:
         with self._lock:
             self._refine_impl()
+        self.publish_quality_health(background=True)
         return ErrorCode.Success
 
     def merge_index(self, other: "VectorIndex") -> ErrorCode:

@@ -192,6 +192,21 @@ _COMMON_TAIL_SPECS = [
     # Process-wide, applied at set_parameter time; the ledger never
     # touches the request path, so serve bytes are identical either way
     _spec("device_bytes_ledger", int, 1, "DeviceBytesLedger"),
+    # search-quality monitor (utils/qualmon.py, ISSUE 7).  Process-wide
+    # like the flight-recorder knobs; live-applied via set_parameter on
+    # every index family, and mirrored as [Service] ini settings on the
+    # serve tiers.  QualitySampleRate: fraction of served queries
+    # shadow-replayed through the exact scan for online recall (0 = off
+    # — one flag test per query, serve bytes byte-identical);
+    # QualityRecallFloor: a sampled recall below this triggers triage
+    # (verdict in the slow-query stats + flight dump);
+    # QualityShadowBudget: GFLOP/s ceiling on shadow-scan device work
+    # (cost-ledger estimated; 0 = unbudgeted); QualityWindow: sliding-
+    # window length in samples for the recall gauges (0 = default 256)
+    _spec("quality_sample_rate", float, 0.0, "QualitySampleRate"),
+    _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
+    _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
+    _spec("quality_window", int, 0, "QualityWindow"),
 ]
 
 _FILE_SPECS = [
@@ -381,7 +396,12 @@ class FlatParams(ParamSet):
         # would exceed the 8192 cap, recall suffers and the remedy is an
         # explicit SketchRerank or disabling the prefilter
         _spec("sketch_rerank", int, 0, "SketchRerank"),
-        # roofline/memory observability knobs; see _COMMON_TAIL_SPECS
+        # roofline/memory/quality observability knobs; see
+        # _COMMON_TAIL_SPECS
         _spec("roofline_probe", int, 0, "RooflineProbe"),
         _spec("device_bytes_ledger", int, 1, "DeviceBytesLedger"),
+        _spec("quality_sample_rate", float, 0.0, "QualitySampleRate"),
+        _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
+        _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
+        _spec("quality_window", int, 0, "QualityWindow"),
     ]

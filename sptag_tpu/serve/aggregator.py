@@ -32,13 +32,14 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import functools
 import logging
 import time
 from typing import List, Optional, Tuple
 
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
-from sptag_tpu.utils import flightrec, metrics, trace
+from sptag_tpu.utils import flightrec, metrics, qualmon, trace
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -167,6 +168,52 @@ class RemoteServer:
                 fut.set_exception(OSError("connection dropped"))
 
 
+def _merge_quality_check(rid: str,
+                         per_server: List[List[wire.IndexSearchResult]],
+                         merged: List[wire.IndexSearchResult],
+                         rel_tol: float) -> None:
+    """Quality-monitor shadow job for the aggregator tier: per index
+    name, the fraction of the IDEAL union top-k (every shard entry,
+    globally sorted by distance) the merged list preserved.  Matching is
+    by DISTANCE at the merge's OWN tolerance (`MergeRelTol`) — vector
+    ids are shard-local and not comparable across backends.  A
+    kept-entry agreement below QualityRecallFloor is triaged as a merge
+    drop.
+
+    Names where any reply entry carries metadata are SKIPPED: metadata
+    is the merge's replica-collapse key, so there the raw union
+    legitimately contains one copy per replica and an undeduplicated
+    ideal would score the INTENDED collapse as lost recall — a
+    permanent false alarm on every replica deployment.  The check
+    therefore measures exactly what it can honestly measure: the
+    collapse-free merge path (shard topologies, the common case)."""
+    by_name: dict = {}
+    meta_names: set = set()
+    for results in per_server:
+        for r in results:
+            if r.metas is not None and any(r.metas):
+                meta_names.add(r.index_name)
+            by_name.setdefault(r.index_name, []).extend(
+                float(d) for v, d in zip(r.ids, r.dists) if v >= 0)
+    for m in merged:
+        union = by_name.get(m.index_name)
+        mdists = [float(d) for v, d in zip(m.ids, m.dists) if v >= 0]
+        if not union or not mdists or m.index_name in meta_names:
+            continue
+        k = len(mdists)
+        ideal = sorted(union)[:k]
+        agreement = qualmon.dist_recall(mdists, ideal, k,
+                                        rel_tol=max(rel_tol, 0.0))
+        verdict = detail = ""
+        floor = qualmon.recall_floor()
+        if floor > 0 and agreement < floor:
+            verdict = "merge_drop"
+            detail = ("dropped in the aggregator merge: kept %d of the "
+                      "union's top-%d" % (round(agreement * k), k))
+        qualmon.record_sample("merge", "aggregator", agreement, k,
+                              rid=rid, verdict=verdict, detail=detail)
+
+
 class AggregatorContext:
     def __init__(self, listen_addr: str = "0.0.0.0",
                  listen_port: int = 8100,
@@ -179,7 +226,11 @@ class AggregatorContext:
                  trace_requests: bool = True,
                  flight_recorder: bool = False,
                  flight_recorder_events: int = 0,
-                 flight_dump_on_slow_query: str = ""):
+                 flight_dump_on_slow_query: str = "",
+                 quality_sample_rate: float = 0.0,
+                 quality_recall_floor: float = 0.0,
+                 quality_shadow_budget: float = 0.0,
+                 quality_window: int = 0):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -203,6 +254,18 @@ class AggregatorContext:
         self.flight_recorder = flight_recorder
         self.flight_recorder_events = flight_recorder_events
         self.flight_dump_on_slow_query = flight_dump_on_slow_query
+        # search-quality monitor (utils/qualmon.py, ISSUE 7) — [Service]
+        # parity with the shard tier.  The aggregator has no corpus to
+        # replay against; its sampled check is the MERGE itself: with
+        # MergeTopK on, the merged top-k's distances are compared to the
+        # ideal top-k over the union of shard replies (ids are shard-
+        # local, distances are comparable), so a replica-collapse or
+        # merge bug that drops a better candidate is measured, triaged
+        # ("dropped in the aggregator merge") and flight-dumped.
+        self.quality_sample_rate = quality_sample_rate
+        self.quality_recall_floor = quality_recall_floor
+        self.quality_shadow_budget = quality_shadow_budget
+        self.quality_window = quality_window
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -236,6 +299,14 @@ class AggregatorContext:
                 "Service", "FlightRecorderEvents", "0")),
             flight_dump_on_slow_query=reader.get_parameter(
                 "Service", "FlightDumpOnSlowQuery", ""),
+            quality_sample_rate=float(reader.get_parameter(
+                "Service", "QualitySampleRate", "0")),
+            quality_recall_floor=float(reader.get_parameter(
+                "Service", "QualityRecallFloor", "0")),
+            quality_shadow_budget=float(reader.get_parameter(
+                "Service", "QualityShadowBudget", "0")),
+            quality_window=int(reader.get_parameter(
+                "Service", "QualityWindow", "0")),
         )
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
@@ -266,6 +337,12 @@ class AggregatorService:
                 enabled=True,
                 max_events=self.context.flight_recorder_events or None,
                 dump_dir=self.context.flight_dump_on_slow_query or None)
+        if self.context.quality_sample_rate > 0:
+            qualmon.configure(
+                sample_rate=self.context.quality_sample_rate,
+                recall_floor=self.context.quality_recall_floor,
+                shadow_budget_gflops=self.context.quality_shadow_budget,
+                window=self.context.quality_window or None)
         if self.context.metrics_port:
             # bind first: a metrics-port clash must fail start() before
             # backend connections, the reconnect task, or the listen
@@ -509,6 +586,18 @@ class AggregatorService:
             flightrec.record("aggregator", "merge", rid,
                              dur_ns=time.monotonic_ns() - t_merge0,
                              payload={"backends": len(targets)})
+        # merge-quality sampling (ISSUE 7): with MergeTopK on, compare
+        # the merged top-k against the ideal top-k over the union of
+        # shard replies on the quality monitor's background worker —
+        # one flag test here when the monitor is off, and the captured
+        # lists are never mutated after this point (read-only capture)
+        if qualmon.enabled() and self.context.merge_top_k \
+                and merged.status == wire.ResultStatus.Success \
+                and qualmon.maybe_sample():
+            qualmon.submit(functools.partial(
+                _merge_quality_check, rid,
+                [r for _, r, _ in replies], merged.results,
+                self.context.merge_rel_tol))
         return merged
 
     async def _query_one(self, idx: int, server: RemoteServer, body: bytes,

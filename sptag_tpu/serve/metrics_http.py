@@ -17,6 +17,14 @@ these when their `MetricsPort` is set:
 * ``GET /debug/memory`` — the device-memory ledger (utils/devmem.py):
   per-component resident bytes plus the ``jax.live_arrays()``
   cross-check, so "what is holding the HBM" is one curl away.
+* ``GET /debug/quality`` — the search-quality observatory
+  (utils/qualmon.py): online recall windows with Wilson bounds per
+  (searchmode, shard), per-shard index-health payloads (graph degrees,
+  reciprocity, seed reachability, deleted fraction) and the shadow-path
+  accounting.  Always answers 200; off shows ``enabled: false``.  An
+  aggregator sharing its process with shard tiers (tests, single-host)
+  sees every shard's windows merged; separate processes each expose
+  their own view.
 
 The /metrics exposition also carries the flight recorder's health
 counters (ring drops, dump errors, auto-dump rate-limit hits) as
@@ -43,7 +51,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
-from sptag_tpu.utils import devmem, flightrec, metrics
+from sptag_tpu.utils import devmem, flightrec, metrics, qualmon
 
 log = logging.getLogger(__name__)
 
@@ -82,12 +90,25 @@ class MetricsHttpServer:
                 try:
                     if self.path.split("?")[0] == "/metrics":
                         publish_flight_gauges()
+                        # quality windows render as labeled series the
+                        # shared registry can't express (the devmem
+                        # pattern); empty string when nothing recorded,
+                        # so the off-path exposition is unchanged
                         body = (metrics.render_prometheus()
-                                + devmem.render_prometheus()).encode()
+                                + devmem.render_prometheus()
+                                + qualmon.render_prometheus()).encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                         code = 200
                     elif self.path.split("?")[0] == "/debug/memory":
                         body = json.dumps(devmem.snapshot()).encode()
+                        ctype = "application/json"
+                        code = 200
+                    elif self.path.split("?")[0] == "/debug/quality":
+                        # search-quality observatory (utils/qualmon.py):
+                        # config, recall windows + Wilson bounds, per-
+                        # shard index health, triage counters.  Always
+                        # 200; off shows enabled=false and empty views
+                        body = json.dumps(qualmon.snapshot()).encode()
                         ctype = "application/json"
                         code = 200
                     elif self.path.split("?")[0] == "/debug/flight":

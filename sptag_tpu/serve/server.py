@@ -17,14 +17,15 @@ which is how the hardware wants its load delivered.
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
-from sptag_tpu.utils import flightrec, metrics, trace
+from sptag_tpu.utils import flightrec, metrics, qualmon, trace
 
 log = logging.getLogger(__name__)
 
@@ -47,7 +48,9 @@ class SearchServer:
                  max_response_tasks: int = 8,
                  flight_recorder: Optional[bool] = None,
                  flight_dump_dir: Optional[str] = None,
-                 flight_tier: str = "server"):
+                 flight_tier: str = "server",
+                 quality_sample_rate: Optional[float] = None,
+                 quality_recall_floor: Optional[float] = None):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -72,6 +75,15 @@ class SearchServer:
             flight_dump_dir if flight_dump_dir is not None
             else context.settings.flight_dump_on_slow_query)
         self.flight_tier = flight_tier
+        # search-quality monitor (utils/qualmon.py, ISSUE 7): process-
+        # wide like the flight recorder; ctor overrides are the test
+        # surface, [Service] QualitySampleRate/... the deployment one
+        self.quality_sample_rate = (
+            quality_sample_rate if quality_sample_rate is not None
+            else context.settings.quality_sample_rate)
+        self.quality_recall_floor = (
+            quality_recall_floor if quality_recall_floor is not None
+            else context.settings.quality_recall_floor)
         self._metrics_http: Optional[MetricsHttpServer] = None
         # reference parity: ConnectionManager hands out at most 256
         # connection slots (/root/reference/AnnService/inc/Socket/
@@ -121,6 +133,18 @@ class SearchServer:
                 max_events=self.context.settings.flight_recorder_events
                 or None,
                 dump_dir=self.flight_dump_dir or None)
+        if self.quality_sample_rate > 0:
+            qualmon.configure(
+                sample_rate=self.quality_sample_rate,
+                recall_floor=self.quality_recall_floor,
+                shadow_budget_gflops=self.context.settings
+                .quality_shadow_budget,
+                window=self.context.settings.quality_window or None)
+            # seed the per-shard health series under the serving index
+            # names (mutation paths republish under the same labels)
+            for name, index in self.context.indexes.items():
+                if hasattr(index, "publish_quality_health"):
+                    index.publish_quality_health(shard=name)
         if self.metrics_port:
             # bind the metrics listener FIRST: an EADDRINUSE here must
             # fail start() before the serve socket accepts or the batcher
@@ -507,6 +531,100 @@ class SearchServer:
             asyncio.get_event_loop().run_in_executor(
                 None, flightrec.dump_to_file,
                 "slow" if slow else "error", rid)
+        # online recall estimation (ISSUE 7): AFTER the response is on
+        # the wire — the shadow path never touches serve latency or
+        # bytes.  Off = this one flag test; on, the deterministic rate
+        # gate picks 1-in-N responses for background exact replay.
+        if qualmon.enabled() and query is not None \
+                and result.status == wire.ResultStatus.Success \
+                and qualmon.maybe_sample():
+            self._queue_quality_sample(rid, query.query, result)
+
+    def _queue_quality_sample(self, rid: str, text: str,
+                              result) -> None:
+        """Hand one served query to the quality monitor's shadow queue
+        (bounded, drop-on-overflow — never blocks the loop).  The job
+        captures only host data (query text + served ids/dists); the
+        exact-scan device work is charged against QualityShadowBudget
+        via the cost ledger's flat.scan estimate at the real shapes."""
+        served = [(r.index_name, [int(v) for v in r.ids],
+                   [float(d) for d in r.dists]) for r in result.results]
+        if not served:
+            return
+        est = 0.0
+        for name, ids, _d in served:
+            index = self.context.indexes.get(name)
+            if index is None:
+                continue
+            try:
+                from sptag_tpu.utils import costmodel
+
+                est += costmodel.estimate(
+                    "flat.scan", Q=1, N=index.num_samples,
+                    D=index.feature_dim, k=max(1, len(ids))).flops
+            except Exception:                            # noqa: BLE001
+                # estimate failure degrades to an unbudgeted (but still
+                # queue-bounded) submit — visible, never fatal
+                log.debug("quality shadow cost estimate failed for %s",
+                          name, exc_info=True)
+        qualmon.submit(
+            functools.partial(_shadow_replay, self.context, rid, text,
+                              served),
+            est_flops=est)
+
+
+def _shadow_replay(context: ServiceContext, rid: str, text: str,
+                   served: List[tuple]) -> None:
+    """Quality-monitor shadow job (runs on qualmon's worker thread,
+    never the serve loop): re-parse the sampled query, replay it
+    through each served index's exact FLAT/MXU scan, and fold the
+    canonical recall (reference CalcRecall semantics, distance ties
+    honored) into the (searchmode, shard) window.  A sample below
+    QualityRecallFloor is classified — beam budget exhausted (the
+    scheduler's per-rid it/t_limit), dense/sketch prefilter miss — and
+    triaged onto the slow-query stats + flight dump."""
+    parsed = protocol.parse_query(text)
+    for name, ids, dists in served:
+        index = context.indexes.get(name)
+        if index is None or not ids:
+            continue
+        vec = parsed.extract_vector(
+            parsed.data_type or index.value_type,
+            context.settings.vector_separator)
+        if vec is None or vec.shape[-1] != index.feature_dim:
+            continue
+        k = len(ids)
+        try:
+            ex_d, ex_ids = index.exact_search_batch(
+                vec.reshape(1, -1), k)
+        except (NotImplementedError, RuntimeError):
+            continue                     # no oracle / emptied mid-flight
+        mode = (parsed.search_mode
+                or getattr(index.params, "search_mode", "flat"))
+        # resolve "auto" to the engine that actually executed (beam vs
+        # dense is a MaxCheck crossover) — triage must blame the real
+        # engine, and the (mode, shard) window should key on it too
+        resolver = getattr(index, "resolve_search_mode", None)
+        if resolver is not None:
+            try:
+                mode = resolver(mode, parsed.max_check
+                                or int(getattr(index.params,
+                                               "max_check", 8192)))
+            except Exception:                            # noqa: BLE001
+                # unresolvable mode degrades to the wire/configured
+                # label — the sample still counts, only less precisely
+                log.debug("quality shadow mode resolve failed",
+                          exc_info=True)
+        sketch = bool(getattr(index.params, "sketch_prefilter", False))
+        recall = qualmon.recall_row(ids, ex_ids[0], k, dists=dists,
+                                    truth_dists=ex_d[0])
+        verdict = detail = ""
+        floor = qualmon.recall_floor()
+        if floor > 0 and recall < floor:
+            verdict, detail = qualmon.classify_low_recall(rid, mode,
+                                                          sketch=sketch)
+        qualmon.record_sample(mode, name, recall, k, rid=rid,
+                              verdict=verdict, detail=detail)
 
 
 def run_interactive(context: ServiceContext) -> None:

@@ -99,6 +99,19 @@ class ServiceSettings:
     flight_recorder: bool = False
     flight_recorder_events: int = 0
     flight_dump_on_slow_query: str = ""
+    # search-quality monitor (utils/qualmon.py, ISSUE 7): sample this
+    # fraction of served queries onto the background shadow path that
+    # replays them through the exact scan and publishes online
+    # quality.recall_at_k gauges (0 = off; off costs one flag test per
+    # query and the serve wire bytes stay byte-identical).  A sampled
+    # recall below QualityRecallFloor is triaged (verdict + flight
+    # dump); QualityShadowBudget bounds shadow device work in estimated
+    # GFLOP/s; QualityWindow sizes the sliding recall window (0 =
+    # module default).
+    quality_sample_rate: float = 0.0
+    quality_recall_floor: float = 0.0
+    quality_shadow_budget: float = 0.0
+    quality_window: int = 0
     # runtime lock sanitizer (utils/locksan.py): when on, locks created
     # from here on (index writer locks, client locks, thread pools) are
     # wrapped to detect lock-order inversions at runtime; the watchdog
@@ -161,6 +174,14 @@ class ServiceContext:
                 "Service", "FlightRecorderEvents", "0")),
             flight_dump_on_slow_query=reader.get_parameter(
                 "Service", "FlightDumpOnSlowQuery", ""),
+            quality_sample_rate=float(reader.get_parameter(
+                "Service", "QualitySampleRate", "0")),
+            quality_recall_floor=float(reader.get_parameter(
+                "Service", "QualityRecallFloor", "0")),
+            quality_shadow_budget=float(reader.get_parameter(
+                "Service", "QualityShadowBudget", "0")),
+            quality_window=int(reader.get_parameter(
+                "Service", "QualityWindow", "0")),
             lock_sanitizer=reader.get_parameter(
                 "Service", "LockSanitizer", "0").lower() in
             ("1", "true", "on", "yes", "strict"),

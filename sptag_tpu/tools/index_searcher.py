@@ -46,10 +46,12 @@ def load_truth(path: str, k: int) -> List[set]:
 
 
 def calc_recall(ids: np.ndarray, truth: List[set], k: int) -> float:
-    """Parity: CalcRecall (IndexSearcher/main.cpp:17-48)."""
-    hits = [len(set(int(v) for v in ids[i][:k] if v >= 0) & truth[i]) / k
-            for i in range(min(len(ids), len(truth)))]
-    return float(np.mean(hits)) if hits else 0.0
+    """Parity: CalcRecall (IndexSearcher/main.cpp:17-48).  Delegates to
+    THE canonical definition in utils/qualmon.py (ISSUE 7 satellite) —
+    the CLI, bench.py and the online estimator share one recall."""
+    from sptag_tpu.utils.qualmon import recall_at_k
+
+    return recall_at_k(ids, truth, k)
 
 
 def peak_rss_gb() -> float:

@@ -464,11 +464,26 @@ def note_query_stats(rid: str, **stats) -> None:
     recorder flag — the slow-query log reads these even with the ring
     off, so the log line and a flight dump always tell the same story.
     Called once per retired query (not per segment), so it is off the
-    per-iteration hot path by construction."""
+    per-iteration hot path by construction.
+
+    MERGE semantics (ISSUE 7): multiple producers annotate one rid —
+    the scheduler writes slot/iteration numbers at retire, the quality
+    monitor (utils/qualmon.py) adds its recall/triage verdict when the
+    shadow replay lands later — so keys UPDATE the existing dict rather
+    than replacing it; a later producer never erases an earlier one's
+    attribution.  The per-QUERY lifecycle owner (the scheduler's retire
+    path) passes `_replace=True` to start the rid's dict fresh: request
+    ids are client-supplied and REUSABLE, and without the reset point a
+    reused rid would carry the previous query's verdict/roofline keys
+    into the next query's slow-query log and flight dump."""
     if not rid:
         return
+    replace = stats.pop("_replace", False)
     with _stats_lock:
-        _query_stats[rid] = stats
+        cur = None if replace else _query_stats.get(rid)
+        if cur is None:
+            cur = _query_stats[rid] = {}
+        cur.update(stats)
         _query_stats.move_to_end(rid)
         while len(_query_stats) > _QUERY_STATS_CAP:
             _query_stats.popitem(last=False)

@@ -525,6 +525,61 @@ def test_gl603_literal_kind_and_dynamic_tier_clean():
     assert rules_of(lint_one(dirty, select=["GL603"])) == ["GL603"]
 
 
+def test_gl606_dynamic_quality_name_flagged():
+    """Quality-monitor series names are the cardinality-bounded surface
+    (ISSUE 7): the labeled exposition keys series off them and the
+    windows never expire a name — f-strings, concatenation and per-call
+    variables are flagged like GL601/602/603."""
+    src = (
+        "from sptag_tpu.utils import qualmon\n"
+        "def publish(component, value):\n"
+        "    qualmon.gauge(f'graph.{component}', value)\n"
+        "def count(kind):\n"
+        "    qualmon.inc('health_' + kind)\n"
+    )
+    found = lint_one(src, select=["GL606"])
+    assert rules_of(found) == ["GL606"]
+    assert len(found) == 2
+    assert "string literal" in found[0].message
+
+
+def test_gl606_literal_name_and_dynamic_labels_clean():
+    """Literal / module-constant names pass; the mode/shard LABELS are
+    out of scope (bounded by deployment — the flightrec tier argument
+    rationale), as are keyword and from-import forms with literals."""
+    src = (
+        "from sptag_tpu.utils import qualmon\n"
+        "from sptag_tpu.utils.qualmon import inc\n"
+        "NAME = 'graph.reachable_fraction'\n"
+        "def publish(shard, mode, value):\n"
+        "    qualmon.gauge('graph.mean_degree', value, shard=shard)\n"
+        "    qualmon.gauge(NAME, value, mode=mode, shard=shard)\n"
+        "    inc(name='health_errors')\n"
+    )
+    assert lint_one(src, select=["GL606"]) == []
+    dirty = (
+        "from sptag_tpu.utils.qualmon import gauge\n"
+        "def publish(name, value):\n"
+        "    gauge(name, value)\n"
+    )
+    assert rules_of(lint_one(dirty, select=["GL606"])) == ["GL606"]
+
+
+def test_gl606_out_of_family_qualmon_calls_clean():
+    """Only gauge/inc carry names; record_sample's mode/shard labels,
+    note_health's shard, and unrelated modules binding `qualmon` stay
+    out of scope."""
+    src = (
+        "from sptag_tpu.utils import qualmon\n"
+        "import contextlib as qualmon2\n"
+        "def sample(mode, shard, recall, rid):\n"
+        "    qualmon.record_sample(mode, shard, recall, 10, rid=rid)\n"
+        "    qualmon.note_health(shard, nodes=5)\n"
+        "    qualmon2.suppress(mode)\n"
+    )
+    assert lint_one(src, select=["GL606"]) == []
+
+
 # ---------------------------------------------------------------------------
 # GL605 cost-ledger coverage (ISSUE 6)
 # ---------------------------------------------------------------------------

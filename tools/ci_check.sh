@@ -33,6 +33,20 @@ echo "== flight recorder off: serve byte parity (standalone) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_flightrec.py -q \
     -p no:cacheprovider -k "off_parity"
 
+# the ISSUE 7 observability gate, standalone: with QualitySampleRate=0
+# (the default) the serve tier's wire bytes stay byte-identical and the
+# hot path performs one flag test per query — the quality monitor's
+# analog of the flight-recorder parity contract
+echo "== quality monitor off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_qualmon.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 7 lint gate, standalone: quality gauge/counter names passed
+# to qualmon must be string literals (GL606, the GL6xx cardinality
+# family) — a dynamic name would grow the labeled exposition unbounded
+echo "== GL606 quality-name lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL606
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

@@ -23,6 +23,13 @@ Rules:
   Chrome-trace export keys tracks off the kind and the ring never
   expires a name, so kinds are a bounded taxonomy by the same
   cardinality argument as GL601/602.
+* GL606 — the name argument of a quality-monitor series call
+  (`qualmon.gauge(name, ...)` / `qualmon.inc(name, ...)`) is not a
+  string literal or module-level string constant: the labeled quality
+  exposition keys series off the name and the windows never expire
+  one.  The `mode`/`shard` LABELS are out of scope — they are bounded
+  by deployment (search modes are an enum, shards come from the
+  service config), exactly like flightrec's tier argument.
 
 Calls are resolved through import aliases (`from sptag_tpu.utils import
 trace` / `import sptag_tpu.utils.metrics as metrics` / from-imports of the
@@ -45,21 +52,25 @@ RULES = {
              "names make metric cardinality unbounded",
     "GL603": "flight-recorder event kind is not a string literal — "
              "dynamic kinds make the event taxonomy unbounded",
+    "GL606": "quality-monitor series name is not a string literal — "
+             "dynamic names make the quality exposition unbounded",
 }
 
 _TRACE_MODULE = "sptag_tpu.utils.trace"
 _METRICS_MODULE = "sptag_tpu.utils.metrics"
 _FLIGHT_MODULE = "sptag_tpu.utils.flightrec"
+_QUALMON_MODULE = "sptag_tpu.utils.qualmon"
 
 _TRACE_FNS = {"span", "record"}
 _METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
                 "observe", "counter_value", "histogram_or_none"}
 _FLIGHT_FNS = {"record", "span"}
+_QUALMON_FNS = {"gauge", "inc"}
 
 #: per-rule (positional index, keyword name) of the argument that must
 #: be a bounded string — GL60x's lint surface
 _NAME_ARG = {"GL601": (0, "name"), "GL602": (0, "name"),
-             "GL603": (1, "kind")}
+             "GL603": (1, "kind"), "GL606": (0, "name")}
 
 
 def _module_str_constants(mod: ModuleInfo) -> Set[str]:
@@ -88,6 +99,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL602"
         if full == _FLIGHT_MODULE and func.attr in _FLIGHT_FNS:
             return "GL603"
+        if full == _QUALMON_MODULE and func.attr in _QUALMON_FNS:
+            return "GL606"
         return None
     if isinstance(func, ast.Name):
         target = mod.from_imports.get(func.id, "")
@@ -98,6 +111,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL602"
         if modpath == _FLIGHT_MODULE and sym in _FLIGHT_FNS:
             return "GL603"
+        if modpath == _QUALMON_MODULE and sym in _QUALMON_FNS:
+            return "GL606"
     return None
 
 
