@@ -996,6 +996,21 @@ def run_bench():
             result["quality_pareto"] = pareto
             checkpoint()
 
+        # open-loop load-generator stage (ISSUE 8 satellite): serve the
+        # headline index through the REAL socket stack with admission
+        # control armed, ramp offered load past the knee, and report
+        # "QPS at SLO" plus how the overload defense responded (sheds /
+        # degraded responses / deadline drops) — the serving-capacity
+        # number the engine-level QPS figures above cannot give.
+        sb_load = _stage_budget(result, "loadgen", budget_s, 120.0, 40.0)
+        if sb_load is not None:
+            try:
+                result["loadgen"] = _loadgen_measure(
+                    index, queries, k, sb_load)
+            except Exception as e:                       # noqa: BLE001
+                result["loadgen_error"] = repr(e)[:300]
+            checkpoint()
+
         # host-span tracing report (utils/trace.py) — where the wall time
         # went, for the judge and for regression diffing.  The FULL report
         # (count/total/max plus registry-derived p50/p90/p99, including
@@ -1022,6 +1037,290 @@ def run_bench():
     except OSError:
         pass
     print(json.dumps(result), flush=True)
+
+
+def _loadgen_measure(index, queries, k, budget_s):
+    """Open-loop load-generator stage (ISSUE 8 satellite): drive a real
+    SearchServer (admission control ON, a default deadline armed) over
+    localhost with Zipfian key popularity, bursty modulated-Poisson
+    arrivals and mixed $resultnum/$maxcheck/$searchmode options, ramping
+    the OFFERED rate geometrically.  Open loop means arrivals never wait
+    for completions — the generator keeps sending at the schedule while
+    the server drowns, which is what real overload looks like (a
+    closed-loop client self-throttles and can never exceed capacity).
+
+    Reports "QPS at SLO": the highest offered rate whose answered p99
+    stayed under BENCH_LOADGEN_SLO_MS with nothing shed or unanswered —
+    plus per-step rows and the overload-defense counters (sheds,
+    degraded responses, deadline drops, hedges), so the BENCH json
+    records both the capacity number and HOW the server defended itself
+    past it."""
+    import socket as socket_mod
+    import threading
+
+    from sptag_tpu.serve import wire
+    from sptag_tpu.serve.server import SearchServer
+    from sptag_tpu.serve.service import ServiceContext, ServiceSettings
+    from sptag_tpu.utils import metrics as metrics_mod
+
+    slo_ms = float(os.environ.get("BENCH_LOADGEN_SLO_MS", "250"))
+    step_s = float(os.environ.get("BENCH_LOADGEN_STEP_S", "2"))
+    start_qps = float(os.environ.get("BENCH_LOADGEN_START_QPS", "64"))
+    max_qps = float(os.environ.get("BENCH_LOADGEN_MAX_QPS", "8192"))
+    out = {"slo_ms": slo_ms, "step_s": step_s, "steps": [],
+           "steps_dropped": []}
+
+    counter_names = ("server.admission_sheds", "admission.sheds",
+                     "admission.degraded_queries",
+                     "server.degraded_responses", "server.deadline_drops",
+                     "server.queue_full", "aggregator.hedges",
+                     "aggregator.hedge_wins")
+    base_counters = {nm: metrics_mod.counter_value(nm)
+                     for nm in counter_names}
+
+    settings = ServiceSettings(default_max_result=k,
+                               admission_control=True,
+                               deadline_ms=4.0 * slo_ms)
+    ctx = ServiceContext(settings)
+    ctx.add_index("main", index)
+    server = SearchServer(ctx, batch_window_ms=2.0, max_batch=128)
+    holder = {}
+    ready = threading.Event()
+
+    def _serve():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        holder["loop"] = loop
+
+        async def boot():
+            holder["addr"] = await server.start("127.0.0.1", 0)
+            ready.set()
+
+        # keep the boot-task reference (the test_serve gc lesson)
+        holder["boot"] = loop.create_task(boot())
+        loop.run_forever()
+
+    th = threading.Thread(target=_serve, daemon=True)
+    th.start()
+    if not ready.wait(30):
+        return {"error": "loadgen server failed to start"}
+    host, port = holder["addr"]
+
+    rng = np.random.default_rng(17)
+    nq = len(queries)
+    # Zipfian popularity over the query set (hot keys repeat, the way
+    # production traffic does)
+    zipf_p = 1.0 / np.arange(1, nq + 1, dtype=np.float64) ** 1.1
+    zipf_p /= zipf_p.sum()
+    text_cache = {}
+
+    def qtext(i, opt):
+        base = text_cache.get(i)
+        if base is None:
+            base = "|".join("%g" % x for x in queries[i])
+            text_cache[i] = base
+        return opt + base
+
+    # the mixed-option palette: k, MaxCheck and searchmode all vary, so
+    # the server's grouped execution sees a realistic shape mix
+    opts_palette = ["", "$resultnum:1 ", "$maxcheck:256 ",
+                    "$maxcheck:2048 ", "$searchmode:auto ",
+                    "$resultnum:1 $maxcheck:256 "]
+
+    sock = socket_mod.create_connection((host, port), timeout=10)
+    sock.settimeout(None)
+    pending = {}            # resource id -> send perf_counter
+    completions = {}        # resource id -> (latency_s, status, degraded)
+
+    def read_exact(n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("server closed")
+            buf += chunk
+        return buf
+
+    def receiver():
+        try:
+            while True:
+                head = wire.PacketHeader.unpack(
+                    read_exact(wire.HEADER_SIZE))
+                body = (read_exact(head.body_length)
+                        if head.body_length else b"")
+                t_sent = pending.pop(head.resource_id, None)
+                if t_sent is None:
+                    continue
+                lat = time.perf_counter() - t_sent
+                try:
+                    res = wire.RemoteSearchResult.unpack(body)
+                except Exception:                        # noqa: BLE001
+                    res = None
+                completions[head.resource_id] = (
+                    lat, res.status if res is not None else -1,
+                    bool(res is not None and res.degraded))
+        except OSError:
+            pass
+
+    rth = threading.Thread(target=receiver, daemon=True)
+    rth.start()
+    next_rid = [1]
+
+    def fire(text):
+        rid = next_rid[0]
+        next_rid[0] += 1
+        body = wire.RemoteQuery(text).pack()
+        head = wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, rid).pack()
+        pending[rid] = time.perf_counter()
+        sock.sendall(head + body)
+        return rid
+
+    try:
+        # warmup: one request per option combo, closed-loop, so the
+        # ramp measures serving, not first-shape XLA compiles
+        warm = [fire(qtext(i % nq, opt))
+                for i, opt in enumerate(opts_palette * 2)]
+        t_stop = time.perf_counter() + min(60.0,
+                                           max(_remaining(budget_s), 5.0))
+        while time.perf_counter() < t_stop and \
+                any(r in pending for r in warm):
+            time.sleep(0.05)
+        for r in warm:
+            completions.pop(r, None)
+
+        def run_step(offered, label=None):
+            n_req = int(min(offered * step_s, 4000))
+            # bursty modulated-Poisson arrivals: ~90% of the time at
+            # 0.8x the offered rate, bursts at 2.4x (mean ~= offered)
+            ts, t_cur, burst = [], 0.0, False
+            while len(ts) < n_req:
+                rate = offered * (2.4 if burst else 0.8)
+                t_cur += rng.exponential(1.0 / rate)
+                ts.append(t_cur)
+                if rng.random() < (0.09 if burst else 0.01):
+                    burst = not burst
+            keys = rng.choice(nq, size=n_req, p=zipf_p)
+            opt_ix = rng.integers(0, len(opts_palette), size=n_req)
+            rids = []
+            t0 = time.perf_counter()
+            for j in range(n_req):
+                # open loop: pace on the arrival schedule only — late
+                # sends catch up in a burst, they never skip
+                dt = ts[j] - (time.perf_counter() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+                rids.append(fire(qtext(int(keys[j]),
+                                       opts_palette[int(opt_ix[j])])))
+            send_s = time.perf_counter() - t0
+            t_drain = time.perf_counter() + max(2.0, 6.0 * slo_ms / 1000.0)
+            while time.perf_counter() < t_drain and \
+                    any(r in pending for r in rids):
+                time.sleep(0.02)
+            lat, sheds, degraded, timeouts, answered = [], 0, 0, 0, 0
+            for r in rids:
+                c = completions.pop(r, None)
+                if c is None:
+                    pending.pop(r, None)   # unanswered: stop tracking
+                    continue
+                answered += 1
+                l, status, deg = c
+                if status == wire.ResultStatus.Overloaded:
+                    sheds += 1
+                    continue               # a shed is not a latency sample
+                if status == wire.ResultStatus.Timeout:
+                    timeouts += 1
+                degraded += bool(deg)
+                lat.append(l)
+            unanswered = n_req - answered
+            p50 = float(np.percentile(lat, 50)) * 1e3 if lat else None
+            p99 = float(np.percentile(lat, 99)) * 1e3 if lat else None
+            row = {
+                "offered_qps": round(offered, 1),
+                "achieved_send_qps": round(n_req / max(send_s, 1e-9), 1),
+                "requests": n_req,
+                "answered": answered,
+                "unanswered": unanswered,
+                "p50_ms": round(p50, 2) if p50 is not None else None,
+                "p99_ms": round(p99, 2) if p99 is not None else None,
+                "sheds": sheds,
+                "degraded": degraded,
+                "deadline_timeouts": timeouts,
+            }
+            if label:
+                row["label"] = label
+            out["steps"].append(row)
+            ok = (p99 is not None and p99 <= slo_ms and sheds == 0
+                  and timeouts == 0 and unanswered == 0)
+            defended = sheds > 0 or degraded > 0 or timeouts > 0
+            return ok, defended
+
+        offered = start_qps
+        qps_at_slo = 0.0
+        saw_defense = False
+        while offered <= max_qps:
+            if _remaining(budget_s) < step_s + 5.0:
+                out["steps_dropped"].append(
+                    {"offered_qps": offered, "reason": "stage budget"})
+                break
+            ok, defended = run_step(offered)
+            saw_defense = saw_defense or defended
+            if ok:
+                qps_at_slo = offered
+            else:
+                break
+            offered *= 2.0
+        if offered > max_qps:
+            out["slo_never_exceeded"] = True
+        # deliberate overload probe: one step well past the knee so the
+        # BENCH json records the defense actually firing (sheds/degrade/
+        # deadline drops), not just the capacity number
+        if not saw_defense and _remaining(budget_s) >= step_s + 5.0:
+            _, defended = run_step(min(4.0 * offered, 4000.0 / step_s),
+                                   label="overload_probe")
+            saw_defense = saw_defense or defended
+        out["qps_at_slo"] = round(qps_at_slo, 1)
+        out["defense_observed"] = saw_defense
+        out["counters"] = {
+            nm: metrics_mod.counter_value(nm) - base_counters[nm]
+            for nm in counter_names}
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        import asyncio
+
+        loop = holder["loop"]
+        try:
+            asyncio.run_coroutine_threadsafe(server.stop(),
+                                             loop).result(timeout=10)
+        except Exception:                                # noqa: BLE001
+            pass
+
+        async def _shutdown():
+            # cancel leftover connection tasks and let their transports
+            # finish closing INSIDE the loop (the test_serve teardown
+            # lesson: a transport finalized against a stopped loop warns)
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await asyncio.sleep(0)
+
+        try:
+            asyncio.run_coroutine_threadsafe(_shutdown(),
+                                             loop).result(timeout=10)
+        except Exception:                                # noqa: BLE001
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(timeout=10)
+        loop.close()
+    return out
 
 
 def _beam_cb_measure(beam_index, queries, k, budget_s):

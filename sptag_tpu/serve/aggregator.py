@@ -34,9 +34,11 @@ import asyncio
 import dataclasses
 import functools
 import logging
+import random
 import time
 from typing import List, Optional, Tuple
 
+from sptag_tpu.serve import admission as admission_mod
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.utils import flightrec, metrics, qualmon, trace
@@ -44,6 +46,9 @@ from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
 
+#: the reference's fixed re-dial sweep interval (AggregatorService.cpp:
+#: 139-194) — now the DEFAULT CAP of the per-server exponential backoff
+#: (`ReconnectCapS`); the first retry after a drop is near-immediate
 RECONNECT_INTERVAL_S = 30.0
 
 
@@ -133,6 +138,17 @@ class RemoteServer:
     replica_group: Optional[str] = None
     reader: Optional[asyncio.StreamReader] = None
     writer: Optional[asyncio.StreamWriter] = None
+    # per-backend latency distribution (an UNREGISTERED Histogram
+    # instance — the registry's names must be literals/bounded, and the
+    # backend set is config-bounded here instead).  Feeds the hedge
+    # trigger and GET /debug/admission.
+    latency: metrics.Histogram = dataclasses.field(
+        default_factory=lambda: metrics.Histogram("backend"))
+    # reconnect backoff state (capped exponential + jitter; see
+    # _reconnect_loop): 0 backoff = dial immediately
+    backoff_s: float = 0.0
+    next_dial: float = 0.0
+    reconnect_attempts: int = 0
     # in-flight requests keyed by resource_id — the asyncio analog of the
     # reference's ResourceManager callback registry
     # (inc/Socket/ResourceManager.h:31-184).  A dedicated reader task
@@ -230,7 +246,22 @@ class AggregatorContext:
                  quality_sample_rate: float = 0.0,
                  quality_recall_floor: float = 0.0,
                  quality_shadow_budget: float = 0.0,
-                 quality_window: int = 0):
+                 quality_window: int = 0,
+                 admission_control: bool = False,
+                 admission_degrade_queue_frac: float = 0.5,
+                 admission_shed_queue_frac: float = 0.9,
+                 admission_degrade_slot_wait_ms: float = 250.0,
+                 admission_shed_slot_wait_ms: float = 1000.0,
+                 admission_fair_share: float = 0.5,
+                 admission_recover_hold_ms: float = 2000.0,
+                 max_inflight: int = 1024,
+                 degrade_max_check_floor: int = 512,
+                 deadline_ms: float = 0.0,
+                 hedge_percentile: float = 95.0,
+                 hedge_budget: float = 0.0,
+                 hedge_min_ms: float = 1.0,
+                 reconnect_base_ms: float = 250.0,
+                 reconnect_cap_s: float = RECONNECT_INTERVAL_S):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -266,6 +297,38 @@ class AggregatorContext:
         self.quality_recall_floor = quality_recall_floor
         self.quality_shadow_budget = quality_shadow_budget
         self.quality_window = quality_window
+        # overload defense (serve/admission.py, ISSUE 8) — attribute
+        # names intentionally match ServiceSettings so
+        # admission.config_from_settings duck-types over both tiers.
+        # The aggregator's "queue" is its in-flight request count over
+        # `max_inflight`; its latency signal is its own request p99.
+        self.admission_control = admission_control
+        self.admission_degrade_queue_frac = admission_degrade_queue_frac
+        self.admission_shed_queue_frac = admission_shed_queue_frac
+        self.admission_degrade_slot_wait_ms = admission_degrade_slot_wait_ms
+        self.admission_shed_slot_wait_ms = admission_shed_slot_wait_ms
+        self.admission_fair_share = admission_fair_share
+        self.admission_recover_hold_ms = admission_recover_hold_ms
+        self.max_inflight = max_inflight
+        self.degrade_max_check_floor = degrade_max_check_floor
+        # default per-request deadline (ms of budget, re-anchored at
+        # arrival; 0 = none).  Requests carrying their own deadline —
+        # wire minor-2 trailer or the $deadlinems text option — keep it;
+        # the aggregator decrements the remaining budget into the
+        # forwarded bodies so shards drop work the client gave up on.
+        self.deadline_ms = deadline_ms
+        # hedged fan-out: when a backend's reply is slower than the
+        # fleet's `hedge_percentile` latency, duplicate the request to a
+        # replica (same ReplicaGroup; without groups, re-send to the
+        # same backend — other shards hold DIFFERENT corpus slices).
+        # First reply wins, the loser is deregistered.  `hedge_budget`
+        # caps hedges as a fraction of fan-out requests; 0 = hedging off.
+        self.hedge_percentile = hedge_percentile
+        self.hedge_budget = hedge_budget
+        self.hedge_min_ms = hedge_min_ms
+        # reconnect backoff (replaces the fixed 30 s sweep)
+        self.reconnect_base_ms = reconnect_base_ms
+        self.reconnect_cap_s = reconnect_cap_s
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -307,6 +370,38 @@ class AggregatorContext:
                 "Service", "QualityShadowBudget", "0")),
             quality_window=int(reader.get_parameter(
                 "Service", "QualityWindow", "0")),
+            admission_control=reader.get_parameter(
+                "Service", "AdmissionControl", "0").lower() in
+            ("1", "true", "on", "yes"),
+            admission_degrade_queue_frac=float(reader.get_parameter(
+                "Service", "AdmissionDegradeQueueFrac", "0.5")),
+            admission_shed_queue_frac=float(reader.get_parameter(
+                "Service", "AdmissionShedQueueFrac", "0.9")),
+            admission_degrade_slot_wait_ms=float(reader.get_parameter(
+                "Service", "AdmissionDegradeSlotWaitMs", "250")),
+            admission_shed_slot_wait_ms=float(reader.get_parameter(
+                "Service", "AdmissionShedSlotWaitMs", "1000")),
+            admission_fair_share=float(reader.get_parameter(
+                "Service", "AdmissionFairShare", "0.5")),
+            admission_recover_hold_ms=float(reader.get_parameter(
+                "Service", "AdmissionRecoverHoldMs", "2000")),
+            max_inflight=int(reader.get_parameter(
+                "Service", "AdmissionMaxInflight", "1024")),
+            degrade_max_check_floor=int(reader.get_parameter(
+                "Service", "DegradeMaxCheckFloor", "512")),
+            deadline_ms=float(reader.get_parameter(
+                "Service", "DeadlineMs", "0")),
+            hedge_percentile=float(reader.get_parameter(
+                "Service", "HedgePercentile", "95")),
+            hedge_budget=float(reader.get_parameter(
+                "Service", "HedgeBudget", "0")),
+            hedge_min_ms=float(reader.get_parameter(
+                "Service", "HedgeMinMs", "1")),
+            reconnect_base_ms=float(reader.get_parameter(
+                "Service", "ReconnectBaseMs", "250")),
+            reconnect_cap_s=float(reader.get_parameter(
+                "Service", "ReconnectCapS",
+                str(RECONNECT_INTERVAL_S))),
         )
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
@@ -321,11 +416,71 @@ class AggregatorContext:
 
 
 class AggregatorService:
-    def __init__(self, context: AggregatorContext):
+    def __init__(self, context: AggregatorContext,
+                 admission: Optional[
+                     admission_mod.AdmissionController] = None):
         self.context = context
         self._server: Optional[asyncio.AbstractServer] = None
         self._reconnect_task: Optional[asyncio.Task] = None
         self._metrics_http: Optional[MetricsHttpServer] = None
+        # overload defense (ISSUE 8): ctor-injected controller is the
+        # test surface, [Service] AdmissionControl the deployment one
+        if admission is not None:
+            self._admission: Optional[
+                admission_mod.AdmissionController] = admission
+            admission.bind_signals(self._admission_signals)
+        elif context.admission_control:
+            self._admission = admission_mod.AdmissionController(
+                admission_mod.config_from_settings(context),
+                signals=self._admission_signals)
+        else:
+            self._admission = None
+        self._inflight = 0
+        self._next_client = 1
+        # hedge budget accounting: hedges issued vs fan-out requests seen
+        self._fanouts = 0
+        self._hedges_issued = 0
+
+    def _admission_signals(self) -> dict:
+        """Aggregator pressure signals: in-flight fraction of the
+        admission cap, plus this tier's own request p99 (there is no
+        scheduler here — end-to-end latency IS the congestion signal)."""
+        h = metrics.histogram_or_none("aggregator.request")
+        return {
+            "queue_frac": self._inflight / max(self.context.max_inflight,
+                                               1),
+            "slot_wait_p99_ms": (h.percentile(99) * 1000.0
+                                 if h is not None else 0.0),
+            "occupancy": 0.0,
+        }
+
+    def _admission_debug(self) -> dict:
+        """GET /debug/admission payload: controller state, hedge
+        accounting, per-backend latency/backoff, deadline drops."""
+        out = {"enabled": self._admission is not None,
+               "tier": "aggregator"}
+        if self._admission is not None:
+            out.update(self._admission.snapshot())
+        out["hedge"] = {
+            "budget": self.context.hedge_budget,
+            "percentile": self.context.hedge_percentile,
+            "fanouts": self._fanouts,
+            "issued": self._hedges_issued,
+            "wins": metrics.counter_value("aggregator.hedge_wins"),
+            "budget_denied": metrics.counter_value(
+                "aggregator.hedge_budget_denied"),
+        }
+        out["backends"] = [
+            {"address": s.address, "port": s.port,
+             "connected": s.connected,
+             "backoff_s": round(s.backoff_s, 3),
+             "reconnect_attempts": s.reconnect_attempts,
+             "latency_p99_ms": round(s.latency.percentile(99) * 1000.0,
+                                     3)}
+            for s in self.context.servers]
+        out["deadline_drops"] = metrics.counter_value(
+            "aggregator.deadline_drops")
+        return out
 
     async def start(self, host: Optional[str] = None,
                     port: Optional[int] = None):
@@ -349,7 +504,8 @@ class AggregatorService:
             # socket exist (no half-started aggregator on error)
             self._metrics_http = MetricsHttpServer(
                 self.context.metrics_port, health=self._healthz,
-                host=self.context.metrics_host)
+                host=self.context.metrics_host,
+                admission=self._admission_debug)
             self._metrics_http.start()
         await self._connect_all()
         self._reconnect_task = asyncio.create_task(self._reconnect_loop())
@@ -415,6 +571,16 @@ class AggregatorService:
             while True:
                 head = await server.reader.readexactly(wire.HEADER_SIZE)
                 header = wire.PacketHeader.unpack(head)
+                if not 0 <= header.body_length <= wire.MAX_BODY_LENGTH:
+                    # a garbled/hostile length must not make this pump
+                    # buffer multi-GB — drop the connection (the backoff
+                    # loop re-dials; in-flight requests fail fast)
+                    metrics.inc("aggregator.malformed_backend_body")
+                    log.warning("backend %s:%d sent body_length %d over "
+                                "cap; dropping connection", server.address,
+                                server.port, header.body_length)
+                    server.drop()
+                    return
                 body = (await server.reader.readexactly(header.body_length)
                         if header.body_length else b"")
                 fut = server.pending.pop(header.resource_id, None)
@@ -430,20 +596,58 @@ class AggregatorService:
                                if not s.connected))
 
     async def _reconnect_loop(self) -> None:
-        """30 s re-dial of Disconnected servers
-        (AggregatorService.cpp:139-194)."""
+        """Re-dial Disconnected servers with capped exponential backoff +
+        jitter (ISSUE 8 satellite) — replaces the reference's fixed 30 s
+        sweep (AggregatorService.cpp:139-194).  A freshly dropped backend
+        is retried within one tick (fast first retry); a dead address
+        backs off to `ReconnectCapS` with ±50% jitter so a restarting
+        fleet does not thundering-herd it."""
+        base = max(self.context.reconnect_base_ms, 1.0) / 1000.0
+        cap = max(self.context.reconnect_cap_s, base)
+        now_fn = asyncio.get_event_loop().time
         while True:
-            await asyncio.sleep(RECONNECT_INTERVAL_S)
-            await self._connect_all()
+            for s in self.context.servers:
+                if s.connected or now_fn() < s.next_dial:
+                    continue
+                s.reconnect_attempts += 1
+                metrics.inc("aggregator.reconnect_attempts")
+                await self._connect(s)
+                if s.connected:
+                    metrics.inc("aggregator.reconnects")
+                    s.backoff_s = 0.0
+                else:
+                    s.backoff_s = min(cap, (s.backoff_s * 2.0) or base)
+                    s.next_dial = now_fn() + \
+                        s.backoff_s * random.uniform(0.5, 1.5)
+            down = [s for s in self.context.servers if not s.connected]
+            if down:
+                delay = min(max(s.next_dial - now_fn(), 0.0)
+                            for s in down)
+                delay = min(max(delay, 0.05), 1.0)
+            else:
+                # everything up: idle tick — a drop is noticed because
+                # drop() leaves next_dial in the past (fast first retry)
+                delay = 1.0
+            await asyncio.sleep(delay)
 
     # -------------------------------------------------------------- serving
 
     async def _on_client(self, reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
+        cid = self._next_client
+        self._next_client += 1
         try:
             while True:
                 head = await reader.readexactly(wire.HEADER_SIZE)
                 header = wire.PacketHeader.unpack(head)
+                if not 0 <= header.body_length <= wire.MAX_BODY_LENGTH:
+                    # the public listen socket is the MOST exposed framing
+                    # reader: a hostile header must not buffer multi-GB
+                    # before admission/decode ever run — drop the client
+                    metrics.inc("aggregator.malformed_packets")
+                    log.warning("client sent body_length %d over cap; "
+                                "closing", header.body_length)
+                    break
                 body = (await reader.readexactly(header.body_length)
                         if header.body_length else b"")
                 t = header.packet_type
@@ -463,12 +667,63 @@ class AggregatorService:
                     metrics.inc("aggregator.requests")
                     rec = flightrec.enabled()
                     t0 = time.perf_counter()
-                    body, rid = self._ensure_request_id(body)
-                    with trace.span("aggregator.scatter_gather"):
-                        result = await self._scatter_gather(body, rid)
+                    degraded = False
+                    if self._admission is not None:
+                        decision = self._admission.admit("conn-%d" % cid)
+                        if decision == admission_mod.SHED:
+                            # shed BEFORE the body is decoded or any
+                            # backend touched — a distinct status so
+                            # callers back off instead of retrying
+                            metrics.inc("aggregator.admission_sheds")
+                            if rec:
+                                flightrec.record("aggregator", "shed")
+                            shed = wire.RemoteSearchResult(
+                                wire.ResultStatus.Overloaded, []).pack()
+                            writer.write(wire.PacketHeader(
+                                wire.PacketType.SearchResponse,
+                                wire.PacketProcessStatus.Dropped,
+                                len(shed), header.connection_id,
+                                header.resource_id).pack() + shed)
+                            await writer.drain()
+                            continue
+                        degraded = decision == admission_mod.DEGRADE
+                    body, rid, deadline_mono = self._prepare_request(
+                        body, degraded)
+                    if deadline_mono is not None and \
+                            time.perf_counter() >= deadline_mono:
+                        # budget already spent before any fan-out
+                        metrics.inc("aggregator.deadline_drops")
+                        if rec:
+                            flightrec.record("aggregator",
+                                             "deadline_drop", rid)
+                        late = wire.RemoteSearchResult(
+                            wire.ResultStatus.Timeout, [], rid).pack()
+                        writer.write(wire.PacketHeader(
+                            wire.PacketType.SearchResponse,
+                            wire.PacketProcessStatus.Ok, len(late),
+                            header.connection_id,
+                            header.resource_id).pack() + late)
+                        await writer.drain()
+                        continue
+                    self._inflight += 1
+                    metrics.set_gauge("aggregator.inflight",
+                                      self._inflight)
+                    try:
+                        with trace.span("aggregator.scatter_gather"):
+                            result = await self._scatter_gather(
+                                body, rid, deadline_mono)
+                    finally:
+                        self._inflight -= 1
+                        metrics.set_gauge("aggregator.inflight",
+                                          self._inflight)
                     # prefer the id echoed back by a shard (proof the trace
                     # traversed a backend); fall back to the edge-minted one
                     result.request_id = result.request_id or rid
+                    if degraded and \
+                            result.status == wire.ResultStatus.Success:
+                        if wire.MARKER_DEGRADED not in result.markers:
+                            result.markers.append(wire.MARKER_DEGRADED)
+                        metrics.inc("aggregator.degraded_responses")
                     rbody = result.pack()
                     t_send0 = time.perf_counter() if rec else 0.0
                     writer.write(wire.PacketHeader(
@@ -519,36 +774,64 @@ class AggregatorService:
         finally:
             writer.close()
 
-    def _ensure_request_id(self, body: bytes) -> Tuple[bytes, str]:
-        """This is the id-minting EDGE: a body that already carries a wire
-        or text-channel id is forwarded untouched (the id rides to every
-        shard); an id-less body gets one minted and repacked.  A body that
-        does not decode rides through unchanged — malformed payloads stay
-        one backend's problem, as before."""
+    def _prepare_request(self, body: bytes, degraded: bool = False
+                         ) -> Tuple[bytes, str, Optional[float]]:
+        """The edge-preparation step: request-id minting (PR-2 contract:
+        a body already carrying a wire or text id rides untouched; an
+        id-less one gets minted+repacked unless TraceRequests opted out),
+        deadline resolution (wire trailer > $deadlinems text option >
+        the [Service] DeadlineMs default; the REMAINING budget is stamped
+        into the forwarded body so shards drop work the client gave up
+        on) and the degrade clamp (a degraded query's $maxcheck is
+        clamped down to DegradeMaxCheckFloor in the TEXT — the layout
+        version is untouched, so this works for reference-exact
+        backends too).  Returns (body, rid, deadline_mono).  A body that
+        does not decode rides through unchanged — malformed payloads
+        stay one backend's problem, as before."""
         query = wire.RemoteQuery.unpack(body)
         if query is None:
-            return body, ""
-        if query.request_id:
-            # attacker-sized wire field: bound it before it reaches logs
-            # (each shard re-caps its own copy at its edge)
-            return body, query.request_id[:64]
-        rid = protocol.request_id_of(query.query)
-        if rid:
-            # the TEXT already carries the id to the shards; no repack
-            return body, rid
-        if not self.context.trace_requests:
-            # operator opted out of extending the wire layout toward
-            # backends that require reference-exact bodies
-            return body, ""
-        query.request_id = wire.new_request_id()
-        return query.pack(), query.request_id
+            return body, "", None
+        modified = False
+        # attacker-sized wire field: bound it before it reaches logs
+        # (each shard re-caps its own copy at its edge)
+        rid = query.request_id[:64]
+        if not rid:
+            rid = protocol.request_id_of(query.query) or ""
+            if not rid and self.context.trace_requests:
+                query.request_id = wire.new_request_id()
+                rid = query.request_id
+                modified = True
+        dl = query.deadline_ms or (protocol.deadline_of(query.query)
+                                   or 0.0)
+        if dl <= 0:
+            dl = self.context.deadline_ms
+        deadline_mono = None
+        if dl > 0:
+            deadline_mono = time.perf_counter() + dl / 1000.0
+            if query.deadline_ms > 0 or self.context.trace_requests:
+                # propagate as a wire trailer (the body was already
+                # extended, or the operator allows extending it);
+                # text-channel deadlines otherwise ride through as text
+                query.deadline_ms = dl
+                modified = True
+        if degraded:
+            floor = self.context.degrade_max_check_floor
+            mc = protocol.parse_query(query.query).max_check
+            if mc is None or mc > floor:
+                # the last $maxcheck token wins at the shard's parser,
+                # so appending clamps without disturbing anything else
+                query.query += " $maxcheck:%d" % floor
+                modified = True
+        return (query.pack() if modified else body), rid, deadline_mono
 
-    async def _scatter_gather(self, body: bytes, rid: str = ""
+    async def _scatter_gather(self, body: bytes, rid: str = "",
+                              deadline_mono: Optional[float] = None
                               ) -> wire.RemoteSearchResult:
         """Fan out to every Connected server; flat-merge the per-index
         lists; degrade status on timeout/network failure
         (AggregatorService.cpp:206-366).  `rid` tags the per-shard
-        fan-out and merge flight events."""
+        fan-out and merge flight events.  `deadline_mono` bounds the
+        per-shard wait to the client's remaining budget."""
         targets = [(i, s) for i, s in enumerate(self.context.servers)
                    if s.connected]
         metrics.set_gauge("aggregator.connected_backends", len(targets))
@@ -556,18 +839,31 @@ class AggregatorService:
             metrics.inc("aggregator.no_backend")
             return wire.RemoteSearchResult(wire.ResultStatus.FailedNetwork,
                                            [])
-        tasks = [self._query_one(i, s, body, rid) for i, s in targets]
+        timeout_s = self.context.search_timeout_s
+        if deadline_mono is not None:
+            timeout_s = max(min(timeout_s,
+                                deadline_mono - time.perf_counter()),
+                            0.001)
+        tasks = [self._query_one(i, s, body, rid, timeout_s)
+                 for i, s in targets]
         replies = await asyncio.gather(*tasks)
         rec = flightrec.enabled()
         t_merge0 = time.monotonic_ns() if rec else 0
         merged = wire.RemoteSearchResult(wire.ResultStatus.Success, [])
-        for status, results, shard_rid in replies:
+        for status, results, shard_rid, shard_markers in replies:
             if status != wire.ResultStatus.Success:
                 merged.status = status
             merged.results.extend(results)
             # a shard's echo proves the id made the full hop; keep the
             # first one so the client's response id traveled end to end
             merged.request_id = merged.request_id or shard_rid
+            # shard-stamped markers survive the merge: if ANY shard's
+            # admission control degraded its slice, the merged answer
+            # traded recall for survival and the client must know
+            for m in shard_markers:
+                if m not in merged.markers and \
+                        len(merged.markers) < wire.MAX_MARKERS:
+                    merged.markers.append(m)
         if self.context.merge_top_k:
             # declared-topology mode keys off the CONFIGURED servers, not
             # the connected subset: if any server declares a ReplicaGroup
@@ -578,7 +874,7 @@ class AggregatorService:
             declared = any(s.replica_group is not None
                            for s in self.context.servers)
             merged.results = merge_top_k(
-                [r for _, r, _ in replies],
+                [r for _, r, _, _ in replies],
                 rel_tol=self.context.merge_rel_tol,
                 replica_groups=([s.replica_group for _, s in targets]
                                 if declared else None))
@@ -596,16 +892,95 @@ class AggregatorService:
                 and qualmon.maybe_sample():
             qualmon.submit(functools.partial(
                 _merge_quality_check, rid,
-                [r for _, r, _ in replies], merged.results,
+                [r for _, r, _, _ in replies], merged.results,
                 self.context.merge_rel_tol))
         return merged
 
-    async def _query_one(self, idx: int, server: RemoteServer, body: bytes,
-                         req_id: str = ""):
+    async def _issue(self, server: RemoteServer, body: bytes):
+        """Register + send one request on a backend connection; returns
+        (future, resource id) or None when the backend is gone.  The
+        future resolves to (header, body) via the response pump."""
         rid = server.next_rid
         server.next_rid += 1
+        header = wire.PacketHeader(wire.PacketType.SearchRequest,
+                                   wire.PacketProcessStatus.Ok, len(body),
+                                   0, rid)
+        fut = asyncio.get_event_loop().create_future()
+        server.pending[rid] = fut
+        try:
+            async with server.wlock:
+                if server.writer is None:
+                    # a concurrent drop() (backend reset) beat us to the
+                    # lock; writer is gone and our future already failed
+                    server.pending.pop(rid, None)
+                    self._discard(fut)
+                    return None
+                server.writer.write(header.pack() + body)
+                await server.writer.drain()
+        except OSError:
+            server.pending.pop(rid, None)
+            self._discard(fut)
+            server.drop()
+            return None
+        return fut, rid
+
+    @staticmethod
+    def _discard(fut) -> None:
+        """Retrieve a dead attempt's exception so the loop never logs
+        'Future exception was never retrieved' — a concurrent drop()
+        may have failed the future we are abandoning."""
+        if fut.done() and not fut.cancelled():
+            fut.exception()
+
+    def _hedge_delay(self, timeout_s: float) -> Optional[float]:
+        """Seconds to wait on a backend before issuing the hedged
+        duplicate: the fleet latency histogram's HedgePercentile once
+        enough samples exist (a reply slower than that percentile is, by
+        definition, in the tail worth hedging), a quarter of the request
+        timeout while cold; floored at HedgeMinMs.  None = hedging off
+        (HedgeBudget 0, the default)."""
+        if self.context.hedge_budget <= 0:
+            return None
+        floor = max(self.context.hedge_min_ms, 0.0) / 1000.0
+        h = metrics.histogram_or_none("aggregator.backend_s")
+        if h is not None and h.count >= 16:
+            return max(h.percentile(self.context.hedge_percentile), floor)
+        return max(timeout_s / 4.0, floor)
+
+    def _hedge_allow(self) -> bool:
+        """Budget cap: hedges may not exceed HedgeBudget as a fraction
+        of fan-out requests (floored at one so a cold start can hedge
+        at all); past the cap the hedge is denied and counted."""
+        cap = max(1.0, self.context.hedge_budget * self._fanouts)
+        if self._hedges_issued < cap:
+            self._hedges_issued += 1
+            return True
+        metrics.inc("aggregator.hedge_budget_denied")
+        return False
+
+    def _hedge_target(self, server: RemoteServer
+                      ) -> Optional[RemoteServer]:
+        """Where the duplicate goes: a connected replica (same declared
+        ReplicaGroup) holds the same data and is the ideal target;
+        without groups every other backend is a DIFFERENT corpus slice,
+        so the only correct duplicate is a fresh request to the same
+        backend (which beats per-request flukes: a lost packet, one bad
+        queue draw — not a genuinely slow server)."""
+        if server.replica_group is not None:
+            for s in self.context.servers:
+                if s is not server and s.connected \
+                        and s.replica_group == server.replica_group:
+                    return s
+        return server if server.connected else None
+
+    async def _query_one(self, idx: int, server: RemoteServer, body: bytes,
+                         req_id: str = "",
+                         timeout_s: Optional[float] = None):
+        timeout_s = (timeout_s if timeout_s is not None
+                     else self.context.search_timeout_s)
         rec = flightrec.enabled()
         t_fan0 = time.monotonic_ns() if rec else 0
+        t0 = time.perf_counter()
 
         def fanout_event(status: int) -> None:
             # every exit of this fan-out — success, backend-gone,
@@ -620,53 +995,112 @@ class AggregatorService:
                                                    server.port),
                              "status": int(status)})
 
-        header = wire.PacketHeader(wire.PacketType.SearchRequest,
-                                   wire.PacketProcessStatus.Ok, len(body),
-                                   0, rid)
-        fut = asyncio.get_event_loop().create_future()
-        server.pending[rid] = fut
-        try:
-            async with server.wlock:
-                if server.writer is None:
-                    # a concurrent drop() (backend reset) beat us to the
-                    # lock; writer is gone and our future already failed
-                    server.pending.pop(rid, None)
-                    metrics.inc("aggregator.backend_failures")
-                    fanout_event(wire.ResultStatus.FailedNetwork)
-                    return wire.ResultStatus.FailedNetwork, [], ""
-                server.writer.write(header.pack() + body)
-                await server.writer.drain()
-            _, rbody = await asyncio.wait_for(
-                fut, self.context.search_timeout_s)
-            try:
-                result = wire.RemoteSearchResult.unpack(rbody)
-            except Exception:                            # noqa: BLE001
-                # a malformed backend body must cost one request, not the
-                # client's whole connection task — but stay observable:
-                # 100%-FailedNetwork from wire corruption must look
-                # different from connectivity loss in the logs
-                log.warning("malformed SearchResponse body from %s:%d "
-                            "(rid %d)", server.address, server.port, rid)
-                result = None
-            if result is None:
-                metrics.inc("aggregator.malformed_backend_body")
-                fanout_event(wire.ResultStatus.FailedNetwork)
-                return wire.ResultStatus.FailedNetwork, [], ""
-            fanout_event(result.status)
-            return result.status, result.results, result.request_id
-        except asyncio.TimeoutError:
-            # the connection stays up and aligned — the reader task will
-            # drop the late reply when it arrives (no resource_id match)
-            server.pending.pop(rid, None)
-            metrics.inc("aggregator.backend_timeouts")
-            fanout_event(wire.ResultStatus.Timeout)
-            return wire.ResultStatus.Timeout, [], ""
-        except OSError:
-            server.pending.pop(rid, None)
-            server.drop()
+        self._fanouts += 1
+        issued = await self._issue(server, body)
+        if issued is None:
             metrics.inc("aggregator.backend_failures")
             fanout_event(wire.ResultStatus.FailedNetwork)
-            return wire.ResultStatus.FailedNetwork, [], ""
+            return wire.ResultStatus.FailedNetwork, [], "", []
+        # attempts: (server, future, resource id) — the primary plus at
+        # most one hedged duplicate.  First healthy completion wins; the
+        # loser is DEREGISTERED (its late reply is read and discarded by
+        # resource id, the protocol's cancellation).
+        attempts = [(server, issued[0], issued[1])]
+        hedge_delay = self._hedge_delay(timeout_s)
+        end = t0 + timeout_s
+        hedged = False
+        winner = None
+        try:
+            while winner is None:
+                for _s, f, _r in attempts:
+                    if f.done() and not f.cancelled() \
+                            and f.exception() is None:
+                        winner = f
+                        break
+                if winner is not None:
+                    break
+                live = [f for _s, f, _r in attempts if not f.done()]
+                if not live:
+                    raise OSError("all attempts failed")
+                now = time.perf_counter()
+                if now >= end:
+                    raise asyncio.TimeoutError
+                wait_s = end - now
+                if not hedged and hedge_delay is not None:
+                    fire_at = t0 + hedge_delay
+                    if now >= fire_at:
+                        hedged = True
+                        target = self._hedge_target(server)
+                        if target is not None and self._hedge_allow():
+                            dup = await self._issue(target, body)
+                            if dup is None:
+                                # nothing was sent (replica dropped /
+                                # write failed): refund the budget so a
+                                # flaky-replica episode cannot lock
+                                # hedging out, and keep the counters
+                                # equal to hedges actually in flight
+                                self._hedges_issued -= 1
+                            else:
+                                metrics.inc("aggregator.hedges")
+                                if rec:
+                                    flightrec.record(
+                                        "aggregator", "hedge", req_id,
+                                        payload={"backend": "%s:%d" % (
+                                            target.address, target.port)})
+                                attempts.append((target, dup[0], dup[1]))
+                        continue
+                    wait_s = min(wait_s, fire_at - now)
+                await asyncio.wait(live, timeout=wait_s,
+                                   return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.TimeoutError:
+            # connections stay up and aligned — the reader tasks drop
+            # the late replies when they arrive (no resource_id match)
+            for s, f, r in attempts:
+                s.pending.pop(r, None)
+                self._discard(f)
+            metrics.inc("aggregator.backend_timeouts")
+            fanout_event(wire.ResultStatus.Timeout)
+            return wire.ResultStatus.Timeout, [], "", []
+        except OSError:
+            for s, f, r in attempts:
+                s.pending.pop(r, None)
+                self._discard(f)
+            metrics.inc("aggregator.backend_failures")
+            fanout_event(wire.ResultStatus.FailedNetwork)
+            return wire.ResultStatus.FailedNetwork, [], "", []
+        # first-wins: deregister the loser (cancellation in this
+        # protocol = the late reply dies unmatched at the pump)
+        for s, f, r in attempts:
+            if f is not winner:
+                s.pending.pop(r, None)
+                self._discard(f)
+                metrics.inc("aggregator.hedge_cancels")
+        if len(attempts) > 1 and winner is attempts[1][1]:
+            metrics.inc("aggregator.hedge_wins")
+        elapsed = time.perf_counter() - t0
+        metrics.observe("aggregator.backend_s", elapsed)
+        # instance histogram (config-bounded cardinality): feeds the
+        # hedge trigger's fleet view and /debug/admission
+        for s, f, _r in attempts:
+            if f is winner:
+                s.latency.observe(elapsed)
+        _, rbody = await winner        # done: resolves without suspending
+        try:
+            result = wire.RemoteSearchResult.unpack(rbody)
+        except Exception:                            # noqa: BLE001
+            # a malformed backend body must cost one request, not the
+            # client's whole connection task — but stay observable:
+            # 100%-FailedNetwork from wire corruption must look
+            # different from connectivity loss in the logs
+            log.warning("malformed SearchResponse body from %s:%d",
+                        server.address, server.port)
+            result = None
+        if result is None:
+            metrics.inc("aggregator.malformed_backend_body")
+            fanout_event(wire.ResultStatus.FailedNetwork)
+            return wire.ResultStatus.FailedNetwork, [], "", []
+        fanout_event(result.status)
+        return result.status, result.results, result.request_id, result.markers
 
 
 def main(argv=None) -> int:

@@ -27,6 +27,7 @@ Three client shapes, smallest first:
 from __future__ import annotations
 
 import concurrent.futures
+import random
 import socket
 import threading
 import time
@@ -34,7 +35,40 @@ from typing import List, Optional
 
 from sptag_tpu.serve import wire
 from sptag_tpu.serve.protocol import request_id_of
-from sptag_tpu.utils import flightrec, locksan
+from sptag_tpu.utils import flightrec, locksan, metrics
+
+#: auto-reconnect backoff bounds (ISSUE 8 satellite): search()'s
+#: re-dial of a dead server backs off exponentially from BASE to CAP
+#: with ±50% jitter instead of paying a full connect timeout per call —
+#: a dead backend costs one failed dial per backoff window, not one per
+#: request.  An explicit connect() always dials (and resets the state).
+RECONNECT_BASE_S = 0.05
+RECONNECT_CAP_S = 5.0
+
+
+class _DialBackoff:
+    """Shared auto-reconnect backoff state for the client shapes."""
+
+    def __init__(self):
+        self.backoff_s = 0.0
+        self.next_dial = 0.0
+
+    def suppressed(self, now: float) -> bool:
+        if now < self.next_dial:
+            metrics.inc("client.dials_suppressed")
+            return True
+        return False
+
+    def failed(self, now: float) -> None:
+        metrics.inc("client.reconnect_failures")
+        self.backoff_s = min(RECONNECT_CAP_S,
+                             (self.backoff_s * 2.0) or RECONNECT_BASE_S)
+        self.next_dial = now + self.backoff_s * random.uniform(0.5, 1.5)
+
+    def succeeded(self) -> None:
+        metrics.inc("client.reconnects")
+        self.backoff_s = 0.0
+        self.next_dial = 0.0
 
 
 class AnnClient:
@@ -51,6 +85,7 @@ class AnnClient:
         # see the unextended layout; explicit/text-channel ids still ride
         self.trace_requests = trace_requests
         self._sock: Optional[socket.socket] = None
+        self._backoff = _DialBackoff()
         # RLock: search() calls close() from inside its locked region on
         # error paths, and close() itself must hold the lock (the heartbeat
         # pump mutates _sock concurrently)
@@ -81,6 +116,7 @@ class AnnClient:
             except OSError:
                 sock.close()
                 raise
+            self._backoff.succeeded()
             self._sock = sock
             if header.packet_type == wire.PacketType.RegisterResponse:
                 self._remote_cid = header.connection_id
@@ -143,22 +179,35 @@ class AnnClient:
 
     def search(self, query: str,
                timeout_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> wire.RemoteSearchResult:
+               request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None
+               ) -> wire.RemoteSearchResult:
         """Send one text-protocol query; returns the RemoteSearchResult
         (status Timeout / FailedNetwork on failure, matching the
         aggregator's partial-result statuses).  Every request carries a
         request id — `request_id`, the query's own `$requestid` option, or
         a minted one — echoed back on `result.request_id` so one slow
         query is traceable through aggregator → shard logs (construct the
-        client with trace_requests=False for reference-exact bytes)."""
+        client with trace_requests=False for reference-exact bytes).
+        `deadline_ms` rides the wire body's minor-2 trailer: servers and
+        aggregators drop the query once that budget is spent instead of
+        computing an answer nobody is waiting for."""
         req_id = request_id or request_id_of(query) or \
             (wire.new_request_id() if self.trace_requests else "")
         rec = flightrec.enabled()
         t_send0 = time.monotonic_ns() if rec else 0
         if self._sock is None:
+            # auto-reconnect with backoff: a dead server costs one
+            # failed dial per backoff window, not one per search
+            now = time.monotonic()
+            if self._backoff.suppressed(now):
+                return wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedNetwork, [])
             try:
+                metrics.inc("client.reconnect_attempts")
                 self.connect()
             except OSError:
+                self._backoff.failed(time.monotonic())
                 return wire.RemoteSearchResult(
                     wire.ResultStatus.FailedNetwork, [])
         with self._lock:
@@ -170,7 +219,8 @@ class AnnClient:
                     wire.ResultStatus.FailedNetwork, [])
             rid = self._next_resource
             self._next_resource += 1
-            body = wire.RemoteQuery(query, request_id=req_id).pack()
+            body = wire.RemoteQuery(query, request_id=req_id,
+                                    deadline_ms=deadline_ms or 0.0).pack()
             header = wire.PacketHeader(
                 wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
                 len(body), self._remote_cid, rid)
@@ -217,6 +267,11 @@ class AnnClient:
     def _recv(self, sock: socket.socket):
         head = _read_exact(sock, wire.HEADER_SIZE)
         header = wire.PacketHeader.unpack(head)
+        if not 0 <= header.body_length <= wire.MAX_BODY_LENGTH:
+            # garbled/hostile length: fail the connection rather than
+            # buffering multi-GB (the caller's OSError path re-dials)
+            raise OSError("response body_length %d exceeds cap"
+                          % header.body_length)
         body = _read_exact(sock, header.body_length) \
             if header.body_length else b""
         return header, body
@@ -241,6 +296,7 @@ class PipelinedAnnClient:
         # see AnnClient: False = reference-exact request bytes
         self.trace_requests = trace_requests
         self._sock: Optional[socket.socket] = None
+        self._backoff = _DialBackoff()
         self._wlock = locksan.make_lock("PipelinedAnnClient._wlock")
         # guards _pending + _next_rid; never nests with _wlock — the
         # canonical order (registration, then locked send, then lock-free
@@ -285,6 +341,7 @@ class PipelinedAnnClient:
             # ...then blocking mode for the reader thread: request
             # timeouts are enforced by the waiters, not the socket
             sock.settimeout(None)
+            self._backoff.succeeded()
             self._sock = sock
             self._reader = threading.Thread(target=self._read_loop,
                                             args=(sock,), daemon=True)
@@ -323,6 +380,8 @@ class PipelinedAnnClient:
             while True:
                 head = _read_exact(sock, wire.HEADER_SIZE)
                 header = wire.PacketHeader.unpack(head)
+                if not 0 <= header.body_length <= wire.MAX_BODY_LENGTH:
+                    raise OSError("response body_length over cap")
                 body = _read_exact(sock, header.body_length) \
                     if header.body_length else b""
                 if header.packet_type != wire.PacketType.SearchResponse:
@@ -348,15 +407,25 @@ class PipelinedAnnClient:
 
     def search(self, query: str,
                timeout_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> wire.RemoteSearchResult:
+               request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None
+               ) -> wire.RemoteSearchResult:
         req_id = request_id or request_id_of(query) or \
             (wire.new_request_id() if self.trace_requests else "")
         rec = flightrec.enabled()
         t_send0 = time.monotonic_ns() if rec else 0
         if self._sock is None:
+            # auto-reconnect with backoff (see AnnClient.search): a dead
+            # server must not cost a connect timeout per request
+            now = time.monotonic()
+            if self._backoff.suppressed(now):
+                return wire.RemoteSearchResult(
+                    wire.ResultStatus.FailedNetwork, [])
             try:
+                metrics.inc("client.reconnect_attempts")
                 self.connect()
             except OSError:
+                self._backoff.failed(time.monotonic())
                 return wire.RemoteSearchResult(
                     wire.ResultStatus.FailedNetwork, [])
         ev = threading.Event()
@@ -365,7 +434,8 @@ class PipelinedAnnClient:
             rid = self._next_rid
             self._next_rid += 1
             self._pending[rid] = (ev, slot)
-        body = wire.RemoteQuery(query, request_id=req_id).pack()
+        body = wire.RemoteQuery(query, request_id=req_id,
+                                deadline_ms=deadline_ms or 0.0).pack()
         header = wire.PacketHeader(
             wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
             len(body), self._remote_cid, rid)
@@ -457,21 +527,25 @@ class AnnClientPool:
 
     def search(self, query: str,
                timeout_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> wire.RemoteSearchResult:
+               request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None
+               ) -> wire.RemoteSearchResult:
         # a closed pool must not serve: PipelinedAnnClient.search would
         # silently RE-DIAL the dropped socket, leaking a fresh connection
         # + reader thread from a pool the caller already tore down
         if self._closed:
             return wire.RemoteSearchResult(
                 wire.ResultStatus.FailedNetwork, [])
-        return self._pick().search(query, timeout_s, request_id=request_id)
+        return self._pick().search(query, timeout_s, request_id=request_id,
+                                   deadline_ms=deadline_ms)
 
     def search_async(self, query: str,
                      timeout_s: Optional[float] = None,
-                     request_id: Optional[str] = None
+                     request_id: Optional[str] = None,
+                     deadline_ms: Optional[float] = None
                      ) -> "concurrent.futures.Future[wire.RemoteSearchResult]":
         return self._executor.submit(self.search, query, timeout_s,
-                                     request_id)
+                                     request_id, deadline_ms)
 
     def close(self) -> None:
         self._closed = True

@@ -17,6 +17,11 @@ these when their `MetricsPort` is set:
 * ``GET /debug/memory`` — the device-memory ledger (utils/devmem.py):
   per-component resident bytes plus the ``jax.live_arrays()``
   cross-check, so "what is holding the HBM" is one curl away.
+* ``GET /debug/admission`` — the overload-defense subsystem
+  (serve/admission.py): admission state machine, per-client fair-share
+  shares, hedge and reconnect-backoff accounting and the active
+  fault-injection plan.  Always answers 200; with no controller the
+  payload shows ``enabled: false``.
 * ``GET /debug/quality`` — the search-quality observatory
   (utils/qualmon.py): online recall windows with Wilson bounds per
   (searchmode, shard), per-shard index-health payloads (graph degrees,
@@ -73,10 +78,14 @@ def publish_flight_gauges() -> None:
 
 class MetricsHttpServer:
     def __init__(self, port: int, health: Optional[Callable[[], Dict]] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 admission: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.health = health
+        # GET /debug/admission callback (serve/admission.py): overload-
+        # defense state, hedge/backoff accounting, fault-injection plan
+        self.admission = admission
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -109,6 +118,21 @@ class MetricsHttpServer:
                         # shard index health, triage counters.  Always
                         # 200; off shows enabled=false and empty views
                         body = json.dumps(qualmon.snapshot()).encode()
+                        ctype = "application/json"
+                        code = 200
+                    elif self.path.split("?")[0] == "/debug/admission":
+                        # overload defense (serve/admission.py): state
+                        # machine, fair-share shares, hedge + reconnect
+                        # accounting, fault-injection plan.  Always 200;
+                        # without a controller shows enabled=false.
+                        try:
+                            state = (owner.admission()
+                                     if owner.admission
+                                     else {"enabled": False})
+                        except Exception:                # noqa: BLE001
+                            log.exception("admission callback failed")
+                            state = {"enabled": False, "error": True}
+                        body = json.dumps(state).encode()
                         ctype = "application/json"
                         code = 200
                     elif self.path.split("?")[0] == "/debug/flight":

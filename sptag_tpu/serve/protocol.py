@@ -94,6 +94,22 @@ class ParsedQuery:
         return raw if 0 < len(raw) <= 64 else None
 
     @property
+    def deadline_ms(self) -> Optional[float]:
+        """The `$deadlinems` budget option — milliseconds the client is
+        still willing to wait, counted from the receiver's arrival (the
+        TEXT channel twin of the wire body's minor-2 deadline trailer,
+        for reference clients that cannot set body fields).  None when
+        absent/unparsable/non-positive."""
+        raw = self.options.get("deadlinems")
+        if raw is None:
+            return None
+        try:
+            v = float(raw)
+        except ValueError:
+            return None
+        return v if v > 0 else None
+
+    @property
     def search_mode(self) -> Optional[str]:
         """Per-request engine pick, "beam", "dense", or "auto" (framework
         extension; see module docstring).  "auto" resolves per request by
@@ -136,6 +152,15 @@ def request_id_of(text: str) -> Optional[str]:
     if "$requestid" not in text.lower():
         return None
     return parse_query(text).request_id
+
+
+def deadline_of(text: str) -> Optional[float]:
+    """The `$deadlinems` option of a query line, or None — same cheap
+    substring pre-check as `request_id_of` (the no-deadline fast path
+    is every request when the feature is off)."""
+    if "$deadlinems" not in text.lower():
+        return None
+    return parse_query(text).deadline_ms
 
 
 def parse_query(text: str) -> ParsedQuery:

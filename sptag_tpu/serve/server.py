@@ -22,19 +22,17 @@ import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
+from sptag_tpu.serve import admission as admission_mod
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
-from sptag_tpu.utils import flightrec, metrics, qualmon, trace
+from sptag_tpu.utils import faultinject, flightrec, metrics, qualmon, trace
 
 log = logging.getLogger(__name__)
 
 
-#: hard ceiling on a packet's declared body size.  The header's body_length
-#: is attacker-controlled; without a cap one hostile 16-byte header makes
-#: readexactly() buffer multi-GB.  64 MiB comfortably covers the largest
-#: legitimate body (a max_batch x dim float32 query block).
-MAX_BODY_LENGTH = 64 << 20
+#: body-size ceiling, shared with every framing reader (see wire.py)
+MAX_BODY_LENGTH = wire.MAX_BODY_LENGTH
 
 
 class SearchServer:
@@ -50,7 +48,11 @@ class SearchServer:
                  flight_dump_dir: Optional[str] = None,
                  flight_tier: str = "server",
                  quality_sample_rate: Optional[float] = None,
-                 quality_recall_floor: Optional[float] = None):
+                 quality_recall_floor: Optional[float] = None,
+                 admission: Optional[
+                     admission_mod.AdmissionController] = None,
+                 fault_spec: Optional[str] = None,
+                 fault_seed: Optional[int] = None):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -116,6 +118,50 @@ class SearchServer:
         # it a slow-reading client accumulates one task + encoded body
         # per streamed query across every batch in its drain window
         self._max_stream_tasks = max_batch
+        # overload defense (ISSUE 8, serve/admission.py): the controller
+        # reads queue fill + scheduler slot-wait p99 + pool occupancy and
+        # moves normal -> degrade -> shed; ctor override is the test
+        # surface, [Service] AdmissionControl the deployment one.  None =
+        # off: one `is None` test per request.
+        if admission is not None:
+            self.admission: Optional[
+                admission_mod.AdmissionController] = admission
+            admission.bind_signals(self._admission_signals)
+        elif context.settings.admission_control:
+            self.admission = admission_mod.AdmissionController(
+                admission_mod.config_from_settings(context.settings),
+                signals=self._admission_signals)
+        else:
+            self.admission = None
+        # default per-request deadline (requests carrying their own —
+        # wire trailer or $deadlinems text option — keep it)
+        self.deadline_ms = context.settings.deadline_ms
+        # wire-layer fault injection (utils/faultinject.py): a per-server
+        # injector when a spec is given (tests run several differently-
+        # faulty shards in one process), else the process-global one
+        # (env SPTAG_FAULTINJECT; disabled when unset)
+        spec = (fault_spec if fault_spec is not None
+                else context.settings.fault_inject)
+        if spec:
+            self._fault = faultinject.Injector(
+                spec, fault_seed if fault_seed is not None
+                else context.settings.fault_inject_seed)
+        else:
+            self._fault = faultinject.global_injector()
+
+    def _admission_signals(self) -> dict:
+        """Live pressure signals for the admission controller: request
+        queue fill, the continuous-batching scheduler's slot-wait p99
+        and pool occupancy (both zero for dense/FLAT-only serving — the
+        queue fraction then carries the whole signal)."""
+        h = metrics.histogram_or_none("scheduler.slot_wait")
+        return {
+            "queue_frac": (self._queue.qsize()
+                           / max(self._queue.maxsize, 1)),
+            "slot_wait_p99_ms": (h.percentile(99) * 1000.0
+                                 if h is not None else 0.0),
+            "occupancy": metrics.gauge_value("scheduler.occupancy"),
+        }
 
     # ------------------------------------------------------------- lifecycle
 
@@ -151,7 +197,8 @@ class SearchServer:
             # exists — no half-started server to clean up
             self._metrics_http = MetricsHttpServer(
                 self.metrics_port, health=self._healthz,
-                host=self.context.settings.metrics_host)
+                host=self.context.settings.metrics_host,
+                admission=self._admission_debug)
             self._metrics_http.start()
         self._server = await asyncio.start_server(self._on_client, host, port)
         self._batcher_task = asyncio.create_task(self._batcher())
@@ -188,6 +235,19 @@ class SearchServer:
                 "indexes": indexes,
                 "connections": len(self._conns),
                 "queue_depth": self._queue.qsize()}
+
+    def _admission_debug(self) -> dict:
+        """GET /debug/admission payload: controller state + fault-
+        injection plan + deadline accounting for this tier."""
+        out = {"enabled": self.admission is not None, "tier": "server"}
+        if self.admission is not None:
+            out.update(self.admission.snapshot())
+        out["faultinject"] = (self._fault.snapshot()
+                              if self._fault.enabled
+                              else {"enabled": False})
+        out["deadline_drops"] = metrics.counter_value(
+            "server.deadline_drops")
+        return out
 
     # ------------------------------------------------------------ connection
 
@@ -289,6 +349,27 @@ class SearchServer:
         elif t == wire.PacketType.SearchRequest:
             metrics.inc("server.requests")
             rec = flightrec.enabled()
+            degraded = False
+            if self.admission is not None:
+                decision = self.admission.admit(str(cid))
+                if decision == admission_mod.SHED:
+                    # reject at the socket edge with a DISTINCT status
+                    # BEFORE decode cost is paid — under overload, body
+                    # decode is the attack surface (the body bytes were
+                    # already read to keep the stream aligned, but never
+                    # parsed)
+                    metrics.inc("server.admission_sheds")
+                    if rec:
+                        flightrec.record(self.flight_tier, "shed")
+                    shed = wire.RemoteSearchResult(
+                        wire.ResultStatus.Overloaded, []).pack()
+                    resp = wire.PacketHeader(
+                        wire.PacketType.SearchResponse,
+                        wire.PacketProcessStatus.Dropped, len(shed),
+                        cid, header.resource_id)
+                    await self._send(cid, resp.pack() + shed)
+                    return
+                degraded = decision == admission_mod.DEGRADE
             t_dec0 = time.monotonic_ns() if rec else 0
             with trace.span("server.decode"):
                 query = wire.RemoteQuery.unpack(body)
@@ -310,9 +391,23 @@ class SearchServer:
                     self.flight_tier, "decode",
                     query.request_id if query is not None else "",
                     dur_ns=time.monotonic_ns() - t_dec0)
+            # deadline resolution (ISSUE 8): the wire trailer wins, the
+            # $deadlinems text option covers reference clients, then the
+            # operator's [Service] DeadlineMs default.  The value is a
+            # RELATIVE budget anchored at THIS arrival (clocks across
+            # machines are not assumed synchronized).
+            deadline_mono = None
+            if query is not None:
+                dl = query.deadline_ms \
+                    or (protocol.deadline_of(query.query) or 0.0)
+                if dl <= 0:
+                    dl = self.deadline_ms
+                if dl > 0:
+                    deadline_mono = time.perf_counter() + dl / 1000.0
             try:
                 self._queue.put_nowait((cid, header, query,
-                                        time.perf_counter()))
+                                        time.perf_counter(),
+                                        deadline_mono, degraded))
                 metrics.set_gauge("server.queue_depth", self._queue.qsize())
                 if rec:
                     flightrec.record(
@@ -362,9 +457,30 @@ class SearchServer:
         metrics.set_gauge("server.queue_depth", self._queue.qsize())
         metrics.set_gauge("server.last_batch_size", len(batch))
         rec = flightrec.enabled()
+        # deadline enforcement at the execute boundary (ISSUE 8): a
+        # query whose budget ran out while queued gets a Timeout answer
+        # NOW instead of burning device time nobody is waiting for —
+        # counted and flight-recorded, never silent
+        live, expired = [], []
+        for e in batch:
+            (expired if e[4] is not None and t_assembled >= e[4]
+             else live).append(e)
+        if expired:
+            batch = live
+            metrics.inc("server.deadline_drops", len(expired))
+            if rec:
+                for entry in expired:
+                    flightrec.record(
+                        self.flight_tier, "deadline_drop",
+                        entry[2].request_id
+                        if entry[2] is not None else "")
+            await self._spawn_response_task(
+                self._respond_expired(expired, t_assembled))
+            if not batch:
+                return
         texts = []
         rids = []
-        for cid, header, query, t_enq in batch:
+        for cid, header, query, t_enq, _deadline, _deg in batch:
             texts.append(query.query if query is not None else "")
             rids.append(query.request_id if query is not None else "")
             trace.record("server.queue_wait", t_assembled - t_enq)
@@ -386,12 +502,17 @@ class SearchServer:
         def on_ready(i, result):
             loop.call_soon_threadsafe(self._stream_response, batch[i],
                                       result, t_assembled, streamed, i)
+        deg_flags = [entry[5] for entry in batch]
+        deg_floor = (self.admission.config.degrade_max_check_floor
+                     if self.admission is not None and any(deg_flags)
+                     else None)
         try:
             def run_batch():
                 with trace.span("server.execute_batch"):
-                    return self.executor.execute_batch(texts,
-                                                       on_ready=on_ready,
-                                                       rids=rids)
+                    return self.executor.execute_batch(
+                        texts, on_ready=on_ready, rids=rids,
+                        degraded=deg_flags if deg_floor else None,
+                        degrade_floor=deg_floor)
             results = await loop.run_in_executor(None, run_batch)
         except Exception:
             metrics.inc("server.batch_failures")
@@ -458,9 +579,48 @@ class SearchServer:
                 continue           # already sent by the streaming path
             await self._respond_one(entry, result, t_assembled, t_executed)
 
+    async def _respond_expired(self, entries, t_assembled: float) -> None:
+        """Answer deadline-expired queries with Timeout — cheap, honest,
+        and the client (which may already have given up) stays
+        stream-aligned either way."""
+        for entry in entries:
+            await self._respond_one(
+                entry, wire.RemoteSearchResult(wire.ResultStatus.Timeout,
+                                               []),
+                t_assembled, t_assembled)
+
+    async def _apply_fault(self, fault, cid: int,
+                           payload: bytes) -> Optional[bytes]:
+        """Apply one injected wire fault to this response (utils/
+        faultinject.py; test/chaos surface).  Returns the (possibly
+        mutated) payload to send, or None when the fault consumed it."""
+        if fault.kind == "delay":
+            await asyncio.sleep(fault.delay_s)
+            return payload
+        if fault.kind == "garble":
+            # flip the first body byte (the serialized version prologue):
+            # framing stays aligned, the body reliably fails decode —
+            # the peer must count a malformed body, not crash
+            b = bytearray(payload)
+            if len(b) > wire.HEADER_SIZE:
+                b[wire.HEADER_SIZE] ^= 0xFF
+            return bytes(b)
+        if fault.kind == "disconnect":
+            # die mid-stream: a payload prefix goes out, then the
+            # transport aborts — the peer sees an incomplete read
+            entry = self._conns.pop(cid, None)
+            if entry is not None:
+                writer, _lock = entry
+                try:
+                    writer.write(payload[:max(1, len(payload) // 2)])
+                finally:
+                    writer.transport.abort()
+            return None
+        return None                                       # "drop"
+
     async def _respond_one(self, entry, result, t_assembled: float,
                            t_executed: float) -> None:
-        cid, header, query, t_enq = entry
+        cid, header, query, t_enq, _deadline, degraded = entry
         if query is None or result is None:
             result = wire.RemoteSearchResult(
                 wire.ResultStatus.FailedExecute, [])
@@ -469,6 +629,14 @@ class SearchServer:
         rid = query.request_id if query is not None else ""
         result.request_id = rid
         rec = flightrec.enabled()
+        if degraded and result.status == wire.ResultStatus.Success:
+            # the degraded marker channel (wire minor 2): clients KNOW
+            # this answer traded recall for survival
+            if wire.MARKER_DEGRADED not in result.markers:
+                result.markers.append(wire.MARKER_DEGRADED)
+            metrics.inc("server.degraded_responses")
+            if rec:
+                flightrec.record(self.flight_tier, "degrade", rid)
         t_enc0 = time.monotonic_ns() if rec else 0
         with trace.span("server.encode"):
             body = result.pack()
@@ -479,9 +647,16 @@ class SearchServer:
             wire.PacketType.SearchResponse,
             wire.PacketProcessStatus.Ok, len(body), cid,
             header.resource_id)
+        payload = resp.pack() + body
+        if self._fault.enabled:
+            fault = self._fault.decide("server.respond")
+            if fault is not None:
+                payload = await self._apply_fault(fault, cid, payload)
+                if payload is None:
+                    return          # drop / disconnect consumed it
         t_send0 = time.perf_counter()
         with trace.span("server.drain"):
-            await self._send(cid, resp.pack() + body)
+            await self._send(cid, payload)
         metrics.inc("server.responses")
         now = time.perf_counter()
         total = now - t_enq

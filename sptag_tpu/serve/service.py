@@ -112,6 +112,31 @@ class ServiceSettings:
     quality_recall_floor: float = 0.0
     quality_shadow_budget: float = 0.0
     quality_window: int = 0
+    # overload defense (serve/admission.py, ISSUE 8): the admission
+    # controller's normal -> degrade -> shed ladder over queue fill,
+    # scheduler slot-wait p99 and pool occupancy.  Off by default — one
+    # `is None` test per request, serve wire bytes byte-identical (the
+    # ci_check.sh off-parity pass).
+    admission_control: bool = False
+    admission_degrade_queue_frac: float = 0.5
+    admission_shed_queue_frac: float = 0.9
+    admission_degrade_slot_wait_ms: float = 50.0
+    admission_shed_slot_wait_ms: float = 250.0
+    admission_fair_share: float = 0.5
+    admission_recover_hold_ms: float = 2000.0
+    # degrade-state budget clamp: per-query MaxCheck is clamped DOWN to
+    # this floor (never raised), oversized k to default_max_result
+    degrade_max_check_floor: int = 512
+    # default per-request deadline in ms, applied to requests that carry
+    # none (wire minor-2 trailer or $deadlinems text option); 0 = none.
+    # Queries whose deadline passes while queued are dropped (counted,
+    # flight-recorded) instead of burning device time nobody waits for.
+    deadline_ms: float = 0.0
+    # wire-layer fault injection (utils/faultinject.py): spec string +
+    # seed.  Empty (default) = no injector work beyond one flag test.
+    # The env twin SPTAG_FAULTINJECT covers processes without an ini.
+    fault_inject: str = ""
+    fault_inject_seed: int = 0
     # runtime lock sanitizer (utils/locksan.py): when on, locks created
     # from here on (index writer locks, client locks, thread pools) are
     # wrapped to detect lock-order inversions at runtime; the watchdog
@@ -182,6 +207,29 @@ class ServiceContext:
                 "Service", "QualityShadowBudget", "0")),
             quality_window=int(reader.get_parameter(
                 "Service", "QualityWindow", "0")),
+            admission_control=reader.get_parameter(
+                "Service", "AdmissionControl", "0").lower() in
+            ("1", "true", "on", "yes"),
+            admission_degrade_queue_frac=float(reader.get_parameter(
+                "Service", "AdmissionDegradeQueueFrac", "0.5")),
+            admission_shed_queue_frac=float(reader.get_parameter(
+                "Service", "AdmissionShedQueueFrac", "0.9")),
+            admission_degrade_slot_wait_ms=float(reader.get_parameter(
+                "Service", "AdmissionDegradeSlotWaitMs", "50")),
+            admission_shed_slot_wait_ms=float(reader.get_parameter(
+                "Service", "AdmissionShedSlotWaitMs", "250")),
+            admission_fair_share=float(reader.get_parameter(
+                "Service", "AdmissionFairShare", "0.5")),
+            admission_recover_hold_ms=float(reader.get_parameter(
+                "Service", "AdmissionRecoverHoldMs", "2000")),
+            degrade_max_check_floor=int(reader.get_parameter(
+                "Service", "DegradeMaxCheckFloor", "512")),
+            deadline_ms=float(reader.get_parameter(
+                "Service", "DeadlineMs", "0")),
+            fault_inject=reader.get_parameter(
+                "Service", "FaultInject", ""),
+            fault_inject_seed=int(reader.get_parameter(
+                "Service", "FaultInjectSeed", "0")),
             lock_sanitizer=reader.get_parameter(
                 "Service", "LockSanitizer", "0").lower() in
             ("1", "true", "on", "yes", "strict"),
@@ -624,8 +672,28 @@ class SearchExecutor:
             except Exception:                            # noqa: BLE001
                 log.exception("on_ready callback failed")
 
+    def _degrade_max_check(self, mc: Optional[int],
+                           sel: tuple, floor: int) -> int:
+        """Effective MaxCheck for a degraded query: the requested (or
+        the selected indexes' configured) budget clamped DOWN to the
+        degrade floor — never raised (a server whose configured budget
+        is already below the floor must not do MORE work in degrade)."""
+        base = mc
+        if base is None:
+            vals = []
+            for n in sel:
+                params = getattr(self.context.indexes.get(n), "params",
+                                 None)
+                v = getattr(params, "max_check", None)
+                if v is not None:
+                    vals.append(int(v))
+            base = max(vals) if vals else floor
+        return min(int(base), int(floor))
+
     def execute_batch(self, query_texts: List[str], on_ready=None,
-                      rids: Optional[List[str]] = None
+                      rids: Optional[List[str]] = None,
+                      degraded: Optional[List[bool]] = None,
+                      degrade_floor: Optional[int] = None
                       ) -> List[RemoteSearchResult]:
         """Coalesced execution: groups parsed queries by (index set, k,
         meta) and runs each group's vectors as ONE device batch.
@@ -638,7 +706,13 @@ class SearchExecutor:
 
         `rids` (one request id per query, optional) rides into scheduler-
         backed submit_batch paths so flight-recorder events and per-rid
-        slot stats attribute to the wire request id."""
+        slot stats attribute to the wire request id.
+
+        `degraded` (one flag per query) + `degrade_floor`: admission-
+        control degrade clamp (serve/admission.py) — flagged queries get
+        their MaxCheck clamped toward the floor and oversized k toward
+        the service default before grouping, so an overloaded server
+        spends a bounded amount of device time per admitted query."""
         parsed = [parse_query(t) for t in query_texts]
         results: List[Optional[RemoteSearchResult]] = [None] * len(parsed)
         groups: Dict[tuple, List[int]] = {}
@@ -647,10 +721,13 @@ class SearchExecutor:
                 results[i] = self._execute_admin(p)
                 continue
             sel = tuple(sorted(self._select_indexes(p)))
-            key = (sel, p.result_num
-                   or self.context.settings.default_max_result,
-                   p.extract_metadata, self._sanitize_max_check(p),
-                   p.search_mode)
+            k = (p.result_num
+                 or self.context.settings.default_max_result)
+            mc = self._sanitize_max_check(p)
+            if degraded is not None and degraded[i] and degrade_floor:
+                mc = self._degrade_max_check(mc, sel, degrade_floor)
+                k = min(k, self.context.settings.default_max_result)
+            key = (sel, k, p.extract_metadata, mc, p.search_mode)
             groups.setdefault(key, []).append(i)
         for (sel, k, with_meta, max_check, search_mode), idxs in \
                 groups.items():

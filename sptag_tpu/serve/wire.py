@@ -26,19 +26,43 @@ unchanged while this stack's edges (client / aggregator) mint an id that
 rides every hop and comes back in the response (the text protocol's
 `$requestid:` option is the equivalent channel for clients that cannot
 set the body field).
+
+Framework extension (overload defense, minor version 2): a RemoteQuery
+may additionally carry a DEADLINE — milliseconds of budget REMAINING at
+send time (relative, never wall clock: peers' clocks are not assumed
+synchronized; each receiver re-anchors at its own arrival).  The
+aggregator decrements it before fanning out so shards can drop work the
+client has already given up on.  A RemoteSearchResult may carry MARKER
+strings — currently ``degraded``, stamped when admission control clamped
+the query's budget — as a count-prefixed string list.  Both trailers
+follow the request-id string (which packs even when empty at minor 2, to
+keep the trailer positional) and are signalled by minor version 2; a
+body without them packs exactly as before (minor 0/1), and a minor-1
+peer reading a minor-2 body consumes the id and ignores the rest, so
+every direction of version skew interoperates.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import logging
 import struct
 import uuid
 from typing import List, Optional, Tuple
 
+log = logging.getLogger(__name__)
+
 HEADER_SIZE = 16
 INVALID_CONNECTION_ID = 0
 INVALID_RESOURCE_ID = 0
+
+#: hard ceiling on a packet's declared body size, shared by EVERY reader
+#: of the framing (server, aggregator backend pump, clients).  The
+#: header's body_length is peer-controlled; without a cap one hostile or
+#: garbled 16-byte header makes readexactly()/recv loops buffer multi-GB.
+#: 64 MiB comfortably covers the largest legitimate body.
+MAX_BODY_LENGTH = 64 << 20
 
 _HEADER_STRUCT = struct.Struct("<BBIII2x")
 _U32 = struct.Struct("<I")
@@ -74,13 +98,26 @@ class PacketProcessStatus(enum.IntEnum):
 
 class ResultStatus(enum.IntEnum):
     """RemoteSearchResult::ResultStatus
-    (inc/Socket/RemoteSearchQuery.h:61-72)."""
+    (inc/Socket/RemoteSearchQuery.h:61-72).  `Overloaded` is a framework
+    extension: the admission controller's shed answer, distinct from
+    every execution failure so clients/load-balancers can back off
+    instead of retrying into the overload."""
 
     Success = 0
     Timeout = 1
     FailedNetwork = 2
     FailedExecute = 3
     Dropped = 4
+    Overloaded = 5
+
+
+#: RemoteSearchResult marker stamped on responses whose budget the
+#: admission controller clamped (serve/admission.py degrade state)
+MARKER_DEGRADED = "degraded"
+
+#: hard ceiling on markers per result — the count prefix is peer-
+#: controlled and must not drive an unbounded decode loop
+MAX_MARKERS = 16
 
 
 @dataclasses.dataclass
@@ -131,22 +168,31 @@ class RemoteQuery:
 
     `request_id` is the framework's traceability extension (module
     docstring): empty packs the exact reference bytes; non-empty bumps the
-    minor version to MIRROR_RID and appends one trailing string."""
+    minor version to MIRROR_RID and appends one trailing string.
+    `deadline_ms` (> 0) is the overload-defense extension: milliseconds
+    of budget remaining at send time, minor version MIRROR_EXT (the id
+    string packs too, even when empty, so the trailer stays positional)."""
 
     query: str = ""
     query_type: int = 0
     request_id: str = ""
+    deadline_ms: float = 0.0
 
     MAJOR = 1
     MIRROR = 0
     MIRROR_RID = 1            # minor version signalling a request-id trailer
+    MIRROR_EXT = 2            # … plus the deadline trailer
 
     def pack(self) -> bytes:
-        mirror = self.MIRROR_RID if self.request_id else self.MIRROR
+        ext = self.deadline_ms > 0
+        mirror = (self.MIRROR_EXT if ext
+                  else self.MIRROR_RID if self.request_id else self.MIRROR)
         out = (_U16X2_U8.pack(self.MAJOR, mirror, self.query_type)
                + write_string(self.query))
-        if self.request_id:
+        if mirror >= self.MIRROR_RID:
             out += write_string(self.request_id)
+        if ext:
+            out += write_string("%g" % self.deadline_ms)
         return out
 
     @classmethod
@@ -157,12 +203,23 @@ class RemoteQuery:
                 return None
             q, off = read_string(buf, _U16X2_U8.size)
             rid = b""
+            deadline_ms = 0.0
             if mirror >= cls.MIRROR_RID and off < len(buf):
                 rid, off = read_string(buf, off)
+            if mirror >= cls.MIRROR_EXT and off < len(buf):
+                ds, off = read_string(buf, off)
+                try:
+                    deadline_ms = float(ds)
+                except ValueError:
+                    # unparsable deadline trailer = no deadline; the
+                    # query itself is still valid
+                    log.debug("unparsable deadline trailer %r", ds)
+                    deadline_ms = 0.0
         except struct.error:
             return None       # truncated body — hostile peers send anything
         return cls(q.decode("utf-8", "replace"), qtype,
-                   rid.decode("utf-8", "replace"))
+                   rid.decode("utf-8", "replace"),
+                   deadline_ms if deadline_ms > 0 else 0.0)
 
 
 @dataclasses.dataclass
@@ -180,18 +237,29 @@ class RemoteSearchResult:
     """inc/Socket/RemoteSearchQuery.h:57-92 — flat list of per-index result
     lists; the aggregator concatenates these without re-ranking
     (AggregatorService.cpp:316-366).  `request_id` echoes the query's id
-    (same versioned-trailer scheme as RemoteQuery)."""
+    (same versioned-trailer scheme as RemoteQuery); `markers` is the
+    minor-2 marker channel (module docstring) — currently only
+    MARKER_DEGRADED rides it."""
 
     status: int = ResultStatus.Timeout
     results: List[IndexSearchResult] = dataclasses.field(default_factory=list)
     request_id: str = ""
+    markers: List[str] = dataclasses.field(default_factory=list)
 
     MAJOR = 1
     MIRROR = 0
     MIRROR_RID = 1
+    MIRROR_EXT = 2            # request id + marker-list trailer
+
+    @property
+    def degraded(self) -> bool:
+        """True when admission control clamped this query's budget."""
+        return MARKER_DEGRADED in self.markers
 
     def pack(self) -> bytes:
-        mirror = self.MIRROR_RID if self.request_id else self.MIRROR
+        ext = bool(self.markers)
+        mirror = (self.MIRROR_EXT if ext
+                  else self.MIRROR_RID if self.request_id else self.MIRROR)
         out = [_U16X2_U8.pack(self.MAJOR, mirror, self.status),
                _U32.pack(len(self.results))]
         for r in self.results:
@@ -204,8 +272,12 @@ class RemoteSearchResult:
             if with_meta:
                 for m in r.metas:
                     out.append(write_string(m))
-        if self.request_id:
+        if mirror >= self.MIRROR_RID:
             out.append(write_string(self.request_id))
+        if ext:
+            out.append(_U32.pack(len(self.markers)))
+            for m in self.markers:
+                out.append(write_string(m))
         return b"".join(out)
 
     @classmethod
@@ -240,8 +312,17 @@ class RemoteSearchResult:
                 results.append(IndexSearchResult(
                     name.decode("utf-8", "replace"), ids, dists, metas))
             rid = b""
+            markers: List[str] = []
             if mirror >= cls.MIRROR_RID and off < len(buf):
                 rid, off = read_string(buf, off)
+            if mirror >= cls.MIRROR_EXT and off < len(buf):
+                (n_mark,) = _U32.unpack_from(buf, off)
+                off += 4
+                if n_mark > MAX_MARKERS:
+                    return None   # hostile count — treat as malformed
+                for _ in range(n_mark):
+                    m, off = read_string(buf, off)
+                    markers.append(m.decode("utf-8", "replace"))
         except struct.error:
             return None       # truncated body — hostile peers send anything
-        return cls(status, results, rid.decode("utf-8", "replace"))
+        return cls(status, results, rid.decode("utf-8", "replace"), markers)

@@ -231,6 +231,14 @@ def counter_value(name: str) -> int:
     return c.value if c is not None else 0
 
 
+def gauge_value(name: str) -> float:
+    """Read-only gauge lookup — never mints an empty series (the
+    admission controller's signal reads must not grow the registry)."""
+    with _reg_lock:
+        g = _gauges.get(name)
+    return g.value if g is not None else 0.0
+
+
 def reset() -> None:
     """Drop every registered series (test isolation; see
     tests/conftest.py)."""

@@ -1,0 +1,689 @@
+"""Overload defense & tail tolerance (ISSUE 8): admission state machine
+(fake clock, no sleeps), per-client fairness, deadline propagation +
+expiry drops, hedged fan-out with first-wins cancellation, reconnect
+backoff, fault-injection determinism, /debug/admission, and the
+knobs-at-defaults byte-parity contract (the ci_check.sh standalone
+pass)."""
+
+import json
+import socket
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.serve import admission, wire
+from sptag_tpu.serve.aggregator import (AggregatorContext,
+                                        AggregatorService, RemoteServer)
+from sptag_tpu.serve.client import (AnnClient, PipelinedAnnClient,
+                                    _DialBackoff)
+from sptag_tpu.serve.protocol import deadline_of, parse_query
+from sptag_tpu.serve.server import SearchServer
+from sptag_tpu.serve.service import (SearchExecutor, ServiceContext,
+                                     ServiceSettings)
+from sptag_tpu.utils import faultinject, metrics
+
+from test_serve import _ServerThread
+
+
+# ---------------------------------------------------------------- helpers
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _make_context(n=64, d=8, name="main", **settings):
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5, **settings))
+    ctx.add_index(name, index)
+    return ctx, data
+
+
+def _query_text(data, i):
+    return "|".join(str(x) for x in data[i])
+
+
+# ------------------------------------------------------- state machine
+
+def test_state_machine_escalates_immediately_and_recovers_with_hold():
+    clock = FakeClock()
+    cfg = admission.AdmissionConfig(recover_hold_ms=1000.0)
+    c = admission.AdmissionController(cfg, clock=clock)
+    assert c.state == "normal"
+    # degrade threshold on queue fill
+    assert c.observe(queue_frac=0.6) == "degrade"
+    # straight to shed from degrade on one bad sample
+    assert c.observe(queue_frac=0.95) == "shed"
+    # calm signals do NOT recover before the hold period...
+    assert c.observe(queue_frac=0.0) == "shed"
+    clock.advance(0.5)
+    assert c.observe(queue_frac=0.0) == "shed"
+    # ...and recovery is ONE level per hold period (shed -> degrade ->
+    # normal), never a direct drop to normal
+    clock.advance(0.6)
+    assert c.observe(queue_frac=0.0) == "degrade"
+    clock.advance(0.5)
+    assert c.observe(queue_frac=0.0) == "degrade"
+    clock.advance(0.6)
+    assert c.observe(queue_frac=0.0) == "normal"
+    assert metrics.counter_value("admission.transitions") == 4
+    # a pressure blip mid-hold resets the calm timer
+    c.observe(queue_frac=0.6)
+    clock.advance(0.9)
+    c.observe(queue_frac=0.6)          # still hot: calm timer restarts
+    clock.advance(0.9)
+    assert c.observe(queue_frac=0.0) == "degrade"
+
+
+def test_state_machine_slot_wait_and_occupancy_signals():
+    clock = FakeClock()
+    c = admission.AdmissionController(clock=clock)
+    # slot-wait p99 drives both levels
+    assert c.observe(slot_wait_p99_ms=60.0) == "degrade"
+    assert c.observe(slot_wait_p99_ms=300.0) == "shed"
+    # occupancy alone can only DEGRADE (full slots + empty queue is
+    # healthy continuous batching, not overload)
+    c2 = admission.AdmissionController(clock=clock)
+    assert c2.observe(occupancy=0.99) == "degrade"
+    assert c2.observe(occupancy=1.0) == "degrade"
+
+
+def test_admit_decisions_per_state():
+    clock = FakeClock()
+    c = admission.AdmissionController(clock=clock)
+    assert c.admit("a") == admission.ADMIT
+    c.observe(queue_frac=0.6)
+    assert c.admit("a") == admission.DEGRADE
+    c.observe(queue_frac=0.95)
+    assert c.admit("a") == admission.SHED
+    assert metrics.counter_value("admission.sheds") == 1
+    assert metrics.counter_value("admission.degraded_queries") == 1
+
+
+def test_fairness_hot_tenant_sheds_quiet_tenant_survives():
+    clock = FakeClock()
+    cfg = admission.AdmissionConfig(fair_share=0.5, fair_min_clients=2)
+    c = admission.AdmissionController(cfg, clock=clock)
+    # build up history: hot sends 9x the quiet tenant's traffic
+    for i in range(90):
+        c.admit("hot")
+        clock.advance(0.01)
+    for i in range(10):
+        c.admit("quiet")
+        clock.advance(0.01)
+    c.observe(queue_frac=0.6)          # pressure: degrade
+    hot, quiet = [], []
+    for i in range(20):
+        hot.append(c.admit("hot"))
+        quiet.append(c.admit("quiet"))
+        clock.advance(0.01)
+    # the hot tenant's share (~90%) exceeds fair_share -> shed; the
+    # quiet one keeps degraded service throughout
+    assert admission.SHED in hot
+    assert all(d == admission.DEGRADE for d in quiet)
+    assert metrics.counter_value("admission.fair_sheds") > 0
+    # single-tenant deployments never fairness-shed (min clients)
+    c2 = admission.AdmissionController(
+        admission.AdmissionConfig(fair_share=0.1), clock=clock)
+    c2.observe(queue_frac=0.6)
+    assert all(c2.admit("only") == admission.DEGRADE for _ in range(50))
+
+
+def test_snapshot_shape():
+    c = admission.AdmissionController(clock=FakeClock())
+    c.admit("a")
+    snap = c.snapshot()
+    assert snap["state"] == "normal"
+    assert snap["clients"] == 1
+    assert snap["top_clients"][0]["client"] == "a"
+    assert "config" in snap and "counters" in snap
+
+
+# ------------------------------------------------------- fault injection
+
+def test_faultinject_parse_determinism_and_filters():
+    inj = faultinject.Injector(
+        "delay@server.respond:ms=50,p=0.5;drop:p=0.25,n=1", seed=7)
+    seq1 = [f.kind if f else None
+            for f in (inj.decide("server.respond") for _ in range(20))]
+    inj2 = faultinject.Injector(
+        "delay@server.respond:ms=50,p=0.5;drop:p=0.25,n=1", seed=7)
+    seq2 = [f.kind if f else None
+            for f in (inj2.decide("server.respond") for _ in range(20))]
+    assert seq1 == seq2                      # same seed, same schedule
+    assert seq1.count("drop") <= 1           # n=1 cap
+    # site filter: the delay rule never fires elsewhere
+    inj3 = faultinject.Injector("delay@server.respond:p=1", seed=1)
+    assert inj3.decide("other.site") is None
+    assert inj3.decide("server.respond").kind == "delay"
+    # `after` skips the first N matching decisions
+    inj4 = faultinject.Injector("drop:p=1,after=2", seed=1)
+    assert [inj4.decide("s") for _ in range(2)] == [None, None]
+    assert inj4.decide("s").kind == "drop"
+    with pytest.raises(ValueError):
+        faultinject.Injector("explode:p=1")
+    assert not faultinject.Injector("").enabled
+    assert not faultinject.enabled()         # env unset -> global off
+
+
+# ------------------------------------------------ wire deadline trailer
+
+def test_deadline_and_marker_wire_roundtrip_and_parity():
+    # minor 0: no trailer, byte-identical reference layout
+    assert wire.RemoteQuery("1|2|3").pack()[2:4] == b"\x00\x00"
+    assert wire.RemoteSearchResult(0, []).pack()[2:4] == b"\x00\x00"
+    # minor 2 round trip: rid + deadline
+    q = wire.RemoteQuery("1|2|3", request_id="r1", deadline_ms=75.5)
+    assert q.pack()[2:4] == b"\x02\x00"
+    u = wire.RemoteQuery.unpack(q.pack())
+    assert (u.request_id, u.deadline_ms) == ("r1", 75.5)
+    # deadline without an id still packs/unpacks (positional trailer)
+    q2 = wire.RemoteQuery.unpack(
+        wire.RemoteQuery("x", deadline_ms=10).pack())
+    assert q2.deadline_ms == 10.0 and q2.request_id == ""
+    # a minor-1 consumer of a minor-2 body still reads the id: the
+    # trailer is strictly append-only
+    r = wire.RemoteSearchResult(0, [], "rid9", [wire.MARKER_DEGRADED])
+    ru = wire.RemoteSearchResult.unpack(r.pack())
+    assert ru.degraded and ru.request_id == "rid9"
+    blob = wire.RemoteSearchResult(0, [], "rid9", []).pack()
+    assert wire.RemoteSearchResult.unpack(blob).markers == []
+    # text channel twin
+    assert deadline_of("$deadlinems:120 1|2|3") == 120.0
+    assert deadline_of("1|2|3") is None
+    assert parse_query("$deadlinems:bogus x").deadline_ms is None
+
+
+# ------------------------------------------------------- server behavior
+
+def test_deadline_expired_drop_e2e():
+    ctx, data = _make_context()
+    server = SearchServer(ctx, batch_window_ms=20.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        cli = AnnClient(host, port, timeout_s=10.0)
+        cli.connect()
+        # a microscopic budget expires while the query waits out the
+        # batch window -> Timeout answer, counted + flight-recorded
+        res = cli.search(_query_text(data, 3), deadline_ms=0.001)
+        assert res.status == wire.ResultStatus.Timeout
+        assert res.results == []
+        assert metrics.counter_value("server.deadline_drops") == 1
+        # a sane budget serves normally
+        res2 = cli.search(_query_text(data, 3), deadline_ms=5000.0)
+        assert res2.status == wire.ResultStatus.Success
+        assert res2.results[0].ids[0] == 3
+        # the $deadlinems TEXT channel drops too (reference clients)
+        res3 = cli.search("$deadlinems:0.001 " + _query_text(data, 3))
+        assert res3.status == wire.ResultStatus.Timeout
+        assert metrics.counter_value("server.deadline_drops") == 2
+        cli.close()
+    finally:
+        t.stop()
+
+
+def test_shed_rejects_before_decode_with_distinct_status(monkeypatch):
+    ctx, data = _make_context()
+    ctrl = admission.AdmissionController(
+        signals=lambda: {"queue_frac": 1.0})   # permanently shedding
+    server = SearchServer(ctx, batch_window_ms=1.0, admission=ctrl)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        assert ctrl.state == "normal"          # refreshed on first admit
+        calls = []
+        real_unpack = wire.RemoteQuery.unpack
+
+        def counting_unpack(buf):
+            calls.append(1)
+            return real_unpack(buf)
+
+        monkeypatch.setattr(wire.RemoteQuery, "unpack",
+                            staticmethod(counting_unpack))
+        cli = AnnClient(host, port, timeout_s=10.0)
+        cli.connect()
+        res = cli.search(_query_text(data, 1))
+        # distinct status at the socket edge, and the body was NEVER
+        # decoded on the server (the client-side unpack of the RESPONSE
+        # uses RemoteSearchResult, not RemoteQuery)
+        assert res.status == wire.ResultStatus.Overloaded
+        assert calls == []
+        assert metrics.counter_value("server.admission_sheds") == 1
+        assert metrics.counter_value("admission.sheds") >= 1
+        cli.close()
+    finally:
+        t.stop()
+
+
+def test_degrade_clamps_budget_and_marks_response():
+    ctx, data = _make_context()
+    ctrl = admission.AdmissionController(
+        signals=lambda: {"queue_frac": 0.6})   # permanently degrading
+    server = SearchServer(ctx, batch_window_ms=1.0, admission=ctrl)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        cli = AnnClient(host, port, timeout_s=10.0)
+        cli.connect()
+        res = cli.search("$resultnum:5 " + _query_text(data, 2))
+        assert res.status == wire.ResultStatus.Success
+        # response carries the degraded marker channel; results intact
+        # (FLAT is exact at any budget)
+        assert res.degraded
+        assert res.results[0].ids[0] == 2
+        assert metrics.counter_value("server.degraded_responses") == 1
+        assert metrics.counter_value("admission.degraded_queries") >= 1
+        cli.close()
+    finally:
+        t.stop()
+
+
+def test_degrade_max_check_clamp_math():
+    ctx, _data = _make_context()
+    ex = SearchExecutor(ctx)
+    # requested budget above the floor clamps DOWN to it
+    assert ex._degrade_max_check(8192, ("main",), 512) == 512
+    # a request already below the floor is never raised
+    assert ex._degrade_max_check(128, ("main",), 512) == 128
+    # no request: the configured default (absent on FLAT params ->
+    # the floor itself), clamped
+    assert ex._degrade_max_check(None, ("main",), 512) == 512
+
+
+def test_debug_admission_endpoint():
+    ctx, data = _make_context(metrics_port=-1)
+    ctrl = admission.AdmissionController(
+        signals=lambda: {"queue_frac": 0.0})
+    server = SearchServer(ctx, batch_window_ms=1.0, admission=ctrl,
+                          fault_spec="drop:p=0,n=1", fault_seed=3)
+    t = _ServerThread(server)
+    t.start()
+    t.wait_ready()
+    try:
+        mport = server._metrics_http.port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/debug/admission",
+                timeout=10) as resp:
+            payload = json.loads(resp.read())
+        assert payload["enabled"] is True
+        assert payload["tier"] == "server"
+        assert payload["state"] == "normal"
+        assert payload["faultinject"]["enabled"] is True
+        assert payload["faultinject"]["rules"][0]["kind"] == "drop"
+    finally:
+        t.stop()
+
+
+# ------------------------------------------------------ reconnect backoff
+
+def test_client_dial_backoff_unit():
+    b = _DialBackoff()
+    assert not b.suppressed(100.0)
+    b.failed(100.0)
+    assert b.backoff_s == pytest.approx(0.05)
+    assert 100.0 < b.next_dial <= 100.0 + 0.05 * 1.5
+    b.failed(100.1)
+    assert b.backoff_s == pytest.approx(0.10)
+    for _ in range(20):
+        b.failed(100.2)
+    assert b.backoff_s == 5.0                  # capped
+    assert b.suppressed(b.next_dial - 0.001)
+    assert not b.suppressed(b.next_dial + 0.001)
+    b.succeeded()
+    assert b.backoff_s == 0.0 and b.next_dial == 0.0
+
+
+def test_client_auto_reconnect_backoff_suppresses_dialing(monkeypatch):
+    # wide backoff window so the suppression assertion cannot race the
+    # wall clock on a loaded CI box
+    from sptag_tpu.serve import client as client_mod
+    monkeypatch.setattr(client_mod, "RECONNECT_BASE_S", 5.0)
+    # a dead port: grab an ephemeral port and close the listener
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    cli = AnnClient("127.0.0.1", dead_port, timeout_s=0.5)
+    assert cli.search("1|2|3").status == wire.ResultStatus.FailedNetwork
+    attempts = metrics.counter_value("client.reconnect_attempts")
+    assert attempts == 1
+    # inside the backoff window the next search is SUPPRESSED — no
+    # second connect timeout is paid against the dead server
+    assert cli.search("1|2|3").status == wire.ResultStatus.FailedNetwork
+    assert metrics.counter_value("client.reconnect_attempts") == attempts
+    assert metrics.counter_value("client.dials_suppressed") >= 1
+    # the pipelined client has the same protection
+    pcli = PipelinedAnnClient("127.0.0.1", dead_port, timeout_s=0.5)
+    assert pcli.search("1|2|3").status == wire.ResultStatus.FailedNetwork
+    assert pcli.search("1|2|3").status == wire.ResultStatus.FailedNetwork
+    assert metrics.counter_value("client.dials_suppressed") >= 2
+
+
+class _PortedServerThread(_ServerThread):
+    """_ServerThread pinned to a KNOWN port (the reconnect test boots a
+    shard on the exact address the aggregator is already re-dialing)."""
+
+    def __init__(self, server, port):
+        super().__init__(server)
+        self._want_port = port
+
+    def run(self):
+        import asyncio
+
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+
+        async def boot():
+            self.addr = await self.server.start("127.0.0.1",
+                                                self._want_port)
+            self._ready.set()
+
+        self._boot_task = self.loop.create_task(boot())
+        self.loop.run_forever()
+
+
+def test_aggregator_reconnect_backoff_recovers():
+    # shard is DOWN when the aggregator starts; it comes up later and
+    # the backoff loop (fast first retry, capped + jittered) picks it
+    # up well under the legacy fixed 30 s sweep
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    shard_port = probe.getsockname()[1]
+    probe.close()
+    agg_ctx = AggregatorContext(search_timeout_s=5.0,
+                                reconnect_base_ms=40.0,
+                                reconnect_cap_s=0.5)
+    agg_ctx.servers.append(RemoteServer("127.0.0.1", shard_port))
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    tg.wait_ready()
+    ts = None
+    try:
+        deadline = time.time() + 3.0
+        while time.time() < deadline and \
+                metrics.counter_value(
+                    "aggregator.reconnect_attempts") < 2:
+            time.sleep(0.05)
+        assert metrics.counter_value("aggregator.reconnect_attempts") >= 2
+        assert agg_ctx.servers[0].backoff_s > 0.0
+        # now boot the shard on that exact port and wait for recovery
+        ctx, _data = _make_context()
+        ts = _PortedServerThread(SearchServer(ctx, batch_window_ms=1.0),
+                                 shard_port)
+        ts.start()
+        ts.wait_ready()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and \
+                not agg_ctx.servers[0].connected:
+            time.sleep(0.05)
+        assert agg_ctx.servers[0].connected
+        assert metrics.counter_value("aggregator.reconnects") >= 1
+        assert agg_ctx.servers[0].backoff_s == 0.0   # reset on success
+    finally:
+        tg.stop()
+        if ts is not None:
+            ts.stop()
+
+
+# ------------------------------------------------------------- hedging
+
+def _boot_shard(data, fault_spec=None, name="main"):
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index(name, index)
+    srv = SearchServer(ctx, batch_window_ms=1.0, fault_spec=fault_spec,
+                       fault_seed=11)
+    t = _ServerThread(srv)
+    t.start()
+    return t, t.wait_ready()
+
+
+def test_hedge_fires_on_slow_shard_loser_cancelled_p99_drops():
+    rng = np.random.default_rng(1)
+    data = rng.standard_normal((64, 8)).astype(np.float32)
+    # shard A answers every query 250 ms late; replica B is healthy
+    ta, (ha, pa) = _boot_shard(data,
+                               fault_spec="delay@server.respond:ms=250,p=1")
+    tb, (hb, pb) = _boot_shard(data)
+    agg_ctx = AggregatorContext(search_timeout_s=5.0, hedge_budget=0.0,
+                                hedge_percentile=50.0, hedge_min_ms=5.0)
+    agg_ctx.servers.append(RemoteServer(ha, pa, replica_group="g1"))
+    agg_ctx.servers.append(RemoteServer(hb, pb, replica_group="g1"))
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    gh, gp = tg.wait_ready()
+    try:
+        cli = AnnClient(gh, gp, timeout_s=10.0)
+        cli.connect()
+        q = _query_text(data, 5)
+        n = 6
+        # hedging DISABLED: every request waits out the slow shard
+        lat_off = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            res = cli.search(q)
+            lat_off.append(time.perf_counter() - t0)
+            assert res.status == wire.ResultStatus.Success
+        p99_off = max(lat_off)
+        assert p99_off >= 0.25
+        # hedging ENABLED (the same test, same backends): seed the fleet
+        # histogram with healthy samples so the p50 trigger is sharp,
+        # then the duplicate to replica B answers while A dawdles
+        agg_ctx.hedge_budget = 1.0
+        for _ in range(100):
+            metrics.observe("aggregator.backend_s", 0.002)
+        lat_on = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            res = cli.search(q)
+            lat_on.append(time.perf_counter() - t0)
+            assert res.status == wire.ResultStatus.Success
+            assert res.results and res.results[0].ids[0] == 5
+        p99_on = max(lat_on)
+        assert metrics.counter_value("aggregator.hedges") >= n
+        assert metrics.counter_value("aggregator.hedge_wins") >= n
+        # first-wins cancellation: the slow shard's pending table is
+        # empty — the loser was deregistered, its late reply dies
+        # unmatched at the response pump
+        time.sleep(0.3)                   # let the late replies land
+        assert all(not s.pending for s in agg_ctx.servers)
+        # the acceptance number: hedging cuts the injected-slow-shard
+        # workload's tail
+        assert p99_on < p99_off * 0.6, (p99_on, p99_off)
+        cli.close()
+    finally:
+        tg.stop()
+        ta.stop()
+        tb.stop()
+
+
+def test_hedge_budget_cap_denies_past_fraction():
+    ctx = AggregatorContext(hedge_budget=0.1)
+    svc = AggregatorService(ctx)
+    svc._fanouts = 10
+    assert svc._hedge_allow()            # 1 <= 0.1*10
+    assert not svc._hedge_allow()        # budget spent
+    assert metrics.counter_value("aggregator.hedge_budget_denied") == 1
+
+
+def test_hedge_target_prefers_replica_else_same_backend():
+    ctx = AggregatorContext()
+    a = RemoteServer("h", 1, replica_group="g")
+    b = RemoteServer("h", 2, replica_group="g")
+    c = RemoteServer("h", 3)             # different slice, no group
+    ctx.servers = [a, b, c]
+    svc = AggregatorService(ctx)
+
+    class W:                              # fake "connected" writer
+        def is_closing(self):
+            return False
+    for s in (a, b, c):
+        s.writer = W()
+    assert svc._hedge_target(a) is b     # replica wins
+    b.writer = None
+    assert svc._hedge_target(a) is a     # no live replica: same backend
+    assert svc._hedge_target(c) is c     # ungrouped: only same backend
+    c.writer = None
+    assert svc._hedge_target(c) is None
+
+
+# ------------------------------------------------- off-default parity
+
+def test_admission_off_parity_serve_bytes():
+    """With every ISSUE-8 knob at its default (AdmissionControl off, no
+    deadline, HedgeBudget 0, FaultInject empty) the serve path produces
+    byte-identical wire responses to the reference layout and zero
+    defense-path work — the ci_check.sh standalone parity pass."""
+    ctx, data = _make_context(n=50)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    assert server.admission is None
+    assert not server._fault.enabled
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        qtext = _query_text(data, 7)
+        expected_result = SearchExecutor(ctx).execute(qtext)
+        expected_result.request_id = ""
+        expected_body = expected_result.pack()
+        expected = wire.PacketHeader(
+            wire.PacketType.SearchResponse, wire.PacketProcessStatus.Ok,
+            len(expected_body), 1, 77).pack() + expected_body
+        body = wire.RemoteQuery(qtext).pack()
+        assert body[2:4] == b"\x00\x00"          # minor version 0
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, 77).pack() + body)
+        s.settimeout(10)
+        got = b""
+        while len(got) < len(expected):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        s.close()
+        assert got == expected
+        for name in ("admission.sheds", "admission.degraded_queries",
+                     "server.admission_sheds", "server.deadline_drops",
+                     "server.degraded_responses", "faultinject.delays",
+                     "faultinject.drops", "faultinject.disconnects",
+                     "faultinject.garbles"):
+            assert metrics.counter_value(name) == 0, name
+    finally:
+        t.stop()
+
+
+def test_new_service_knobs_from_ini(tmp_path):
+    ini = tmp_path / "svc.ini"
+    ini.write_text(
+        "[Service]\n"
+        "AdmissionControl=1\n"
+        "AdmissionShedQueueFrac=0.8\n"
+        "DegradeMaxCheckFloor=256\n"
+        "DeadlineMs=1500\n"
+        "FaultInject=delay:ms=5,p=0\n"
+        "FaultInjectSeed=9\n")
+    ctx = ServiceContext.from_ini(str(ini))
+    s = ctx.settings
+    assert s.admission_control
+    assert s.admission_shed_queue_frac == 0.8
+    assert s.degrade_max_check_floor == 256
+    assert s.deadline_ms == 1500.0
+    assert s.fault_inject == "delay:ms=5,p=0"
+    assert s.fault_inject_seed == 9
+    agg_ini = tmp_path / "agg.ini"
+    agg_ini.write_text(
+        "[Service]\n"
+        "AdmissionControl=1\n"
+        "HedgePercentile=90\n"
+        "HedgeBudget=0.05\n"
+        "ReconnectBaseMs=100\n"
+        "ReconnectCapS=10\n"
+        "DeadlineMs=2000\n")
+    actx = AggregatorContext.from_ini(str(agg_ini))
+    assert actx.admission_control
+    assert actx.hedge_percentile == 90.0
+    assert actx.hedge_budget == 0.05
+    assert actx.reconnect_base_ms == 100.0
+    assert actx.reconnect_cap_s == 10.0
+    assert actx.deadline_ms == 2000.0
+    # defaults stay off / reference-compatible
+    d = AggregatorContext()
+    assert d.hedge_budget == 0.0 and not d.admission_control
+    assert ServiceSettings().admission_control is False
+    assert ServiceSettings().deadline_ms == 0.0
+    assert ServiceSettings().fault_inject == ""
+
+
+def test_aggregator_propagates_shard_degraded_marker():
+    """A shard whose admission control degraded its slice must be
+    visible THROUGH the aggregator: the merged response carries the
+    shard-stamped `degraded` marker (review fix — markers previously
+    died at the merge)."""
+    ctx, data = _make_context()
+    ctrl = admission.AdmissionController(
+        signals=lambda: {"queue_frac": 0.6})   # permanently degrading
+    shard = SearchServer(ctx, batch_window_ms=1.0, admission=ctrl)
+    ts = _ServerThread(shard)
+    ts.start()
+    hs, ps = ts.wait_ready()
+    agg_ctx = AggregatorContext(search_timeout_s=10.0)
+    agg_ctx.servers.append(RemoteServer(hs, ps))
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+    try:
+        cli = AnnClient(hg, pg, timeout_s=10.0)
+        cli.connect()
+        res = cli.search(_query_text(data, 4))
+        assert res.status == wire.ResultStatus.Success
+        assert res.degraded            # shard marker survived the merge
+        assert res.results[0].ids[0] == 4
+        cli.close()
+    finally:
+        tg.stop()
+        ts.stop()
+
+
+def test_aggregator_rejects_oversized_client_header():
+    """The aggregator's public listen socket enforces MAX_BODY_LENGTH:
+    a hostile header must close the connection, not buffer multi-GB
+    (review fix — this was the one framing reader without the cap)."""
+    agg = AggregatorService(AggregatorContext())
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready()
+    try:
+        s = socket.create_connection((hg, pg), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            wire.MAX_BODY_LENGTH + 1, 0, 1).pack())
+        s.settimeout(10)
+        assert s.recv(1) == b""        # closed, nothing buffered/answered
+        s.close()
+        assert metrics.counter_value("aggregator.malformed_packets") == 1
+    finally:
+        tg.stop()

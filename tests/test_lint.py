@@ -565,6 +565,28 @@ def test_gl606_literal_name_and_dynamic_labels_clean():
     assert rules_of(lint_one(dirty, select=["GL606"])) == ["GL606"]
 
 
+def test_issue8_overload_defense_names_are_literals():
+    """ISSUE 8 CI satellite: GL601/602/603 coverage extends to the
+    overload-defense modules — every metric and flight-event name in
+    serve/admission.py, utils/faultinject.py and the serve files they
+    wired into is a string literal, with NO new baseline entries (the
+    files lint clean with no baseline applied at all)."""
+    paths = [
+        "sptag_tpu/serve/admission.py",
+        "sptag_tpu/utils/faultinject.py",
+        "sptag_tpu/serve/server.py",
+        "sptag_tpu/serve/aggregator.py",
+        "sptag_tpu/serve/client.py",
+        "sptag_tpu/serve/wire.py",
+    ]
+    srcs = {}
+    for p in paths:
+        with open(os.path.join(REPO, p), encoding="utf-8") as fh:
+            srcs[p] = fh.read()
+    found = lint_sources(srcs, select=["GL601", "GL602", "GL603"])
+    assert found == [], "\n".join(f.format() for f in found)
+
+
 def test_gl606_out_of_family_qualmon_calls_clean():
     """Only gauge/inc carry names; record_sample's mode/shard labels,
     note_health's shard, and unrelated modules binding `qualmon` stay

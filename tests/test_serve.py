@@ -1804,9 +1804,9 @@ def test_metrics_port_ini_and_disabled_by_default():
     # repacked to the extended layout); existing ids still ride
     svc = AggregatorService(agg_off)
     raw = wire.RemoteQuery("1|2|3").pack()
-    assert svc._ensure_request_id(raw) == (raw, "")
+    assert svc._prepare_request(raw) == (raw, "", None)
     tagged = wire.RemoteQuery("1|2|3", request_id="keepme").pack()
-    assert svc._ensure_request_id(tagged) == (tagged, "keepme")
+    assert svc._prepare_request(tagged) == (tagged, "keepme", None)
     os.unlink(path)
     # the bind host DEFAULTS to loopback: the endpoint is unauthenticated
     assert ServiceSettings().metrics_host == "127.0.0.1"
@@ -1828,3 +1828,148 @@ def test_metrics_port_ini_and_disabled_by_default():
         cli.close()
     finally:
         t.stop()
+
+
+# ----------------------------------------------- fault matrix (ISSUE 8)
+
+def _boot_fault_shard(data, name, fault_spec=None):
+    """One FLAT shard under a private fault-injection plan
+    (utils/faultinject.py) — several differently-faulty shards coexist
+    in one process because each SearchServer owns its Injector."""
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index(name, index)
+    srv = SearchServer(ctx, batch_window_ms=1.0, fault_spec=fault_spec,
+                       fault_seed=5)
+    t = _ServerThread(srv)
+    t.start()
+    return t, t.wait_ready()
+
+
+@pytest.mark.parametrize("fault,inj_counter,agg_counter", [
+    # slow shard past SearchTimeout: the aggregator stops waiting at its
+    # timeout and degrades the merged status to Timeout
+    ("delay@server.respond:ms=2500,p=1", "faultinject.delays",
+     "aggregator.backend_timeouts"),
+    # hung shard (response swallowed, connection alive): same Timeout
+    # path — the pending entry dies unmatched, the connection stays up
+    ("drop@server.respond:p=1", "faultinject.drops",
+     "aggregator.backend_timeouts"),
+    # shard dies mid-stream (payload prefix, then abort): the response
+    # pump fails every in-flight request on that backend fast
+    ("disconnect@server.respond:p=1", "faultinject.disconnects",
+     "aggregator.backend_failures"),
+    # garbled body (framing intact, body undecodable): counted as
+    # malformed, costs one request, never the connection task
+    ("garble@server.respond:p=1", "faultinject.garbles",
+     "aggregator.malformed_backend_body"),
+])
+def test_fault_matrix_partial_results_no_hang(fault, inj_counter,
+                                              agg_counter):
+    """Each injected wire fault must degrade gracefully: the merged
+    answer keeps the healthy shard's results, carries a non-Success
+    status, and returns well inside the client timeout — no hang, no
+    crash, and both the injection and the aggregator's accounting of it
+    are visible as counters."""
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((64, 8)).astype(np.float32)
+    tb, (hb, pb) = _boot_fault_shard(data, "bad", fault_spec=fault)
+    tg_, (hg_, pg_) = _boot_fault_shard(data, "good")
+    agg_ctx = AggregatorContext(search_timeout_s=1.0)
+    agg_ctx.servers = [RemoteServer(hb, pb), RemoteServer(hg_, pg_)]
+    agg = AggregatorService(agg_ctx)
+    ta = _ServerThread(agg)
+    ta.start()
+    ha, pa = ta.wait_ready()
+    try:
+        cli = AnnClient(ha, pa, timeout_s=10.0)
+        cli.connect()
+        qtext = "|".join(str(x) for x in data[9])
+        t0 = time.perf_counter()
+        res = cli.search(qtext)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 5.0                       # no hang
+        # PARTIAL results with degraded status: the healthy shard's
+        # answer is in the merge, the faulty one degrades the status
+        assert res.status in (wire.ResultStatus.Timeout,
+                              wire.ResultStatus.FailedNetwork)
+        good = [r for r in res.results if r.index_name == "good"]
+        assert good and good[0].ids[0] == 9
+        assert not any(r.index_name == "bad" for r in res.results)
+        assert metrics.counter_value(inj_counter) >= 1
+        assert metrics.counter_value(agg_counter) >= 1
+        cli.close()
+    finally:
+        ta.stop()
+        tb.stop()
+        tg_.stop()
+
+
+def test_acceptance_three_shards_inflight_queries_all_degrade():
+    """The ISSUE-8 acceptance drill: an aggregator over 3 shards with
+    one shard delayed past SearchTimeout and one disconnecting
+    mid-stream must answer 100% of concurrent in-flight queries with
+    partial results (the healthy shard's list) and a degraded status —
+    zero hangs, zero crashes."""
+    rng = np.random.default_rng(4)
+    data = rng.standard_normal((64, 8)).astype(np.float32)
+    t_slow, (h1, p1) = _boot_fault_shard(
+        data, "slow", fault_spec="delay@server.respond:ms=2500,p=1")
+    t_dead, (h2, p2) = _boot_fault_shard(
+        data, "dead", fault_spec="disconnect@server.respond:p=1")
+    t_ok, (h3, p3) = _boot_fault_shard(data, "ok")
+    agg_ctx = AggregatorContext(search_timeout_s=1.0)
+    agg_ctx.servers = [RemoteServer(h1, p1), RemoteServer(h2, p2),
+                       RemoteServer(h3, p3)]
+    agg = AggregatorService(agg_ctx)
+    ta = _ServerThread(agg)
+    ta.start()
+    ha, pa = ta.wait_ready()
+    n_workers, n_queries = 6, 2
+    outcomes = []
+    errors = []
+
+    def worker(wid):
+        try:
+            c = AnnClient(ha, pa, timeout_s=10.0)
+            c.connect()
+            for j in range(n_queries):
+                q = "|".join(str(x) for x in data[(wid * 7 + j) % 64])
+                res = c.search(q)
+                outcomes.append((wid, j, res.status,
+                                 sorted(r.index_name
+                                        for r in res.results)))
+            c.close()
+        except Exception as e:                       # noqa: BLE001
+            errors.append((wid, repr(e)))
+
+    try:
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "worker hang"
+        assert not errors, errors
+        # 100%: every in-flight query answered, degraded, partial
+        assert len(outcomes) == n_workers * n_queries
+        for wid, j, status, names in outcomes:
+            assert status in (wire.ResultStatus.Timeout,
+                              wire.ResultStatus.FailedNetwork), \
+                (wid, j, status)
+            assert "ok" in names, (wid, j, names)
+            assert "slow" not in names and "dead" not in names
+        # the accounting matches the injected faults
+        assert metrics.counter_value("faultinject.delays") >= 1
+        assert metrics.counter_value("faultinject.disconnects") >= 1
+        assert metrics.counter_value("aggregator.backend_timeouts") >= 1
+        assert metrics.counter_value("server.responses") >= \
+            n_workers * n_queries            # the healthy shard answered
+    finally:
+        ta.stop()
+        t_slow.stop()
+        t_dead.stop()
+        t_ok.stop()

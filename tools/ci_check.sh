@@ -47,6 +47,21 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_qualmon.py -q \
 echo "== GL606 quality-name lint (standalone) =="
 python -m tools.graftlint sptag_tpu/ --select GL606
 
+# the ISSUE 8 robustness gate, standalone: with every overload-defense
+# knob at its default (AdmissionControl off, DeadlineMs 0, HedgeBudget
+# 0, FaultInject empty) the serve tier's wire bytes stay byte-identical
+# to the reference layout and the defense path performs zero work
+echo "== overload defense off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_admission.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 8 lint gate, standalone: the overload-defense modules'
+# metric/flight-event names are literals (GL601/602/603 extend to the
+# new modules with no new baseline entries)
+echo "== GL601/602/603 overload-defense names (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q \
+    -p no:cacheprovider -k "issue8"
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within
