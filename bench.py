@@ -1011,6 +1011,21 @@ def run_bench():
                 result["loadgen_error"] = repr(e)[:300]
             checkpoint()
 
+        # mixed read/write mutation stage (ISSUE 9): 95/5 reads vs a
+        # paced add/delete stream with the delta shard + background
+        # refine armed — reports read p50/p99 DURING swap windows vs
+        # steady state, swap count, acked writes and add-to-visible
+        # staleness.  The number this stage exists for: what does a
+        # snapshot swap cost the readers that ride through it?
+        sb_mut = _stage_budget(result, "mutate", budget_s, 120.0, 40.0)
+        if sb_mut is not None:
+            try:
+                result["mutate"] = _mutate_measure(
+                    index, queries, k, sb_mut)
+            except Exception as e:                       # noqa: BLE001
+                result["mutate_error"] = repr(e)[:300]
+            checkpoint()
+
         # host-span tracing report (utils/trace.py) — where the wall time
         # went, for the judge and for regression diffing.  The FULL report
         # (count/total/max plus registry-derived p50/p90/p99, including
@@ -1321,6 +1336,203 @@ def _loadgen_measure(index, queries, k, budget_s):
         th.join(timeout=10)
         loop.close()
     return out
+
+
+def _mutate_measure(index, queries, k, budget_s, write_frac=0.05):
+    """Mixed read/write mutation stage (ISSUE 9): reader threads search
+    continuously while a paced writer streams adds/deletes at ~5% of
+    total ops with the delta shard + background auto-refine armed.
+
+    Reports: read p50/p99 overall and PARTITIONED into swap windows vs
+    steady state (the windows come from the index's mutation_state,
+    stamped per swap; the flight recorder carries the same swap_begin/
+    swap_publish events for trace-level inspection), plus swap_count,
+    acked_writes, deletes, and add-to-visible staleness samples (an
+    acked add is probed immediately — with the delta shard the row is
+    findable in the very next search).  Zero reader errors is part of
+    the contract: a swap that drops or breaks queries would show here."""
+    from sptag_tpu.utils import flightrec as flightrec_mod
+
+    cap = int(os.environ.get("BENCH_MUTATE_DELTA_CAP", "2048"))
+    thr = int(os.environ.get("BENCH_MUTATE_REFINE_THRESHOLD", "128"))
+    readers = int(os.environ.get("BENCH_MUTATE_READERS", "3"))
+    stage_s = min(float(os.environ.get("BENCH_MUTATE_S", "45")),
+                  max(_remaining(budget_s), 10.0))
+    prev = {p: index.get_parameter(p)
+            for p in ("DeltaShardCapacity", "AutoRefineThreshold")}
+    flight_was = flightrec_mod.enabled()
+    try:
+        return _mutate_measure_armed(index, queries, k, budget_s,
+                                     write_frac, cap, thr, readers,
+                                     stage_s, flight_was)
+    finally:
+        # restore on EVERY exit (review fix): an error mid-stage must
+        # not leave later stages measuring a delta-merging, background-
+        # refining index with the flight ring armed
+        for p, v in prev.items():
+            if v is not None:
+                index.set_parameter(p, v)
+        if not flight_was:
+            flightrec_mod.configure(enabled=False)
+
+
+def _mutate_measure_armed(index, queries, k, budget_s, write_frac,
+                          cap, thr, readers, stage_s, flight_was):
+    import threading
+
+    import sptag_tpu as sp
+    from sptag_tpu.utils import flightrec as flightrec_mod
+    from sptag_tpu.utils import metrics as metrics_mod
+
+    index.set_parameter("DeltaShardCapacity", str(cap))
+    index.set_parameter("AutoRefineThreshold", str(thr))
+    if not flight_was:
+        # swap intervals ride the ring as index/swap_begin+swap_publish
+        # events (GL603 literals) — arm it for the stage
+        flightrec_mod.configure(enabled=True)
+    base_state = index.mutation_state()
+    base_swaps = base_state["swap_count"]
+    base_acked = metrics_mod.counter_value("mutation.wal_appends")
+    dim = index.feature_dim
+    rng = np.random.default_rng(23)
+    nq = len(queries)
+    stop = threading.Event()
+    errors = []
+    lat_lock = threading.Lock()
+    lat = []                    # (monotonic_end_ms, latency_s)
+    ops = {"reads": 0, "writes": 0, "deletes": 0, "adds_rows": 0}
+    staleness_ms = []
+    added_rows = []             # vectors eligible for delete-by-content
+
+    def reader(seed):
+        r = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                ix = r.integers(0, nq, 4)
+                t0 = time.perf_counter()
+                d, ids = index.search_batch(queries[ix], k)
+                dt = time.perf_counter() - t0
+                if ids.shape != (4, k):
+                    raise RuntimeError(f"malformed result {ids.shape}")
+                with lat_lock:
+                    lat.append((time.monotonic() * 1000.0, dt))
+                    ops["reads"] += 1
+        except Exception as e:                           # noqa: BLE001
+            errors.append(repr(e)[:300])
+
+    def writer():
+        try:
+            while not stop.is_set():
+                with lat_lock:
+                    total = ops["reads"] + ops["writes"]
+                    writes = ops["writes"]
+                if total and writes / total >= write_frac:
+                    time.sleep(0.01)     # pace: hold the 95/5 ratio
+                    continue
+                if added_rows and rng.random() < 0.25:
+                    vec = added_rows.pop(0)
+                    index.delete(vec[None, :])
+                    with lat_lock:
+                        ops["writes"] += 1
+                        ops["deletes"] += 1
+                    continue
+                batch = rng.standard_normal(
+                    (int(rng.integers(1, 9)), dim)).astype(np.float32)
+                code = index.add(batch)
+                if code != sp.ErrorCode.Success:
+                    raise RuntimeError(f"add failed: {code}")
+                t_ack = time.perf_counter()
+                # staleness probe: the acked row must be findable NOW
+                probe = batch[0:1]
+                found = False
+                for _ in range(5):
+                    _, pids = index.search_batch(probe, max(4, k))
+                    if (pids[0] >= 0).any():
+                        dd, _ = index.search_batch(probe, 1)
+                        if dd[0, 0] <= 1e-3:
+                            found = True
+                            break
+                    time.sleep(0.001)
+                if found:
+                    staleness_ms.append(
+                        (time.perf_counter() - t_ack) * 1000.0)
+                added_rows.append(batch[0])
+                with lat_lock:
+                    ops["writes"] += 1
+                    ops["adds_rows"] += len(batch)
+        except Exception as e:                           # noqa: BLE001
+            errors.append(repr(e)[:300])
+
+    threads = [threading.Thread(target=reader, args=(100 + i,),
+                                daemon=True) for i in range(readers)]
+    threads.append(threading.Thread(target=writer, daemon=True))
+    # warm the read AND probe shapes before timing (first-shape XLA
+    # compiles are not mutation cost — an unwarmed probe shape once
+    # read as a 5.9 s "staleness" sample)
+    index.search_batch(queries[:4], k)
+    index.search_batch(queries[:1], max(4, k))
+    index.search_batch(queries[:1], 1)
+    for t in threads:
+        t.start()
+    t_stage0 = time.monotonic()
+    while time.monotonic() - t_stage0 < stage_s:
+        if _remaining(budget_s) < 5.0:
+            break
+        time.sleep(0.25)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    # let an in-flight background refine land so swap accounting and
+    # the restored knobs see a quiet index
+    t_wait = time.monotonic() + min(30.0, max(_remaining(budget_s), 0.0))
+    while time.monotonic() < t_wait and \
+            index.mutation_state()["refine_in_flight"]:
+        time.sleep(0.1)
+    state = index.mutation_state()
+    # partition read latencies by the recorded swap windows
+    windows = [w for w in state["swap_windows_ms"]
+               if w[1] >= t_stage0 * 1000.0]
+    in_swap = [l for (t_ms, l) in lat
+               if any(w0 <= t_ms <= w1 + l * 1000.0
+                      for (w0, w1) in windows)]
+    steady = [l for (t_ms, l) in lat
+              if not any(w0 <= t_ms <= w1 + l * 1000.0
+                         for (w0, w1) in windows)]
+    all_l = [l for (_t, l) in lat]
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)) * 1e3, 3) \
+            if vals else None
+
+    return {
+        "duration_s": round(time.monotonic() - t_stage0, 1),
+        "reads": ops["reads"],
+        "writes": ops["writes"],
+        "deletes": ops["deletes"],
+        "adds_rows": ops["adds_rows"],
+        "write_frac": round(ops["writes"]
+                            / max(ops["reads"] + ops["writes"], 1), 4),
+        "errors": errors,
+        "swap_count": state["swap_count"] - base_swaps,
+        "swap_windows": len(windows),
+        # every write op that RETURNED is an ack (WAL-backed when the
+        # index has a home folder; wal_appends then tracks it)
+        "acked_writes": ops["writes"],
+        "wal_appends": metrics_mod.counter_value("mutation.wal_appends")
+        - base_acked,
+        "delta_rows_end": state["delta_rows"],
+        "staleness_ms_p50": (round(float(np.percentile(
+            staleness_ms, 50)), 3) if staleness_ms else None),
+        "staleness_ms_max": (round(max(staleness_ms), 3)
+                             if staleness_ms else None),
+        "read_p50_ms": pct(all_l, 50),
+        "read_p99_ms": pct(all_l, 99),
+        "swap_window_reads": len(in_swap),
+        "swap_window_p50_ms": pct(in_swap, 50),
+        "swap_window_p99_ms": pct(in_swap, 99),
+        "steady_p50_ms": pct(steady, 50),
+        "steady_p99_ms": pct(steady, 99),
+    }
 
 
 def _beam_cb_measure(beam_index, queries, k, budget_s):

@@ -31,6 +31,7 @@ import io
 import logging
 import os
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -103,6 +104,11 @@ class BKTIndex(VectorIndex):
         # bumped whenever row ids are remapped (build / compaction) so an
         # in-flight background rebuild can detect its snapshot went stale
         self._structure_gen = 0
+        # bumped when an engine-baked parameter changes (set_parameter's
+        # _ENGINE_PARAMS invalidation): a background refine that built
+        # its engine under the OLD values must discard, not publish a
+        # snapshot that silently reverts the operator's change
+        self._engine_param_gen = 0
 
     def _make_params(self) -> BKTParams:
         return BKTParams()
@@ -165,9 +171,15 @@ class BKTIndex(VectorIndex):
             refine_accuracy_guard=bool(p.refine_accuracy_guard),
             refine_accuracy_floor=float(p.refine_accuracy_floor))
 
-    def _pivot_ids(self) -> np.ndarray:
-        max_pivots = min(self._n, pivot_budget(self.params, self._n))
-        return self._tree.collect_pivots(max_pivots)
+    def _pivot_ids(self, rows: Optional[int] = None) -> np.ndarray:
+        """Seed-pivot ids valid for an engine over `rows` corpus rows
+        (default: the main-tier coverage).  The tree may postdate a
+        delta absorb and reference ids past a smaller engine's corpus —
+        those are clamped out (the delta scan covers their rows)."""
+        rows = self._main_rows() if rows is None else rows
+        max_pivots = min(rows, pivot_budget(self.params, rows))
+        pivots = self._tree.collect_pivots(max_pivots)
+        return pivots[pivots < rows]
 
     # parameters whose value is BAKED into a materialized engine snapshot:
     # changing one must invalidate the engine or the setting is a silent
@@ -199,6 +211,7 @@ class BKTIndex(VectorIndex):
         if ok and low in self._ENGINE_PARAMS:
             with self._lock:
                 self._engine = None
+                self._engine_param_gen += 1
         if ok and low in self._DENSE_PARAMS:
             with self._lock:
                 self._dense = None
@@ -226,8 +239,13 @@ class BKTIndex(VectorIndex):
             if self._dense is not None:
                 self._dense.register_devmem()
 
-    def _make_engine(self, graph: np.ndarray) -> GraphSearchEngine:
+    def _make_engine(self, graph: np.ndarray,
+                     rows: Optional[int] = None) -> GraphSearchEngine:
+        """Materialize an engine snapshot over `rows` corpus rows
+        (default: the main-tier coverage — rows in the delta shard are
+        served by the delta scan, never by the engine)."""
         p = self.params
+        rows = self._main_rows() if rows is None else rows
         if int(getattr(p, "flight_recorder", 0)):
             # index-level FlightRecorder=1 is the OFFLINE-run surface
             # (builder/searcher/bench CLIs with Index.Param passthrough):
@@ -241,8 +259,9 @@ class BKTIndex(VectorIndex):
                 or None,
                 dump_dir=getattr(p, "flight_dump_on_slow_query", "")
                 or None)
-        return GraphSearchEngine(self._host[:self._n], graph,
-                                 self._pivot_ids(), self._deleted[:self._n],
+        return GraphSearchEngine(self._host[:rows], graph[:rows],
+                                 self._pivot_ids(rows),
+                                 self._deleted[:rows],
                                  self.dist_calc_method, self.base,
                                  score_dtype=getattr(
                                      self.params, "beam_score_dtype", "auto"),
@@ -256,22 +275,33 @@ class BKTIndex(VectorIndex):
                                      self.params, "roofline_probe", 0))))
 
     def _get_engine(self) -> GraphSearchEngine:
-        if self._dirty or self._engine is None:
-            with self._lock:
-                if self._dirty or self._engine is None:
-                    self._engine = self._make_engine(self._graph.graph)
-                    self._dense = None
-                    self._dirty = False
-                    self._tombstones_dirty = False
-        elif self._tombstones_dirty:
-            # delete-only change: swap the mask, keep the snapshots
-            with self._lock:
-                if self._tombstones_dirty:
-                    self._engine.set_deleted(self._deleted)
-                    if self._dense is not None:
-                        self._dense.set_deleted(self._deleted)
-                    self._tombstones_dirty = False
-        return self._engine
+        """Pin the current engine snapshot (epoch-based handoff,
+        ISSUE 9): readers take ONE unlocked reference of an IMMUTABLE
+        snapshot and keep using it even if a writer publishes a newer
+        one mid-search — monotone, never torn.  The old code's fast
+        path re-read `self._engine` after its flag checks, so a
+        concurrent `set_parameter` nulling the attribute could hand a
+        reader None (or mutate a mask on an engine the writer was
+        discarding); now the pinned local is what's returned, and every
+        publish happens under the lock with an epoch bump."""
+        eng = self._engine
+        if eng is not None and not self._dirty \
+                and not self._tombstones_dirty:
+            return eng
+        with self._lock:
+            if self._dirty or self._engine is None:
+                self._engine = self._make_engine(self._graph.graph)
+                self._dense = None
+                self._dirty = False
+                self._tombstones_dirty = False
+                self._snapshot_epoch += 1
+            elif self._tombstones_dirty:
+                # delete-only change: swap the mask, keep the snapshots
+                self._engine.set_deleted(self._deleted)
+                if self._dense is not None:
+                    self._dense.set_deleted(self._deleted)
+                self._tombstones_dirty = False
+            return self._engine
 
     def _build_dense_searcher(self,
                               replicas: Optional[int] = None
@@ -287,20 +317,23 @@ class BKTIndex(VectorIndex):
         """
         if replicas is None:
             replicas = getattr(self.params, "dense_replicas", 1)
-        data = self._host[:self._n]
+        n = self._main_rows()
+        data = self._host[:n]
         centers, clusters = self._dense_clusters()
         return DenseTreeSearcher(
-            data, centers, clusters, self._deleted[:self._n],
+            data, centers, clusters, self._deleted[:n],
             self.dist_calc_method, self.base,
             replicas=replicas)
 
     def _dense_clusters(self):
         """Tree partition plus nearest-center assignment of rows appended
         after the last rebuild (host numpy throughout — the mesh packer
-        calls this without touching the device)."""
-        data = self._host[:self._n]
-        centers, clusters = self._partition_tree()
-        covered = np.zeros(self._n, bool)
+        calls this without touching the device).  Coverage stops at the
+        delta base like every main-tier snapshot."""
+        n = self._main_rows()
+        data = self._host[:n]
+        centers, clusters = self._partition_tree(n)
+        covered = np.zeros(n, bool)
         for c in clusters:
             covered[c] = True
         missing = np.flatnonzero(~covered)
@@ -319,14 +352,19 @@ class BKTIndex(VectorIndex):
                         [clusters[ci], extra])
         return centers, clusters
 
-    def _partition_tree(self):
+    def _partition_tree(self, rows: Optional[int] = None):
         """Cut the current tree into a corpus partition for the dense
-        layout; subclasses override per tree type (KDT cuts kd cells)."""
-        return partition_from_tree(self._tree, self._n,
+        layout; subclasses override per tree type (KDT cuts kd cells).
+        `rows` bounds the partition to the main-tier coverage."""
+        return partition_from_tree(self._tree,
+                                   self._main_rows() if rows is None
+                                   else rows,
                                    self.params.dense_cluster_size)
 
     def _get_dense(self) -> DenseTreeSearcher:
-        """Lazy dense snapshot for the dense search mode."""
+        """Lazy dense snapshot for the dense search mode (pinned by
+        local reference, like _get_engine — readers must never observe
+        a concurrent invalidation as None)."""
         if not getattr(self.params, "build_graph", 1):
             # dense-only index: refresh state WITHOUT materializing the
             # beam engine — its device copies of data + graph would
@@ -337,19 +375,23 @@ class BKTIndex(VectorIndex):
                     self._dense = None
                     self._dirty = False
                     self._tombstones_dirty = False
+                    self._snapshot_epoch += 1
                 elif self._tombstones_dirty:
                     if self._dense is not None:
-                        self._dense.set_deleted(self._deleted[:self._n])
+                        self._dense.set_deleted(
+                            self._deleted[:self._main_rows()])
                     self._tombstones_dirty = False
                 if self._dense is None:
                     self._dense = self._build_dense_searcher()
-            return self._dense
+                return self._dense
         self._get_engine()          # refresh dirty state under one lock
-        if self._dense is None:
-            with self._lock:
-                if self._dense is None:
-                    self._dense = self._build_dense_searcher()
-        return self._dense
+        dense = self._dense
+        if dense is not None:
+            return dense
+        with self._lock:
+            if self._dense is None:
+                self._dense = self._build_dense_searcher()
+            return self._dense
 
     # ---- build ------------------------------------------------------------
 
@@ -656,7 +698,10 @@ class BKTIndex(VectorIndex):
 
         if self._graph is None or self._graph.graph is None:
             return None
-        n = self._n
+        # main-tier rows only: while a delta is live the graph holds
+        # exactly _main_rows() rows (the tail is unlinked by design and
+        # would read as unreachable)
+        n = min(self._main_rows(), len(self._graph.graph))
         health = qualmon.graph_health(self._graph.graph[:n],
                                       self._deleted[:n], self._pivot_ids())
         shard = getattr(self, "_quality_shard",
@@ -699,18 +744,38 @@ class BKTIndex(VectorIndex):
 
         from sptag_tpu.algo.scheduler import pad_result_row
 
+        # delta union for the streaming path: the shard is scanned ONCE
+        # for the whole batch up front (fresh rows must be visible to
+        # streamed results exactly like whole-batch ones), and each
+        # retiring query merges its row in its resolve callback.  The
+        # scheduler walks the engine snapshot pinned at submit, so the
+        # two tiers stay disjoint even if a swap lands mid-flight.
+        delta = self._delta
+        delta_res = None
+        if delta is not None and delta.count:
+            from sptag_tpu.core.delta import merge_topk
+
+            delta_res = delta.search(queries, min(k, delta.count),
+                                     self._tombstone_mask())
         out = []
-        for inner in self._scheduler_submit(queries, min(k, self._n), mc,
-                                            rids=rids):
+        for row, inner in enumerate(
+                self._scheduler_submit(queries, min(k, self._n), mc,
+                                       rids=rids)):
             outer: Future = Future()
 
-            def _pad(f, outer=outer):
+            def _pad(f, outer=outer, row=row):
                 e = f.exception()
                 if e is not None:
                     outer.set_exception(e)
                     return
                 d, ids = f.result()
-                outer.set_result(pad_result_row(d, ids, k))
+                d, ids = pad_result_row(d, ids, k)
+                if delta_res is not None:
+                    md, mi = merge_topk(d[None, :], ids[None, :],
+                                        delta_res[0][row:row + 1],
+                                        delta_res[1][row:row + 1], k)
+                    d, ids = md[0], mi[0]
+                outer.set_result((d, ids))
             inner.add_done_callback(_pad)
             out.append(outer)
         return out
@@ -764,33 +829,42 @@ class BKTIndex(VectorIndex):
         ThreadPool.h:18).  Called under the writer lock.  At most one rebuild
         runs; a request arriving mid-rebuild coalesces into one follow-up
         pass."""
-        # the worker sets _rebuild_done under this same lock before it
-        # exits, so "job in flight" and "worker will still see the pending
-        # flag" are one atomic condition (no lost-request TOCTOU)
-        if not self._rebuild_done.is_set():
-            self._rebuild_pending = True
-            return
-        if self._rebuild_pool is None:
-            from sptag_tpu.utils.threadpool import ThreadPool
+        # re-entrant re-acquire (the callers already hold the RLock):
+        # makes the lock invariant LOCAL — the background-refine chain
+        # (ISSUE 9) reaches here through several frames and the
+        # protection must not depend on reading every caller
+        with self._lock:
+            # the worker sets _rebuild_done under this same lock before
+            # it exits, so "job in flight" and "worker will still see
+            # the pending flag" are one atomic condition (no lost-
+            # request TOCTOU)
+            if not self._rebuild_done.is_set():
+                self._rebuild_pending = True
+                return
+            if self._rebuild_pool is None:
+                from sptag_tpu.utils.threadpool import ThreadPool
 
-            # named pool: a leaked-worker warning (threadpool.py stop())
-            # must say WHICH pool wedged, and the lock sanitizer's
-            # watchdog dumps read better with the owner spelled out
-            self._rebuild_pool = ThreadPool(name="bkt-rebuild")
-            self._rebuild_pool.init(1)    # one worker = reference cadence
-        self._rebuild_pending = False
-        # enqueue BEFORE clearing the event: if add() raises (pool stopped
-        # by a concurrent close()), _rebuild_done must stay set or no
-        # rebuild would ever be schedulable again
-        self._rebuild_pool.add(self._rebuild_job)
-        self._rebuild_done.clear()
+                # named pool: a leaked-worker warning (threadpool.py
+                # stop()) must say WHICH pool wedged, and the lock
+                # sanitizer's watchdog dumps read better with the owner
+                # spelled out
+                self._rebuild_pool = ThreadPool(name="bkt-rebuild")
+                self._rebuild_pool.init(1)  # one worker = ref cadence
+            self._rebuild_pending = False
+            # enqueue BEFORE clearing the event: if add() raises (pool
+            # stopped by a concurrent close()), _rebuild_done must stay
+            # set or no rebuild would ever be schedulable again
+            self._rebuild_pool.add(self._rebuild_job)
+            self._rebuild_done.clear()
 
     def _rebuild_job(self) -> None:
         try:
             while True:
                 with self._lock:
                     gen = self._structure_gen
-                    n = self._n
+                    # main-tier rows only: delta rows are unlinked and
+                    # would put out-of-engine ids into the pivot set
+                    n = self._main_rows()
                     snapshot = self._host[:n].copy()
                 tree = self._new_tree()
                 tree.build(snapshot)      # the long pass — no lock held
@@ -842,7 +916,20 @@ class BKTIndex(VectorIndex):
 
     def _link_new_rows(self, engine: GraphSearchEngine, begin: int,
                        count: int) -> None:
-        """Wire `count` appended rows into the RNG graph.
+        """Wire `count` appended rows into the RNG graph (writer-lock
+        path: `self._graph.graph` holds `begin` linked rows)."""
+        self._graph.graph = self._linked_graph(
+            engine, self._graph.graph[:begin], begin, count, self._host)
+
+    def _linked_graph(self, engine: GraphSearchEngine,
+                      graph_base: np.ndarray, begin: int, count: int,
+                      host: np.ndarray) -> np.ndarray:
+        """Pure linking pass: returns a (begin+count, m') graph whose
+        first `begin` rows extend `graph_base` with reverse edges and
+        whose tail rows are freshly RNG-pruned — shared by the inline
+        `_add` path and the BACKGROUND delta absorb (which runs it
+        off-lock over pinned array references; rows [0, begin+count)
+        are append-only stable, so no copies are needed).
 
         Parity: the AddIndex tail (BKTIndex.cpp:523-526): per new node, an
         AddCEF-budget search + RebuildNeighbors for its own row, then
@@ -851,18 +938,18 @@ class BKTIndex(VectorIndex):
         """
         p = self.params
         m = p.neighborhood_size
-        new_rows = np.full((count, self._graph.graph.shape[1]), -1, np.int32)
-        grown = np.concatenate([self._graph.graph, new_rows], axis=0)
+        new_rows = np.full((count, graph_base.shape[1]), -1, np.int32)
+        grown = np.concatenate([graph_base, new_rows], axis=0)
 
         add_k = min(p.add_cef + 1, max(begin, 1))
-        queries = self._host[begin:begin + count]
+        queries = host[begin:begin + count]
         d, ids = engine.search(
             queries, add_k, max_check=p.max_check_for_refine_graph,
             nbp_limit=p.no_better_propagation_limit)
 
         from sptag_tpu.ops import graph as graph_ops
         import jax.numpy as jnp
-        vecs = self._host[np.maximum(ids, 0)].astype(np.float32)
+        vecs = host[np.maximum(ids, 0)].astype(np.float32)
         keep = np.asarray(graph_ops.rng_select(
             jnp.asarray(queries.astype(np.float32)), jnp.asarray(vecs),
             jnp.asarray(d), jnp.asarray(ids >= 0), m,
@@ -898,8 +985,8 @@ class BKTIndex(VectorIndex):
 
             cand = np.concatenate([grown[uniq].astype(np.int64), ins], axis=1)
             valid = cand >= 0
-            cvecs = self._host[np.maximum(cand, 0)].astype(np.float32)
-            tvecs = self._host[uniq].astype(np.float32)
+            cvecs = host[np.maximum(cand, 0)].astype(np.float32)
+            tvecs = host[uniq].astype(np.float32)
             cd = np.asarray(graph_ops.node_candidate_dists(
                 jnp.asarray(tvecs), jnp.asarray(cvecs),
                 int(self.dist_calc_method), self.base))
@@ -919,7 +1006,7 @@ class BKTIndex(VectorIndex):
                 np.take_along_axis(cand_s, np.maximum(keep_r, 0), axis=1),
                 -1).astype(np.int32)
             grown[uniq] = new_rows
-        self._graph.graph = grown
+        return grown
 
     def _delete_id(self, vid: int) -> bool:
         if self._deleted[vid]:
@@ -929,6 +1016,182 @@ class BKTIndex(VectorIndex):
         # tombstones ride a cheap mask swap, not a snapshot rebuild
         self._tombstones_dirty = True
         return True
+
+    # ---- delta shard + background refine/swap (ISSUE 9) -------------------
+
+    def _append_rows_unlinked(self, data: np.ndarray) -> Optional[int]:
+        """Delta-shard fast path: rows land in host storage but are NOT
+        linked (no AddCEF search) and do NOT invalidate the engine
+        snapshot — the FLAT delta scan serves them until a refine
+        absorbs the tail.  The GRAPH is deliberately untouched: while a
+        delta is live the graph holds exactly `_main_rows()` rows, and
+        the absorb's `_linked_graph` pass appends the tail rows then —
+        growing it here with -1 rows cost an O(n*m) full-graph copy per
+        acked add batch (review fix), for rows nothing reads."""
+        begin = self._n
+        count = data.shape[0]
+        self._reserve(count)
+        self._host[begin:begin + count] = data
+        self._n += count
+        return begin
+
+    def _tombstone_mask(self) -> Optional[np.ndarray]:
+        return self._deleted[:self._n]
+
+    def _absorb_delta_impl(self, begin: int, count: int) -> None:
+        """Synchronous absorb (lock held): link the delta tail into the
+        graph against an engine covering [0, begin), then invalidate so
+        the next snapshot covers everything.  Used at overflow, save,
+        and explicit refine; the BACKGROUND path (_auto_refine_job)
+        does the same work off-thread and swaps atomically."""
+        if getattr(self.params, "build_graph", 1):
+            engine = self._engine
+            if engine is None or engine.n != begin:
+                engine = self._make_engine(self._graph.graph, rows=begin)
+            # the graph holds exactly `begin` rows while the delta is
+            # live (_append_rows_unlinked defers growth); linking
+            # appends the tail and refreshes the prefix reverse edges
+            self._graph.graph = self._linked_graph(
+                engine, self._graph.graph[:begin], begin, count,
+                self._host)
+            self._adds_since_rebuild += count
+            if self._adds_since_rebuild >= \
+                    self.params.add_count_for_rebuild:
+                self._adds_since_rebuild = 0
+                self._schedule_rebuild()
+        self._dirty = True
+
+    def _schedule_auto_refine(self) -> None:
+        """Queue the background absorb+swap on the index's worker pool
+        (shared with the tree rebuild — background work serializes).
+        At most one refine is in flight; the job re-checks the
+        threshold when it finishes, so a delta that refilled during the
+        build gets the next round without a new trigger."""
+        with self._lock:
+            if self._refine_in_flight:
+                return
+            d = self._delta
+            if d is None or not d.count:
+                return
+            if not getattr(self.params, "build_graph", 1):
+                # dense-only: absorbing is a partition reassignment at
+                # the next snapshot — cheap enough inline
+                self._absorb_delta_locked()
+                return
+            if self._rebuild_pool is None:
+                from sptag_tpu.utils.threadpool import ThreadPool
+
+                self._rebuild_pool = ThreadPool(name="bkt-rebuild")
+                self._rebuild_pool.init(1)
+            self._refine_in_flight = True
+            try:
+                self._rebuild_pool.add(self._auto_refine_job)
+            except BaseException:
+                self._refine_in_flight = False
+                raise
+
+    def _auto_refine_job(self) -> None:
+        """Background refine + snapshot swap WITHOUT drain: link the
+        delta tail into a graph copy and build a fresh engine OFF the
+        writer lock (searches and acks continue throughout), then
+        publish under the lock and retire the superseded scheduler —
+        its resident queries finish on the old immutable snapshot while
+        the replacement accepts refills (BeamSlotScheduler.retire(),
+        THE snapshot-swap path).  Zero queries dropped; staleness is
+        bounded by this job's wall time."""
+        from sptag_tpu.utils import flightrec, metrics
+
+        t0 = time.monotonic()
+        old_sched = None
+        try:
+            with self._lock:
+                d = self._delta
+                if d is None or not d.count:
+                    return
+                gen = self._structure_gen
+                pgen = self._engine_param_gen
+                b0 = d.base_id
+                n0 = b0 + d.count
+                host = self._host          # pinned; rows [0, n0) stable
+                graph_base = self._graph.graph[:b0].copy()
+                engine = self._engine
+                if engine is None or engine.n != b0 or self._dirty:
+                    engine = None
+            if flightrec.enabled():
+                flightrec.record("index", "swap_begin",
+                                 payload={"rows": n0 - b0, "base": b0})
+            if engine is None:
+                # off-lock materialization over the stable prefix
+                engine = self._make_engine(self._graph.graph, rows=b0)
+            new_graph = self._linked_graph(engine, graph_base, b0,
+                                           n0 - b0, host)
+            new_engine = self._make_engine(new_graph, rows=n0)
+            with self._lock:
+                d = self._delta
+                if self._structure_gen != gen or d is None \
+                        or d.base_id != b0 \
+                        or self._engine_param_gen != pgen:
+                    # a compaction / synchronous absorb / engine-baked
+                    # set_parameter raced the build; its result
+                    # supersedes ours (publishing would silently revert
+                    # the operator's change — review fix)
+                    metrics.inc("mutation.swap_stale_discards")
+                    return
+                # install the WHOLE linked graph, not just the tail
+                # rows: _linked_graph also re-pruned prefix rows with
+                # reverse edges INTO the absorbed tail, and dropping
+                # those left the host graph unable to reach the new
+                # rows after the next engine rebuild (review fix).  The
+                # prefix is stable under us: any writer that could have
+                # changed rows [0, b0) also bumped _structure_gen or
+                # replaced the delta, both caught above.
+                self._graph.graph = new_graph
+                # fold tombstones that landed during the build, then
+                # publish: one attribute write, readers pin by reference
+                new_engine.set_deleted(self._deleted[:n0])
+                self._engine = new_engine
+                self._dense = None
+                self._dirty = False
+                self._tombstones_dirty = False
+                self._snapshot_epoch += 1
+                self._swap_count += 1
+                tail = (self._host[n0:self._n].copy()
+                        if self._n > n0 else None)
+                self._delta = d.rebased(n0, tail)
+                metrics.set_gauge(
+                    "mutation.delta_rows",
+                    self._delta.count if self._delta is not None else 0)
+                self._adds_since_rebuild += n0 - b0
+                if self._adds_since_rebuild >= \
+                        self.params.add_count_for_rebuild:
+                    self._adds_since_rebuild = 0
+                    self._schedule_rebuild()
+                old_sched = self._scheduler
+                self._scheduler = None
+            if old_sched is not None:
+                old_sched.retire()    # non-blocking; residents finish
+            t1 = time.monotonic()
+            # copy-on-write publish (single background writer; readers
+            # snapshot the attribute — core/index.py __init__ note)
+            self._swap_windows = tuple(self._swap_windows[-15:]) + (
+                (t0 * 1000.0, t1 * 1000.0),)
+            metrics.inc("mutation.swaps")
+            metrics.observe("mutation.swap_s", t1 - t0)
+            if flightrec.enabled():
+                flightrec.record("index", "swap_publish",
+                                 dur_ns=int((t1 - t0) * 1e9),
+                                 payload={"rows": n0 - b0,
+                                          "epoch": self._snapshot_epoch})
+            self.publish_quality_health(background=True)
+        except BaseException:
+            # a failed refine must not wedge mutation: the delta keeps
+            # serving, the next trigger retries
+            metrics.inc("mutation.refine_errors")
+            log.exception("background delta refine failed")
+        finally:
+            with self._lock:
+                self._refine_in_flight = False
+            self._maybe_auto_refine()
 
     # ---- refine (compaction) ----------------------------------------------
 
@@ -1025,8 +1288,11 @@ class BKTIndex(VectorIndex):
         ]
 
     def _save_index_data(self, folder: str) -> None:
+        from sptag_tpu.io import atomic
+
         for name, writer in self._blob_writers():
-            with open(os.path.join(folder, name), "wb") as f:
+            with atomic.checked_open(os.path.join(folder, name),
+                                     "wb") as f:
                 writer(f)
 
     def _load_index_data(self, folder: str) -> None:

@@ -306,6 +306,27 @@ class FlatIndex(VectorIndex):
         self._dirty = True
         return True
 
+    # ---- delta shard (ISSUE 9) --------------------------------------------
+
+    def _append_rows_unlinked(self, data: np.ndarray) -> Optional[int]:
+        """Delta-shard fast path: rows land in host storage WITHOUT
+        dirtying the device snapshot — the (Npad, D) upload FLAT would
+        otherwise pay per add is exactly what the bounded delta scan
+        avoids.  The snapshot keeps covering [0, _main_rows())."""
+        begin = self._n
+        self._reserve(data.shape[0])
+        self._host[begin:begin + data.shape[0]] = data
+        self._n += data.shape[0]
+        return begin
+
+    def _tombstone_mask(self) -> Optional[np.ndarray]:
+        return self._deleted[:self._n]
+
+    def _absorb_delta_impl(self, begin: int, count: int) -> None:
+        # the rows are already resident in _host; absorbing is just
+        # letting the next snapshot cover them
+        self._dirty = True
+
     # ---- device snapshot --------------------------------------------------
 
     def _retrack_devmem(self) -> None:
@@ -330,12 +351,15 @@ class FlatIndex(VectorIndex):
         with self._lock:
             if not self._dirty and self._device is not None:
                 return self._device
-            n_pad = max(_ROW_PAD, round_up(self._n, _ROW_PAD))
+            # snapshot coverage stops at the delta base: rows beyond it
+            # are served by the FLAT-scanned delta shard until absorbed
+            n = self._main_rows()
+            n_pad = max(_ROW_PAD, round_up(n, _ROW_PAD))
             dt = dtype_of(self.value_type)
             data = np.zeros((n_pad, self.feature_dim), dtype=dt)
-            data[:self._n] = self._host[:self._n]
+            data[:n] = self._host[:n]
             invalid = np.ones(n_pad, dtype=bool)
-            invalid[:self._n] = self._deleted[:self._n]
+            invalid[:n] = self._deleted[:n]
             data_d = jnp.asarray(data)
             sqnorm_d = dist_ops.row_sqnorms(data_d)
             invalid_d = jnp.asarray(invalid)
@@ -535,8 +559,11 @@ class FlatIndex(VectorIndex):
         ]
 
     def _save_index_data(self, folder: str) -> None:
+        from sptag_tpu.io import atomic
+
         for name, writer in self._blob_writers():
-            with open(os.path.join(folder, name), "wb") as f:
+            with atomic.checked_open(os.path.join(folder, name),
+                                     "wb") as f:
                 writer(f)
 
     def _load_index_data(self, folder: str) -> None:

@@ -51,11 +51,13 @@ class KDTIndex(BKTIndex):
         return KDTree(tree_number=p.tree_number, top_dims=p.kdt_top_dims,
                       samples=p.samples)
 
-    def _pivot_ids(self) -> np.ndarray:
+    def _pivot_ids(self, rows: Optional[int] = None) -> np.ndarray:
         # the engine's shared pivot set is only a fallback for KDT (used
         # when no per-query seeds are provided, e.g. graph refine); a
-        # uniform stride sample plays the role of tree-top pivots
-        n = self._n
+        # uniform stride sample plays the role of tree-top pivots.
+        # `rows` bounds the sample to the engine's corpus coverage (the
+        # delta shard serves rows past it — ISSUE 9)
+        n = self._main_rows() if rows is None else rows
         count = min(n, max(64, self.params.initial_dynamic_pivots * 32))
         return np.linspace(0, n - 1, count, dtype=np.int32)
 
@@ -79,13 +81,15 @@ class KDTIndex(BKTIndex):
             max_check if max_check is not None else self.params.max_check)
         return self._tree.collect_seeds(queries, backtrack=backtrack)
 
-    def _partition_tree(self):
+    def _partition_tree(self, rows: Optional[int] = None):
         # SearchMode=dense runs the shared MXU block scan over a kd-cell
         # partition (the default stays the reference-semantics kd-seeded
         # walk via _engine_search below)
         from sptag_tpu.algo.dense import partition_from_kdtree
 
-        return partition_from_kdtree(self._tree, self._n,
+        return partition_from_kdtree(self._tree,
+                                     self._main_rows() if rows is None
+                                     else rows,
                                      self.params.dense_cluster_size)
 
     def _scheduler_submit(self, queries: np.ndarray, k: int,

@@ -310,8 +310,19 @@ class BeamSlotScheduler:
         exactly like monolithic searches that were already executing —
         while the replacement serves new traffic."""
         with self._cv:
+            already = self._draining
             self._draining = True
             self._cv.notify()
+            resident = (sum(p.live_count() for p in self._pools.values())
+                        + self._pending_count())
+        if not already:
+            # swap-drain observability (ISSUE 9): how many schedulers a
+            # mutation stream retired and how much work each drained —
+            # the serve-tier witness that a snapshot swap dropped nothing
+            metrics.inc("scheduler.retired_schedulers")
+            if flightrec.enabled():
+                flightrec.record("scheduler", "retire_drain",
+                                 payload={"resident": resident})
 
     def stop(self) -> None:
         """Stop the worker and fail outstanding queries with

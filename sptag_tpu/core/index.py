@@ -41,8 +41,9 @@ from sptag_tpu.core.types import (
     enum_from_string,
 )
 from sptag_tpu.core.vectorset import MetadataSet, VectorSet, metas_for
+from sptag_tpu.io import atomic, wal
 from sptag_tpu.ops import distance as dist_ops
-from sptag_tpu.utils import locksan
+from sptag_tpu.utils import faultinject, locksan, metrics
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -115,6 +116,28 @@ class VectorIndex(abc.ABC):
         self._lock = locksan.make_rlock("VectorIndex._lock")
         self._meta_file = "metadata.bin"
         self._meta_index_file = "metadataIndex.bin"
+        # mutation-under-load state (ISSUE 9).  The WAL writer is armed
+        # by load_index / a successful save_index when WalEnabled=1;
+        # _wal_replaying suppresses re-logging while records re-apply.
+        self._wal: Optional[wal.WalWriter] = None
+        self._wal_folder: Optional[str] = None
+        self._wal_replaying = False
+        self._acked_writes = 0
+        # bounded FLAT-scanned side index for fresh rows (core/delta.py);
+        # None until DeltaShardCapacity routes an add into it
+        self._delta = None
+        # epoch-based snapshot handoff: readers pin a snapshot by local
+        # reference, writers bump the epoch at every publish — the
+        # number a /healthz probe watches to see swaps land
+        self._snapshot_epoch = 0
+        self._swap_count = 0
+        self._refine_in_flight = False
+        # (start_ms, end_ms) monotonic wall windows of recent swaps —
+        # the bench's swap-window p99 partitioning reads these.
+        # COPY-ON-WRITE tuple, never mutated in place: mutation_state()
+        # iterates it lock-free from /healthz scrapes, and an in-place
+        # append racing that iteration would raise (review fix)
+        self._swap_windows: tuple = ()
 
     # ---- subclass surface -------------------------------------------------
 
@@ -285,6 +308,7 @@ class VectorIndex(abc.ABC):
                                  build_fingerprint(data, config))
         with self._lock:
             self._build(data, checkpoint=ck)
+            self._reset_delta()
             self.metadata = metadata
             if with_meta_index and metadata is not None:
                 self.build_meta_mapping()
@@ -343,7 +367,12 @@ class VectorIndex(abc.ABC):
             raise ValueError(
                 f"query dim {queries.shape[1]} != index dim {self.feature_dim}")
         queries = self._prepare_query(queries)
-        return self._search_batch(queries, k, max_check, search_mode)
+        # delta/main union (ISSUE 9): the main tier covers its frozen
+        # snapshot; fresh rows ride the FLAT-scanned delta shard and the
+        # two top-k lists merge here — one flag test when no delta
+        return self._merge_delta(
+            queries, k, self._search_batch(queries, k, max_check,
+                                           search_mode))
 
     def submit_batch(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
@@ -410,7 +439,11 @@ class VectorIndex(abc.ABC):
                 f"{self.feature_dim}")
         queries = self._prepare_query(queries)
         k_eff = min(k, self.num_samples)
-        dists, ids = self._exact_scan(queries, k_eff)
+        # the oracle unions the delta scan too: both tiers are exact, and
+        # an oracle blind to just-acked rows would score the serving path
+        # against a stale truth (utils/qualmon.py)
+        dists, ids = self._merge_delta(queries, k_eff,
+                                       self._exact_scan(queries, k_eff))
         if dists.shape[1] < k:
             q = dists.shape[0]
             dists = np.concatenate(
@@ -506,41 +539,293 @@ class VectorIndex(abc.ABC):
     def add(self, vectors, metadata: Optional[MetadataSet] = None,
             with_meta_index: bool = False) -> ErrorCode:
         """Parity: VectorIndex::AddIndex + BKT dedupe-by-metadata semantics
-        (reference VectorIndex.cpp:224-231, BKTIndex.cpp:462-529)."""
+        (reference VectorIndex.cpp:224-231, BKTIndex.cpp:462-529).
+
+        Durability (ISSUE 9): with the WAL armed, the add's record is
+        appended + fsync'd BEFORE this returns — an acked add survives
+        process death (load_index replays it).  With DeltaShardCapacity
+        set, the rows land in the FLAT-scanned delta shard and are
+        searchable immediately, without re-linking the graph or
+        invalidating the engine snapshot."""
         data = self._prepare_vectors(vectors)
         if data.size == 0:
             return ErrorCode.EmptyData
+        metas = ([metadata.get_metadata(i) for i in range(data.shape[0])]
+                 if metadata is not None else None)
         with self._lock:
-            if self.num_samples == 0:
-                # data is already normalized; bypass build()'s re-preparation
-                self._build(data)
-                self.metadata = metadata
-                if with_meta_index and metadata is not None:
-                    self.build_meta_mapping()
-                return ErrorCode.Success
-            begin = self._add(data)
-            if metadata is not None:
-                if self.metadata is None:
-                    self.metadata = MetadataSet([b""] * begin)
-                for i in range(data.shape[0]):
-                    meta = metadata.get_metadata(i)
-                    self.metadata.add(meta)
-                    if self._meta_to_vec is not None and meta:
-                        old = self._meta_to_vec.get(meta)
-                        if old is not None:
-                            self._delete_id(old)
-                        self._meta_to_vec[meta] = begin + i
-            elif self.metadata is not None:
-                for _ in range(data.shape[0]):
-                    self.metadata.add(b"")
-            if with_meta_index and self.metadata is not None \
-                    and self._meta_to_vec is None:
-                # honor with_meta_index on an ALREADY-BUILT index too (it
-                # previously only applied to the first-add-as-build path,
-                # leaving delete_by_metadata dead after admin adds)
-                self.build_meta_mapping()
+            # log BEFORE apply (standard WAL ordering, review fix): a
+            # failed append leaves the in-memory index untouched, so an
+            # un-acked add is never resident (and never folded into a
+            # later save); a torn record truncates at replay.  `begin`
+            # is the tail by construction — every add path appends.
+            # Redo semantics for the inverse failure (append succeeded,
+            # apply raised): the caller sees an exception and the
+            # write's outcome is INDETERMINATE — a restart may replay
+            # the durable record.  That is the standard WAL contract;
+            # what is guaranteed is never a HALF-applied state.
+            begin = self.num_samples
+            self._wal_log(wal.pack_add(begin, data, metas))
+            applied = self._apply_add(data, metas, with_meta_index)
+            assert applied == begin, (applied, begin)
         self.publish_quality_health(background=True)
+        self._maybe_auto_refine()
         return ErrorCode.Success
+
+    def _apply_add(self, data: np.ndarray, metas: Optional[List[bytes]],
+                   with_meta_index: bool) -> int:
+        """THE add effect, shared verbatim by the live path and WAL
+        replay (caller holds the lock; `data` already prepared).
+        Returns the global id the first row landed at."""
+        if self.num_samples == 0:
+            # data is already normalized; bypass build()'s re-preparation
+            self._build(data)
+            self._reset_delta()
+            self.metadata = (MetadataSet(metas) if metas is not None
+                             else None)
+            if with_meta_index and self.metadata is not None:
+                self.build_meta_mapping()
+            return 0
+        begin = self._route_add(data)
+        if metas is not None:
+            if self.metadata is None:
+                self.metadata = MetadataSet([b""] * begin)
+            for i in range(data.shape[0]):
+                meta = metas[i]
+                self.metadata.add(meta)
+                if self._meta_to_vec is not None and meta:
+                    old = self._meta_to_vec.get(meta)
+                    if old is not None:
+                        self._delete_id(old)
+                    self._meta_to_vec[meta] = begin + i
+        elif self.metadata is not None:
+            for _ in range(data.shape[0]):
+                self.metadata.add(b"")
+        if with_meta_index and self.metadata is not None \
+                and self._meta_to_vec is None:
+            # honor with_meta_index on an ALREADY-BUILT index too (it
+            # previously only applied to the first-add-as-build path,
+            # leaving delete_by_metadata dead after admin adds)
+            self.build_meta_mapping()
+        return begin
+
+    def _route_add(self, data: np.ndarray) -> int:
+        """Storage routing for appended rows (lock held): the delta
+        shard when enabled and the batch fits, the subclass's linked
+        `_add` otherwise.  The delta is always the TAIL of the id space
+        — a fallback to `_add` absorbs it first so ids stay ordered
+        main-then-delta."""
+        cap = int(getattr(self.params, "delta_shard_capacity", 0) or 0)
+        if cap > 0:
+            if data.shape[0] > cap:
+                # bulk load: the shard can never hold it — fold any
+                # pending delta, then take the linked path
+                self._absorb_delta_locked()
+            else:
+                if self._delta is not None and \
+                        self._delta.count + data.shape[0] > self._delta.capacity:
+                    self._absorb_delta_locked()
+                begin = self._delta_append(data, cap)
+                if begin is not None:
+                    return begin
+        elif self._delta is not None:
+            # knob turned off with rows still resident: fold them back
+            self._absorb_delta_locked()
+        return self._add(data)
+
+    def _delta_append(self, data: np.ndarray, cap: int) -> Optional[int]:
+        """Append `data` to the delta shard (creating it at the current
+        tail when absent); None when the subclass has no unlinked-append
+        support — the caller falls back to `_add`."""
+        from sptag_tpu.core.delta import DeltaShard
+
+        begin = self._append_rows_unlinked(data)
+        if begin is None:
+            return None
+        if self._delta is None:
+            self._delta = DeltaShard(begin, data.shape[1], data.dtype,
+                                     cap, int(self.dist_calc_method),
+                                     self.base)
+        self._delta.append(data, begin)
+        metrics.set_gauge("mutation.delta_rows", self._delta.count)
+        return begin
+
+    # ---- delta-shard surface (subclass hooks + shared plumbing) -----------
+
+    def _append_rows_unlinked(self, data: np.ndarray) -> Optional[int]:
+        """Append rows to the subclass's storage WITHOUT linking them
+        into search structures or invalidating the engine snapshot —
+        the delta shard serves them until a refine absorbs them.
+        Returns the first new global id, or None when the index family
+        has no such fast path (the caller then uses `_add`)."""
+        return None
+
+    def _tombstone_mask(self) -> Optional[np.ndarray]:
+        """The full (num_samples,) tombstone mask, for masking delta
+        rows at query time; None when the family keeps none."""
+        return None
+
+    def _absorb_delta_impl(self, begin: int, count: int) -> None:
+        """Fold rows [begin, begin+count) — currently served by the
+        delta shard — into the subclass's main structures (lock held).
+        Families that support `_append_rows_unlinked` must override."""
+        raise NotImplementedError
+
+    def _absorb_delta_locked(self) -> None:
+        """Absorb + drop the delta shard (lock held); no-op when empty.
+        Every path that appends via `_add`, remaps ids, or persists the
+        index calls this first — the invariant is that the delta is
+        always the unlinked TAIL [base_id, num_samples)."""
+        d = self._delta
+        if d is None:
+            return
+        self._delta = None
+        if d.count:
+            self._absorb_delta_impl(d.base_id, d.count)
+        from sptag_tpu.utils import devmem
+
+        devmem.untrack(d)
+        metrics.set_gauge("mutation.delta_rows", 0)
+
+    def _reset_delta(self) -> None:
+        """Discard the delta wholesale (build/load replaced the corpus;
+        there is no tail to fold)."""
+        if self._delta is not None:
+            from sptag_tpu.utils import devmem
+
+            devmem.untrack(self._delta)
+            self._delta = None
+            metrics.set_gauge("mutation.delta_rows", 0)
+
+    def _main_rows(self) -> int:
+        """Rows covered by the MAIN search structures: everything below
+        the delta shard's base (== num_samples when no delta is live).
+        Engine/dense snapshot builds size themselves with this, so the
+        two tiers never overlap."""
+        d = self._delta
+        return d.base_id if (d is not None and d.count) else \
+            self.num_samples
+
+    def _merge_delta(self, queries: np.ndarray, k: int,
+                     main: Tuple[np.ndarray, np.ndarray]
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Union the main tier's top-k with the delta scan's (queries
+        already prepared).  Reads the shard via ONE local reference —
+        a concurrent swap retires it harmlessly (merge_topk dedupes the
+        brief double-coverage window)."""
+        d = self._delta
+        if d is None or not d.count:
+            return main
+        from sptag_tpu.core.delta import merge_topk
+
+        dd, di = d.search(queries, min(k, d.count),
+                          self._tombstone_mask())
+        return merge_topk(main[0], main[1], dd, di, k)
+
+    def _maybe_auto_refine(self) -> None:
+        """Schedule a background absorb+swap once the delta crosses
+        AutoRefineThreshold (subclass hook decides how; the base folds
+        inline — correct for families whose absorb is cheap)."""
+        thr = int(getattr(self.params, "auto_refine_threshold", 0) or 0)
+        d = self._delta
+        if thr <= 0 or d is None or d.count < thr:
+            return
+        self._schedule_auto_refine()
+
+    def _schedule_auto_refine(self) -> None:
+        with self._lock:
+            self._absorb_delta_locked()
+
+    def mutation_state(self) -> Dict[str, object]:
+        """Swap/durability state for /healthz and /debug/mutation: the
+        epoch a reader pins, WAL accounting, delta occupancy, and the
+        recent swap windows the bench partitions latencies by."""
+        d = self._delta
+        return {
+            "epoch": self._snapshot_epoch,
+            "wal": self._wal is not None,
+            "wal_folder": self._wal_folder or "",
+            "acked_writes": self._acked_writes,
+            "delta_rows": int(d.count) if d is not None else 0,
+            "delta_capacity": int(getattr(self.params,
+                                          "delta_shard_capacity", 0) or 0),
+            "swap_count": self._swap_count,
+            "refine_in_flight": self._refine_in_flight,
+            "swap_windows_ms": [list(w) for w in self._swap_windows],
+        }
+
+    # ---- write-ahead log plumbing -----------------------------------------
+
+    def _wal_log(self, payload: bytes) -> None:
+        """Append one mutation record (lock held).  Raising here means
+        the mutation was NOT acked — by the crash-consistency contract
+        the caller's exception propagates and the client must retry."""
+        if self._wal is None or self._wal_replaying:
+            return
+        self._wal.append(payload)
+        self._acked_writes += 1
+        metrics.inc("mutation.wal_appends")
+
+    def _arm_wal(self, folder: str) -> None:
+        """(Re)open the WAL writer at `folder` — called after load and
+        after every successful save (the publish moved the log)."""
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = wal.WalWriter(
+            os.path.join(folder, wal.WAL_NAME),
+            sync=bool(int(getattr(self.params, "wal_fsync", 1) or 0)))
+        self._wal_folder = folder
+
+    def _replay_wal(self, folder: str) -> None:
+        """Re-apply the folder's log over the loaded snapshot: torn
+        tails truncate, records already inside the snapshot (the
+        published-but-log-not-yet-reset window) are skipped by their
+        `begin`, deletes are idempotent."""
+        path = os.path.join(folder, wal.WAL_NAME)
+        records, torn = wal.replay(path)
+        if torn:
+            metrics.inc("mutation.wal_torn_tails")
+        if not records:
+            return
+        applied = 0
+        with self._lock:
+            self._wal_replaying = True
+            try:
+                for rec in records:
+                    try:
+                        if isinstance(rec, wal.WalAdd):
+                            n = self.num_samples
+                            if rec.begin + rec.rows.shape[0] <= n:
+                                continue      # folded into the snapshot
+                            skip = max(0, n - rec.begin)
+                            rows = rec.rows[skip:]
+                            metas = (rec.metas[skip:]
+                                     if rec.metas is not None else None)
+                            self._apply_add(np.ascontiguousarray(rows),
+                                            metas, False)
+                        else:
+                            for vid in rec.vids:
+                                if 0 <= vid < self.num_samples:
+                                    self._delete_id(int(vid))
+                        applied += 1
+                    except Exception:                    # noqa: BLE001
+                        # a record that fails to APPLY (resource
+                        # exhaustion, a bug) must not make a folder
+                        # with a perfectly valid snapshot unloadable —
+                        # stop at the failed record (later ones may
+                        # depend on it) and serve the durable prefix;
+                        # the failure is loud, never silent
+                        metrics.inc("mutation.wal_replay_errors")
+                        log.exception(
+                            "WAL replay: record %d failed to apply; "
+                            "serving the snapshot + %d replayed "
+                            "record(s)", applied, applied)
+                        break
+            finally:
+                self._wal_replaying = False
+        if applied:
+            log.info("WAL replay: %d record(s) re-applied from %s",
+                     applied, path)
+            metrics.inc("mutation.wal_replayed", applied)
 
     def delete(self, vectors) -> ErrorCode:
         """Delete-by-content: search each vector, tombstone exact matches
@@ -554,15 +839,30 @@ class VectorIndex(abc.ABC):
         # data is already normalized — call the subclass engine directly
         # rather than search_batch, which would normalize a second time.
         # The reference searches with k=CEF for deletes (BKTIndex.cpp:441).
+        # The delta merge rides along: a row acked into the delta shard
+        # moments ago is deletable-by-content like any other.
         k = int(getattr(self.params, "cef", 32))
-        dists, ids = self._search_batch(data, min(k, self.num_samples))
+        k_eff = min(k, self.num_samples)
+        dists, ids = self._merge_delta(
+            data, k_eff, self._search_batch(data, k_eff))
+        tombstoned: List[int] = []
+        seen = set()
         with self._lock:
+            # collect the matches first, LOG, then apply (the add
+            # path's log-before-apply ordering, review fix)
             for q, row_d, row_i in zip(data, dists, ids):
                 for d, v in zip(row_d, row_i):
                     if v >= 0 and d <= max(DELETE_EPS, _NEAR_EPS) and \
                             self._exact_distance(q, int(v)) <= DELETE_EPS:
-                        self._delete_id(int(v))
                         found_any = True
+                        if int(v) not in seen and \
+                                self.contains_sample(int(v)):
+                            seen.add(int(v))
+                            tombstoned.append(int(v))
+            if tombstoned:
+                self._wal_log(wal.pack_delete(tombstoned))
+                for v in tombstoned:
+                    self._delete_id(v)
         if found_any:
             self.publish_quality_health(background=True)
         return ErrorCode.Success if found_any else ErrorCode.VectorNotFound
@@ -588,7 +888,9 @@ class VectorIndex(abc.ABC):
         if vid is None:
             return ErrorCode.VectorNotFound
         with self._lock:
-            self._delete_id(vid)
+            if self.contains_sample(vid):
+                self._wal_log(wal.pack_delete([vid]))     # log first
+                self._delete_id(vid)
         return ErrorCode.Success
 
     # ---- refine / merge ---------------------------------------------------
@@ -605,6 +907,9 @@ class VectorIndex(abc.ABC):
 
     def refine_index(self) -> ErrorCode:
         with self._lock:
+            # compaction remaps ids: the delta's global-id tail must be
+            # folded into the main structures first
+            self._absorb_delta_locked()
             self._refine_impl()
         self.publish_quality_health(background=True)
         return ErrorCode.Success
@@ -630,8 +935,14 @@ class VectorIndex(abc.ABC):
         with self._lock:
             if self.num_samples == 0:
                 self._build(rows)
+                self._reset_delta()
                 self.metadata = metas
             else:
+                self._absorb_delta_locked()   # _add appends at the tail
+                self._wal_log(wal.pack_add(   # log first (add() ordering)
+                    self.num_samples, rows,
+                    [metas.get_metadata(i) for i in range(len(keep))]
+                    if metas is not None else None))
                 begin = self._add(rows)
                 if metas is not None:
                     if self.metadata is None:
@@ -691,15 +1002,37 @@ class VectorIndex(abc.ABC):
             token = f"{os.getpid()}-{threading.get_ident()}"
             target = folder.rstrip("/\\") + f".saving-{token}"
             os.makedirs(target, exist_ok=True)
+            # saved snapshots are always fully linked: the delta tail
+            # folds into the main structures before a byte is staged
+            self._absorb_delta_locked()
             if self.need_refine:
                 self._refine_impl()
-            with open(os.path.join(target, "indexloader.ini"), "w") as f:
+            wal_on = bool(int(getattr(self.params, "wal_enabled", 0)
+                              or 0))
+            with atomic.checked_open(
+                    os.path.join(target, "indexloader.ini"), "w") as f:
                 f.write(self.save_index_config())
             if self.metadata is not None:
                 self.metadata.save(os.path.join(target, self._meta_file),
                                    os.path.join(target,
                                                 self._meta_index_file))
             self._save_index_data(target)
+            if wal_on:
+                # the published snapshot ships an EMPTY log: every acked
+                # record is folded into the blobs beside it, and the
+                # directory swap retires the old log atomically with the
+                # old blobs — there is no post-publish truncate to crash
+                # between
+                wal.create_empty(os.path.join(target, wal.WAL_NAME))
+            # manifest LAST: its presence vouches for the checksums of
+            # everything staged before it.  Excluded: the WAL (it
+            # legitimately grows after the publish) and indexloader.ini
+            # (a TEXT config operators legitimately hand-edit between
+            # save and load — checksums protect the binary blobs, the
+            # ini's completeness-sentinel role is structural)
+            atomic.write_manifest(
+                target, exclude=(wal.WAL_NAME, "indexloader.ini"))
+            faultinject.crash_point("save.pre_rename")
             if existing:
                 backup = folder.rstrip("/\\") + f".old-{token}"
                 try:
@@ -725,6 +1058,9 @@ class VectorIndex(abc.ABC):
                         _replace_file(os.path.join(target, nm),
                                       os.path.join(folder, nm))
                     shutil.rmtree(target, ignore_errors=True)
+                    faultinject.crash_point("save.post_rename")
+                    if wal_on:
+                        self._arm_wal(folder)
                     return ErrorCode.Success
                 os.rename(target, folder)     # the swap
                 # best-effort: the save has SUCCEEDED once the swap lands;
@@ -760,6 +1096,11 @@ class VectorIndex(abc.ABC):
                     _replace_file(os.path.join(target, nm),
                                   os.path.join(folder, nm))
                 shutil.rmtree(target, ignore_errors=True)
+            faultinject.crash_point("save.post_rename")
+            if wal_on:
+                # the acked log now lives (empty) inside the published
+                # folder; future acks append there
+                self._arm_wal(folder)
         return ErrorCode.Success
 
     # ---- in-memory blob persistence (embedding-host path) -----------------
@@ -783,6 +1124,7 @@ class VectorIndex(abc.ABC):
         import io as _io
 
         with self._lock:
+            self._absorb_delta_locked()
             if self.need_refine:
                 self._refine_impl()
             config = self.save_index_config()
@@ -825,6 +1167,7 @@ class VectorIndex(abc.ABC):
                         lazy_metadata: bool = False) -> None:
         self.params.load_config(reader.section_items("Index"))
         self._load_index_data(folder)
+        self._reset_delta()
         if reader.does_section_exist("MetaData"):
             self._meta_file = reader.get_parameter(
                 "MetaData", "MetaDataFilePath", self._meta_file)
@@ -844,38 +1187,9 @@ class VectorIndex(abc.ABC):
                 self.build_meta_mapping()
 
 
-def _replace_file(src: str, dst: str) -> None:
-    """`os.replace` with a cross-filesystem fallback: when the destination
-    folder is a mountpoint on a different filesystem than the staging
-    sibling (a container volume is the common case), rename raises EXDEV —
-    fall back to copy2 + fsync + unlink so the data is durably at `dst`
-    before the staged copy disappears.  The copy window is not atomic,
-    but the caller's ordering (indexloader.ini LAST) preserves the
-    completeness-sentinel property either way (ADVICE r5)."""
-    try:
-        os.replace(src, dst)
-        return
-    except OSError as e:
-        if e.errno != errno.EXDEV:
-            raise
-    tmp = dst + ".xdev-tmp"
-    shutil.copy2(src, tmp)
-    fd = os.open(tmp, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-    os.replace(tmp, dst)       # same filesystem as dst: atomic
-    # fsync the destination DIRECTORY before dropping the only other
-    # copy: the rename above is a directory-entry update that may still
-    # sit in the page cache, and src vanishing first would lose the file
-    # from both locations on power loss
-    dfd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
-    try:
-        os.fsync(dfd)
-    finally:
-        os.close(dfd)
-    os.unlink(src)
+#: kept as a module name for callers/tests; the implementation moved to
+#: io/atomic.py (the GL411 write-path funnel) unchanged
+_replace_file = atomic.replace_file
 
 
 def _recover_interrupted_save(folder: str) -> None:
@@ -903,8 +1217,15 @@ def _recover_interrupted_save(folder: str) -> None:
 def load_index(folder: str, lazy_metadata: bool = False) -> VectorIndex:
     """Parity: VectorIndex::LoadIndex(folder) (VectorIndex.cpp:324-360).
     `lazy_metadata=True` loads metadata as a FileMetadataSet (offsets only
-    resident; payload read per lookup)."""
+    resident; payload read per lookup).
+
+    Crash-consistency (ISSUE 9): interrupted-save recovery first, then
+    manifest checksum verification (a corrupt blob fails the load, never
+    deserializes), then — for a WalEnabled index — WAL replay over the
+    loaded snapshot and re-arming of the log, so every acked mutation is
+    present and future acks keep appending."""
     _recover_interrupted_save(folder)
+    atomic.verify_manifest(folder)
     reader = IniReader.load(os.path.join(folder, "indexloader.ini"))
     algo = reader.get_parameter("Index", "IndexAlgoType")
     value_type = reader.get_parameter("Index", "ValueType")
@@ -912,6 +1233,9 @@ def load_index(folder: str, lazy_metadata: bool = False) -> VectorIndex:
         raise ValueError("indexloader.ini missing IndexAlgoType/ValueType")
     index = create_instance(algo, value_type)
     index.load_index_data(folder, reader, lazy_metadata=lazy_metadata)
+    if int(getattr(index.params, "wal_enabled", 0) or 0):
+        index._replay_wal(folder)
+        index._arm_wal(folder)
     return index
 
 

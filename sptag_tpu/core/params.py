@@ -207,6 +207,28 @@ _COMMON_TAIL_SPECS = [
     _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
     _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
     _spec("quality_window", int, 0, "QualityWindow"),
+] + [
+    # live-mutation durability + delta-shard knobs (ISSUE 9).  All
+    # default OFF: serve bytes and on-disk layout are unchanged until an
+    # operator opts in.  WalEnabled=1 arms a checksummed write-ahead log
+    # (io/wal.py) at the index's home folder — every acked add/delete
+    # survives process death and is replayed by load_index; WalFsync=0
+    # trades that durability for append throughput (still crash-
+    # CONSISTENT: torn tails truncate, never corrupt).
+    _spec("wal_enabled", int, 0, "WalEnabled"),
+    _spec("wal_fsync", int, 1, "WalFsync"),
+    # >0: adds land in a bounded FLAT/MXU-scanned side index merged into
+    # every query (core/delta.py) instead of re-linking the graph / re-
+    # materializing the engine snapshot inline — fresh rows are
+    # searchable in O(ms).  The capacity bounds the shard's host+HBM
+    # footprint AND its per-query scan cost.
+    _spec("delta_shard_capacity", int, 0, "DeltaShardCapacity"),
+    # >0: once the delta holds this many rows, a BACKGROUND refine links
+    # them into the main structure and atomically swaps a new engine
+    # snapshot in (algo/bkt.py, riding BeamSlotScheduler.retire() — zero
+    # dropped queries, staleness bounded by the build time).  0 = absorb
+    # only at overflow / save / explicit refine.
+    _spec("auto_refine_threshold", int, 0, "AutoRefineThreshold"),
 ]
 
 _FILE_SPECS = [
@@ -404,4 +426,9 @@ class FlatParams(ParamSet):
         _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
         _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
         _spec("quality_window", int, 0, "QualityWindow"),
+        # mutation durability + delta shard; see _COMMON_TAIL_SPECS
+        _spec("wal_enabled", int, 0, "WalEnabled"),
+        _spec("wal_fsync", int, 1, "WalFsync"),
+        _spec("delta_shard_capacity", int, 0, "DeltaShardCapacity"),
+        _spec("auto_refine_threshold", int, 0, "AutoRefineThreshold"),
     ]

@@ -1,0 +1,208 @@
+"""Crash-safe persistence primitives — THE write path for core/ and io/.
+
+Every byte the index durability subsystem puts on disk flows through
+this module (or its sibling io/wal.py): fsync'd file writes with
+deterministic fault-injection hooks, the cross-filesystem atomic
+replace, and the snapshot manifest (per-file CRC32s written last, so a
+complete-looking folder whose blobs were silently truncated or
+bit-flipped fails the load CHECKSUM instead of deserializing garbage).
+
+graftlint GL411 enforces the funnel: a bare write-mode ``open()``
+anywhere in sptag_tpu/core/ or sptag_tpu/io/ outside these two helper
+modules is a lint error — "it probably flushes on close" is exactly the
+implicit contract that loses acked writes on power loss.
+
+Fault sites (utils/faultinject.py storage kinds):
+
+* ``snapshot.write`` — every checked_open'd file write (``torn_write``
+  persists a prefix then dies; ``crash`` dies before the file exists);
+* ``snapshot.read`` — manifest verification reads (``short_read``);
+* crash points are the CALLER's: save_index names its own
+  (``save.pre_rename`` / ``save.post_rename``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import json
+import logging
+import os
+import shutil
+import zlib
+from typing import Dict, Iterable, Optional
+
+from sptag_tpu.utils import faultinject
+
+log = logging.getLogger(__name__)
+
+#: snapshot manifest file name (written LAST into a staged save)
+MANIFEST_NAME = "manifest.json"
+
+
+class ManifestError(RuntimeError):
+    """A manifest-listed file is missing or fails its checksum."""
+
+
+def fsync_file(f) -> None:
+    """Flush + fsync an open file object (the durability half an
+    implicit close-flush never gives you)."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: renames/creates are directory-entry updates
+    that sit in the page cache until the directory inode is synced."""
+    fd = os.open(path or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class _TearingFile:
+    """File proxy armed by a ``torn_write`` fault: the first write
+    persists a durable PREFIX of its bytes, then the "process dies"."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def write(self, b):
+        prefix = bytes(b)[: max(1, len(b) // 2)] if len(b) else b""
+        self._f.write(prefix)
+        # the torn prefix is made durable BEFORE the death: a torn tail
+        # that vanished with the page cache would be indistinguishable
+        # from a clean pre-write crash and test nothing
+        fsync_file(self._f)
+        raise faultinject.InjectedCrash("torn_write")
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+@contextlib.contextmanager
+def checked_open(path_or_stream, mode: str = "wb",
+                 site: str = "snapshot.write", sync: bool = True):
+    """Write-mode open with fault hooks and fsync-before-close.
+
+    Streams pass through untouched (the caller owns their durability —
+    blob writers and tests hand in BytesIO).  For paths: a ``crash``
+    fault dies before the file is created, a ``torn_write`` fault tears
+    the first write; otherwise the file is fsync'd before close so a
+    following rename publishes DURABLE bytes."""
+    if hasattr(path_or_stream, "write"):
+        yield path_or_stream
+        return
+    fault = faultinject.storage_fault(site)
+    if fault is not None and fault.kind == "crash":
+        raise faultinject.InjectedCrash(site)
+    f = open(path_or_stream, mode)
+    try:
+        yield (_TearingFile(f) if fault is not None
+               and fault.kind == "torn_write" else f)
+        if sync:
+            fsync_file(f)
+    finally:
+        f.close()
+
+
+def replace_file(src: str, dst: str) -> None:
+    """``os.replace`` with a cross-filesystem fallback: when the
+    destination folder is a mountpoint on a different filesystem than
+    the staging sibling (a container volume is the common case), rename
+    raises EXDEV — fall back to copy2 + fsync + unlink so the data is
+    durably at `dst` before the staged copy disappears.  The copy
+    window is not atomic, but the caller's ordering (indexloader.ini
+    LAST) preserves the completeness-sentinel property either way
+    (ADVICE r5)."""
+    try:
+        os.replace(src, dst)
+        return
+    except OSError as e:
+        if e.errno != errno.EXDEV:
+            raise
+    tmp = dst + ".xdev-tmp"
+    shutil.copy2(src, tmp)
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, dst)       # same filesystem as dst: atomic
+    # fsync the destination DIRECTORY before dropping the only other
+    # copy: the rename above is a directory-entry update that may still
+    # sit in the page cache, and src vanishing first would lose the file
+    # from both locations on power loss
+    fsync_dir(os.path.dirname(dst) or ".")
+    os.unlink(src)
+
+
+def file_crc32(path: str, site: str = "snapshot.read") -> int:
+    """Streaming CRC32 of a file; a ``short_read`` fault truncates the
+    observed bytes (the checksum then fails loudly downstream)."""
+    fault = faultinject.storage_fault(site)
+    crc = 0
+    total = os.path.getsize(path)
+    limit = total // 2 if fault is not None \
+        and fault.kind == "short_read" else total
+    seen = 0
+    with open(path, "rb") as f:
+        while seen < limit:
+            chunk = f.read(min(1 << 20, limit - seen))
+            if not chunk:
+                break
+            seen += len(chunk)
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_manifest(folder: str, exclude: Iterable[str] = ()) -> None:
+    """Write ``manifest.json``: size + CRC32 of every regular file in
+    `folder` (minus `exclude` and the manifest itself).  Written LAST by
+    save paths — its presence vouches for the checksums of everything
+    it lists."""
+    skip = set(exclude) | {MANIFEST_NAME}
+    files: Dict[str, Dict] = {}
+    for name in sorted(os.listdir(folder)):
+        path = os.path.join(folder, name)
+        if name in skip or not os.path.isfile(path):
+            continue
+        files[name] = {"bytes": os.path.getsize(path),
+                       "crc32": file_crc32(path, site="snapshot.write")}
+    payload = json.dumps({"version": 1, "files": files}, sort_keys=True)
+    with checked_open(os.path.join(folder, MANIFEST_NAME), "w",
+                      site="snapshot.write") as f:
+        f.write(payload)
+
+
+def verify_manifest(folder: str) -> Optional[int]:
+    """Check every manifest-listed file's size + CRC32.  Returns the
+    number of files verified, or None when no manifest exists (pre-
+    manifest snapshots and reference-built folders load unverified).
+    Raises :class:`ManifestError` on any mismatch — a corrupt blob must
+    fail the LOAD, not surface later as silently wrong neighbors."""
+    path = os.path.join(folder, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, "r") as f:
+        try:
+            manifest = json.load(f)
+        except ValueError as e:
+            raise ManifestError(f"unparseable manifest {path}: {e}")
+    checked = 0
+    for name, meta in manifest.get("files", {}).items():
+        fpath = os.path.join(folder, name)
+        if not os.path.exists(fpath):
+            raise ManifestError(f"manifest lists missing file {name}")
+        size = os.path.getsize(fpath)
+        if size != int(meta.get("bytes", -1)):
+            raise ManifestError(
+                f"{name}: size {size} != manifest {meta.get('bytes')}")
+        crc = file_crc32(fpath)
+        if crc != int(meta.get("crc32", -1)):
+            raise ManifestError(
+                f"{name}: crc32 {crc:#x} != manifest "
+                f"{int(meta.get('crc32', -1)):#x}")
+        checked += 1
+    return checked

@@ -40,11 +40,13 @@ KDT_NODE_DTYPE = np.dtype(
 
 @contextlib.contextmanager
 def open_write(path_or_stream):
-    if hasattr(path_or_stream, "write"):
-        yield path_or_stream
-    else:
-        with open(path_or_stream, "wb") as f:
-            yield f
+    # path writes funnel through the crash-safe helper (fsync before
+    # close + deterministic storage-fault hooks — io/atomic.py, GL411);
+    # streams pass through untouched as before
+    from sptag_tpu.io import atomic
+
+    with atomic.checked_open(path_or_stream, "wb") as f:
+        yield f
 
 
 @contextlib.contextmanager

@@ -22,6 +22,10 @@ these when their `MetricsPort` is set:
   shares, hedge and reconnect-backoff accounting and the active
   fault-injection plan.  Always answers 200; with no controller the
   payload shows ``enabled: false``.
+* ``GET /debug/mutation`` — the live-mutation subsystem (ISSUE 9):
+  per-index snapshot epoch, WAL accounting (acked writes, home
+  folder), delta-shard occupancy, swap count and recent swap windows.
+  Always answers 200; a tier with no indexes shows ``enabled: false``.
 * ``GET /debug/quality`` — the search-quality observatory
   (utils/qualmon.py): online recall windows with Wilson bounds per
   (searchmode, shard), per-shard index-health payloads (graph degrees,
@@ -79,13 +83,17 @@ def publish_flight_gauges() -> None:
 class MetricsHttpServer:
     def __init__(self, port: int, health: Optional[Callable[[], Dict]] = None,
                  host: str = "127.0.0.1",
-                 admission: Optional[Callable[[], Dict]] = None):
+                 admission: Optional[Callable[[], Dict]] = None,
+                 mutation: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.health = health
         # GET /debug/admission callback (serve/admission.py): overload-
         # defense state, hedge/backoff accounting, fault-injection plan
         self.admission = admission
+        # GET /debug/mutation callback (ISSUE 9): per-index swap +
+        # durability state (epoch, WAL accounting, delta occupancy)
+        self.mutation = mutation
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -131,6 +139,21 @@ class MetricsHttpServer:
                                      else {"enabled": False})
                         except Exception:                # noqa: BLE001
                             log.exception("admission callback failed")
+                            state = {"enabled": False, "error": True}
+                        body = json.dumps(state).encode()
+                        ctype = "application/json"
+                        code = 200
+                    elif self.path.split("?")[0] == "/debug/mutation":
+                        # live-mutation subsystem (core/index.py +
+                        # algo/bkt.py, ISSUE 9): per-index epoch / WAL /
+                        # delta / swap state.  Always 200; a tier with
+                        # no indexes (aggregator) shows enabled=false.
+                        try:
+                            state = (owner.mutation()
+                                     if owner.mutation
+                                     else {"enabled": False})
+                        except Exception:                # noqa: BLE001
+                            log.exception("mutation callback failed")
                             state = {"enabled": False, "error": True}
                         body = json.dumps(state).encode()
                         ctype = "application/json"

@@ -198,7 +198,8 @@ class SearchServer:
             self._metrics_http = MetricsHttpServer(
                 self.metrics_port, health=self._healthz,
                 host=self.context.settings.metrics_host,
-                admission=self._admission_debug)
+                admission=self._admission_debug,
+                mutation=self._mutation_debug)
             self._metrics_http.start()
         self._server = await asyncio.start_server(self._on_client, host, port)
         self._batcher_task = asyncio.create_task(self._batcher())
@@ -230,6 +231,12 @@ class SearchServer:
             params = getattr(index, "params", None)
             if params is not None and hasattr(params, "non_default_items"):
                 info["non_default_params"] = dict(params.non_default_items())
+            ms = getattr(index, "mutation_state", None)
+            if ms is not None:
+                # swap/durability state (ISSUE 9): epoch, WAL accounting,
+                # delta occupancy, in-flight refine — the numbers an
+                # operator watches to see a snapshot swap land
+                info["mutation"] = ms()
             indexes[name] = info
         return {"status": "ok" if indexes else "empty",
                 "indexes": indexes,
@@ -248,6 +255,27 @@ class SearchServer:
         out["deadline_drops"] = metrics.counter_value(
             "server.deadline_drops")
         return out
+
+    def _mutation_debug(self) -> dict:
+        """GET /debug/mutation payload: per-index swap/durability state
+        plus the process-wide mutation counters."""
+        indexes = {}
+        for name, index in self.context.indexes.items():
+            ms = getattr(index, "mutation_state", None)
+            if ms is not None:
+                try:
+                    indexes[name] = ms()
+                except Exception:                        # noqa: BLE001
+                    log.exception("mutation_state failed for %s", name)
+                    indexes[name] = {"error": True}
+        return {
+            "tier": "server",
+            "indexes": indexes,
+            "wal_appends": metrics.counter_value("mutation.wal_appends"),
+            "swaps": metrics.counter_value("mutation.swaps"),
+            "refine_errors": metrics.counter_value(
+                "mutation.refine_errors"),
+        }
 
     # ------------------------------------------------------------ connection
 

@@ -33,6 +33,26 @@ or a per-server ctor override)::
 e.g. ``delay@server.respond:ms=2500,p=1`` or ``garble:p=0.1;drop:p=0.05``.
 A rule without ``@site`` matches every site.
 
+Storage faults (ISSUE 9 — the crash-recovery matrix's other half): the
+durability subsystem (io/wal.py write-ahead log, io/atomic.py snapshot
+writers) exists to survive process death at ANY byte offset, and the
+recovery tests need a deterministic way to die at chosen offsets:
+
+* ``torn_write`` — the writer persists a PREFIX of the payload, then
+  raises :class:`InjectedCrash` (power loss mid-write: the file carries
+  a torn tail the reader must detect and truncate);
+* ``short_read`` — a reader observes a PREFIX of the stored bytes (a
+  truncated file / torn page at read time: checksums must fail loudly,
+  never deserialize garbage);
+* ``crash`` (alias ``crash_after``) — raise :class:`InjectedCrash`
+  before the site does any work; sequence it with ``after=n`` to die at
+  the n+1-th decision (``crash@save.post_rename:after=0`` dies at the
+  first post-rename point — the classic pre-WAL-truncate window).
+
+Storage sites consult the PROCESS-GLOBAL injector (persistence is not
+per-server); the wire kinds never fire at storage sites and vice versa —
+a rule's kind simply doesn't match the other family's application code.
+
 Determinism: decisions consume draws from one ``random.Random(seed)``
 (env ``SPTAG_FAULTINJECT_SEED`` / ini ``FaultInjectSeed``), so a fixed
 spec + seed + call sequence replays the exact same fault schedule —
@@ -59,7 +79,16 @@ from sptag_tpu.utils import metrics
 
 log = logging.getLogger(__name__)
 
-KINDS = ("delay", "drop", "disconnect", "garble")
+KINDS = ("delay", "drop", "disconnect", "garble",
+         # storage family (io/wal.py + io/atomic.py sites)
+         "torn_write", "short_read", "crash")
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated process death at a storage fault site.  Raised instead
+    of killing the interpreter so the recovery tests can catch it,
+    abandon the in-memory index, and reload from disk — the on-disk
+    state at raise time is exactly what a real crash would leave."""
 
 
 class Fault:
@@ -98,6 +127,8 @@ def _parse_spec(spec: str) -> List[_Rule]:
         head, _, params = part.partition(":")
         kind, _, site = head.partition("@")
         kind = kind.strip().lower()
+        if kind == "crash_after":        # the spec-grammar alias: pair
+            kind = "crash"               # with after=n to pick the point
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
                              f"(expected one of {KINDS})")
@@ -170,6 +201,12 @@ class Injector:
             metrics.inc("faultinject.disconnects")
         elif kind == "garble":
             metrics.inc("faultinject.garbles")
+        elif kind == "torn_write":
+            metrics.inc("faultinject.torn_writes")
+        elif kind == "short_read":
+            metrics.inc("faultinject.short_reads")
+        elif kind == "crash":
+            metrics.inc("faultinject.crashes")
 
     def snapshot(self) -> Dict:
         """Plain-data view for GET /debug/admission."""
@@ -217,6 +254,32 @@ def global_injector() -> Injector:
 
 def enabled() -> bool:
     return global_injector().enabled
+
+
+def storage_fault(site: str) -> Optional[Fault]:
+    """One injection decision at a STORAGE site (io/wal.py, io/atomic.py)
+    against the process-global plan; None when disabled — the off cost
+    is one attribute read, so durability paths stay fault-hook-free in
+    production."""
+    inj = global_injector()
+    if not inj.enabled:
+        return None
+    return inj.decide(site)
+
+
+def crash_point(site: str) -> None:
+    """Die here if the plan says so — the seedable stand-in for `kill -9`
+    between two filesystem operations.  Crash points sit BETWEEN writes
+    (pre/post rename, pre-truncate), so only the ``crash`` kind is
+    meaningful at them; a byte-level kind matching such a site is
+    consumed and ignored (target byte-level kinds at the write/read
+    sites instead)."""
+    inj = global_injector()
+    if not inj.enabled:
+        return
+    fault = inj.decide(site)
+    if fault is not None and fault.kind == "crash":
+        raise InjectedCrash(site)
 
 
 def reset() -> None:

@@ -168,3 +168,123 @@ def test_concurrent_delete_search_rebuild():
     _, ids = index.search_batch(data[:64], 10)
     leaked = set(int(x) for x in ids.ravel() if x >= 0) & confirmed_deleted
     assert not leaked, leaked
+
+
+def test_search_while_mutate_epoch_swap_hammer():
+    """ISSUE 9 hammer: continuous searches while a writer streams
+    delta-shard adds/deletes and background refines swap the engine
+    snapshot under them.  Asserts ZERO reader errors, well-formed
+    results throughout, MONOTONE visibility (a row acked before the
+    search started is findable — the delta shard makes adds visible
+    immediately, and a swap must never un-publish one), confirmed
+    deletes never resurface, and that at least one snapshot swap
+    actually landed mid-traffic (the scenario exercised, not skipped)."""
+    rng = np.random.default_rng(11)
+    d = 12
+    data = rng.standard_normal((192, d)).astype(np.float32)
+
+    index = sp.create_instance("BKT", "Float")
+    for name, value in [("DistCalcMethod", "L2"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "64"),
+                        ("NeighborhoodSize", "12"), ("CEF", "48"),
+                        ("AddCEF", "24"), ("MaxCheckForRefineGraph", "96"),
+                        ("MaxCheck", "256"), ("RefineIterations", "1"),
+                        ("Samples", "100"), ("DenseClusterSize", "64"),
+                        ("AddCountForRebuild", "100000"),
+                        ("DeltaShardCapacity", "64"),
+                        ("AutoRefineThreshold", "12")]:
+        index.set_parameter(name, value)
+    assert index.build(data) == sp.ErrorCode.Success
+    index.search_batch(data[:8], 5)           # warm the read shapes
+
+    errors = []
+    stop = threading.Event()
+    state_lock = threading.Lock()
+    acked = []                # (vid, vector) acked adds, in ack order
+    # a delete's tombstone lands inside index.delete(), BEFORE the
+    # writer can record it — rows move to `deleting` FIRST (searchers
+    # stop asserting visibility for them), then to `confirmed_deleted`
+    # once the delete acks (searchers assert INvisibility)
+    deleting = set()
+    confirmed_deleted = set()
+
+    def writer():
+        try:
+            for i in range(10):
+                batch = rng.standard_normal((4, d)).astype(np.float32)
+                begin = index.num_samples
+                assert index.add(batch) == sp.ErrorCode.Success
+                with state_lock:
+                    for j in range(4):
+                        acked.append((begin + j, batch[j]))
+                if i % 3 == 2 and acked:
+                    with state_lock:
+                        vid, vec = acked.pop(0)
+                        deleting.add(vid)
+                    if index.delete(vec[None, :]) == sp.ErrorCode.Success:
+                        with state_lock:
+                            confirmed_deleted.add(vid)
+                time.sleep(0.02)
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def searcher(seed):
+        r = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                with state_lock:
+                    banned = set(confirmed_deleted)
+                    probe = acked[int(r.integers(0, len(acked)))] \
+                        if acked else None
+                dists, ids = index.search_batch(data[:16], 8)
+                assert ids.shape == (16, 8)
+                assert np.all(np.diff(dists, axis=1) >= -1e-3)
+                hit = set(int(x) for x in ids.ravel()
+                          if x >= 0) & banned
+                assert not hit, f"deleted ids returned: {hit}"
+                with state_lock:
+                    probe_ok = probe is not None and \
+                        probe[0] not in deleting
+                if probe_ok:
+                    # monotone visibility: acked BEFORE this search
+                    pd, pids = index.search_batch(probe[1][None, :], 4)
+                    with state_lock:
+                        still_live = probe[0] not in deleting
+                    if still_live:
+                        assert probe[0] in pids[0], \
+                            (probe[0], pids[0], pd[0])
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    threads = ([threading.Thread(target=writer)]
+               + [threading.Thread(target=searcher, args=(50 + i,))
+                  for i in range(3)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, errors
+    # wait out any in-flight background refine, then check the swap
+    # machinery actually fired under traffic
+    deadline = time.time() + 120
+    while time.time() < deadline and \
+            index.mutation_state()["refine_in_flight"]:
+        time.sleep(0.05)
+    st = index.mutation_state()
+    assert st["swap_count"] >= 1, st
+    assert index.num_samples == 192 + 40
+    # post-quiescence: every surviving acked row visible, deletes gone
+    with state_lock:
+        live = [(vid, vec) for vid, vec in acked
+                if vid not in confirmed_deleted]
+    for vid, vec in live:
+        _, ids = index.search_batch(vec[None, :], 4)
+        assert vid in ids[0], (vid, ids[0])
+    _, ids = index.search_batch(data[:32], 10)
+    leaked = set(int(x) for x in ids.ravel()
+                 if x >= 0) & confirmed_deleted
+    assert not leaked, leaked
+    index.wait_for_rebuild(timeout=120)
+    index.close()

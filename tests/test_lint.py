@@ -1258,3 +1258,80 @@ def test_gl301_spawn_in_one_class_does_not_taint_another():
         "        self._state = 2\n"
     )
     assert lint_one(src, select=["GL301"]) == []
+
+
+# ---------------------------------------------------------------------------
+# GL411 persistence-write funnel (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_gl411_write_open_in_core_flagged():
+    """A bare write-mode open() in core/ or io/ bypasses the fsync +
+    fault-hook funnel (io/atomic.py, io/wal.py) — the implicit
+    close-flush contract that loses acked writes on power loss."""
+    src = (
+        "import os\n"
+        "def save(folder, blob):\n"
+        "    with open(os.path.join(folder, 'x.bin'), 'wb') as f:\n"
+        "        f.write(blob)\n"
+    )
+    found = lint_one(src, path="sptag_tpu/core/snippet.py",
+                     select=["GL411"])
+    assert rules_of(found) == ["GL411"]
+    assert "atomic" in found[0].message
+    # io/ is in scope too
+    assert rules_of(lint_one(src, path="sptag_tpu/io/snippet.py",
+                             select=["GL411"])) == ["GL411"]
+
+
+def test_gl411_read_open_and_out_of_scope_clean():
+    """Read-mode opens pass; write opens OUTSIDE core//io (algo, serve,
+    tools) are out of scope — their durability is owned by the core
+    save path they are staged under."""
+    read_src = (
+        "def load(path):\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read()\n"
+        "def load_default(path):\n"
+        "    with open(path) as f:\n"
+        "        return f.read()\n"
+    )
+    assert lint_one(read_src, path="sptag_tpu/core/snippet.py",
+                    select=["GL411"]) == []
+    write_src = (
+        "def save(path, b):\n"
+        "    with open(path, 'wb') as f:\n"
+        "        f.write(b)\n"
+    )
+    assert lint_one(write_src, path="sptag_tpu/algo/snippet.py",
+                    select=["GL411"]) == []
+
+
+def test_gl411_helper_modules_exempt_and_modes_covered():
+    """The two sanctioned helpers implement the funnel and keep their
+    raw opens; append/exclusive/update and computed modes are flagged
+    in scoped modules (a computed mode can't be proven read-only)."""
+    src = (
+        "def raw(path, b, m):\n"
+        "    open(path, 'ab').write(b)\n"
+        "    open(path, mode='r+b').read()\n"
+        "    open(path, m)\n"
+    )
+    assert lint_one(src, path="sptag_tpu/io/atomic.py",
+                    select=["GL411"]) == []
+    assert lint_one(src, path="sptag_tpu/io/wal.py",
+                    select=["GL411"]) == []
+    found = lint_one(src, path="sptag_tpu/io/snippet.py",
+                     select=["GL411"])
+    assert rules_of(found) == ["GL411"]
+    assert len(found) == 3
+
+
+def test_gl411_registered_and_tree_clean():
+    """GL411 is registered with the runner, and the real core//io tree
+    needs ZERO baseline entries — every persistence write already rides
+    the helpers."""
+    assert "GL411" in ALL_RULES
+    unsup, _sup, _stale = lint_project(
+        os.path.join(REPO, "sptag_tpu"), DEFAULT_BASELINE,
+        select=["GL411"])
+    assert unsup == [], "\n".join(f.format() for f in unsup)

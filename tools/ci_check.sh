@@ -62,6 +62,28 @@ echo "== GL601/602/603 overload-defense names (standalone) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q \
     -p no:cacheprovider -k "issue8"
 
+# the ISSUE 9 robustness gate, standalone: with every mutation knob at
+# its default (WalEnabled 0, DeltaShardCapacity 0, AutoRefineThreshold
+# 0) the serve tier's wire bytes stay byte-identical and the mutation
+# subsystem performs zero work
+echo "== mutation knobs off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_mutation.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 9 recovery drill, standalone: every injected storage-fault/
+# crash point (mid-WAL append, mid-snapshot blob, pre-rename,
+# post-rename) yields a loadable index containing exactly the acked
+# writes, checksums verified — if this fails, the durability contract
+# is broken and no mutation feature on top of it matters
+echo "== crash-recovery drill (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_mutation.py -q \
+    -p no:cacheprovider -k "crash_matrix or manifest or wal"
+
+# the ISSUE 9 lint gate, standalone: persistence writes in core//io
+# ride the atomic-write/WAL helpers (GL411, zero baseline entries)
+echo "== GL411 persistence-path lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL411
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

@@ -1,0 +1,97 @@
+"""GL411 — persistence writes must ride the atomic-write/WAL helpers.
+
+The durability contract (ISSUE 9, DESIGN.md §15) is that every byte the
+index persistence subsystem puts on disk is either fsync'd before a
+rename publishes it (io/atomic.py) or logged through the checksummed
+WAL (io/wal.py).  A bare write-mode ``open()`` in a save path relies on
+close-time flushing — the exact implicit contract that loses acked
+writes on power loss and leaves truncated blobs behind a valid-looking
+``indexloader.ini``.
+
+Rule:
+
+* GL411 — a call to builtin ``open()`` with a write-capable mode
+  (``w``, ``a``, ``x`` or ``+``) in sptag_tpu/core/ or sptag_tpu/io/,
+  outside the two sanctioned helper modules (io/atomic.py, io/wal.py).
+  Read-mode opens and ``os.open``-style attribute calls are out of
+  scope; so are algo//serve//utils (their writes are staged files and
+  caches whose durability the core save path already owns — algo's
+  ``_save_index_data`` implementations route through
+  ``atomic.checked_open`` by convention, enforced by the crash-matrix
+  tests rather than this rule).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.graftlint.core import Finding, ModuleInfo, Project
+
+RULES = {
+    "GL411": "persistence write bypasses the atomic-write/WAL helpers "
+             "(bare write-mode open() in core//io — use "
+             "io.atomic.checked_open / io.wal)",
+}
+
+_SCOPES = ("sptag_tpu/core/", "sptag_tpu/io/")
+_HELPERS = ("sptag_tpu/io/atomic.py", "sptag_tpu/io/wal.py")
+
+_WRITE_CHARS = set("wax+")
+
+
+def _mode_of(call: ast.Call) -> Optional[str]:
+    """The literal mode argument of an open() call, None when absent or
+    not a string constant (a computed mode is flagged conservatively —
+    see _check_module)."""
+    if len(call.args) >= 2:
+        node = call.args[1]
+    else:
+        node = next((kw.value for kw in call.keywords
+                     if kw.arg == "mode"), None)
+    if node is None:
+        return "r"          # open() default
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None             # computed — can't prove it's read-only
+
+
+def _enclosing(mod: ModuleInfo, lineno: int) -> str:
+    best = ""
+    best_line = -1
+    for fn in mod.functions:
+        end = getattr(fn.node, "end_lineno", fn.node.lineno)
+        if fn.node.lineno <= lineno <= end and fn.node.lineno > best_line:
+            best, best_line = fn.qualname, fn.node.lineno
+    return best
+
+
+def _check_module(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id == "open"):
+            continue
+        mode = _mode_of(node)
+        if mode is not None and not (_WRITE_CHARS & set(mode)):
+            continue        # provably read-only
+        out.append(Finding(
+            "GL411", mod.relpath, node.lineno,
+            f"write-mode open({mode!r} mode) bypasses the atomic-write/"
+            "WAL helpers — route through io.atomic.checked_open (fsync "
+            "+ fault hooks) or io.wal", _enclosing(mod, node.lineno)))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for relpath, mod in project.modules.items():
+        if relpath in _HELPERS or any(
+                relpath.endswith(h) for h in _HELPERS):
+            continue
+        if any(relpath.startswith(s) or ("/" + s) in relpath
+               for s in _SCOPES):
+            out.extend(_check_module(mod))
+    return out

@@ -15,13 +15,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from tools.graftlint import (asyncrules, concurrency, costrules,
                              dtype_parity, errorpath, hostsync, lockgraph,
-                             obsnames, retrace)
+                             obsnames, persistrules, retrace)
 from tools.graftlint.baseline import (BaselineError, Suppression,
                                       apply_baseline, load_baseline)
 from tools.graftlint.core import Finding, Project
 
 CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity,
-            obsnames, lockgraph, asyncrules, costrules)
+            obsnames, lockgraph, asyncrules, costrules, persistrules)
 
 #: rule id -> one-line description, collected from every checker module
 ALL_RULES: Dict[str, str] = {}
