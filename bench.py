@@ -50,6 +50,29 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".bench_cache")
 CACHE_VERSION = 5          # bump when index params/format/build semantics change
                            # (v5: FinalRefineSearchMode=beam default + exact int16)
+# artifact schema stamp (ISSUE 10): tools/benchdiff.py keys its watched
+# metrics off this — bump when a watched key changes meaning or moves
+BENCH_SCHEMA_VERSION = 1
+
+
+def _git_rev():
+    """Short git rev of the benched tree (provenance for benchdiff
+    tables); 'unknown' when git is unavailable — never fatal."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10)
+        rev = out.stdout.strip()
+        if out.returncode == 0 and rev:
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain"], cwd=REPO,
+                capture_output=True, text=True, timeout=10)
+            if dirty.returncode == 0 and dirty.stdout.strip():
+                rev += "-dirty"
+            return rev
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
 DEFAULT_BUDGET_S = 1500.0
 _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", DEFAULT_BUDGET_S))
 # probe budget derived from the envelope unless explicitly overridden: a
@@ -580,7 +603,9 @@ def run_bench():
         platform, probe_err, attempts, probe_cached = \
             probe_accelerator(budget_s)
     result = {"metric": f"qps_per_chip_bkt_n{n}_d128_l2_recall@10",
-              "value": 0.0, "unit": "qps", "vs_baseline": 0.0}
+              "value": 0.0, "unit": "qps", "vs_baseline": 0.0,
+              "schema_version": BENCH_SCHEMA_VERSION,
+              "git_rev": _git_rev()}
     if probe_cached:
         result["tpu_probe_cached"] = True
     if attempts > 1 or (attempts and platform is None):
@@ -1084,6 +1109,7 @@ def _loadgen_measure(index, queries, k, budget_s):
     max_qps = float(os.environ.get("BENCH_LOADGEN_MAX_QPS", "8192"))
     out = {"slo_ms": slo_ms, "step_s": step_s, "steps": [],
            "steps_dropped": []}
+    from sptag_tpu.utils import hostprof
 
     counter_names = ("server.admission_sheds", "admission.sheds",
                      "admission.degraded_queries",
@@ -1117,7 +1143,8 @@ def _loadgen_measure(index, queries, k, budget_s):
         holder["boot"] = loop.create_task(boot())
         loop.run_forever()
 
-    th = threading.Thread(target=_serve, daemon=True)
+    th = threading.Thread(target=_serve, daemon=True,
+                          name="bench-loadgen-serve")
     th.start()
     if not ready.wait(30):
         return {"error": "loadgen server failed to start"}
@@ -1179,7 +1206,8 @@ def _loadgen_measure(index, queries, k, budget_s):
         except OSError:
             pass
 
-    rth = threading.Thread(target=receiver, daemon=True)
+    rth = threading.Thread(target=receiver, daemon=True,
+                           name="bench-loadgen-recv")
     rth.start()
     next_rid = [1]
 
@@ -1195,6 +1223,16 @@ def _loadgen_measure(index, queries, k, budget_s):
         return rid
 
     try:
+        # host profiler rides the loadgen stage (ISSUE 10 satellite):
+        # the artifact embeds sample counts + the top folded stacks, so
+        # benchdiff has stable keys and "where did the host CPU go at
+        # the SLO knee" is answered by the bench JSON itself.  Started
+        # INSIDE this try: every exit path from here runs the finally,
+        # whose hostprof.reset() guarantees no sampler leaks into (and
+        # skews) the later bench stages benchdiff gates on
+        hostprof.configure(hz=float(os.environ.get("BENCH_HOSTPROF_HZ",
+                                                   "67")))
+        hostprof.start()
         # warmup: one request per option combo, closed-loop, so the
         # ramp measures serving, not first-shape XLA compiles
         warm = [fire(qtext(i % nq, opt))
@@ -1285,6 +1323,11 @@ def _loadgen_measure(index, queries, k, budget_s):
             saw_defense = saw_defense or defended
             if ok:
                 qps_at_slo = offered
+                # steady-state latency AT the best passing step — the
+                # stable per-stage keys benchdiff watches
+                last = out["steps"][-1]
+                out["p50_ms"] = last["p50_ms"]
+                out["p99_ms"] = last["p99_ms"]
             else:
                 break
             offered *= 2.0
@@ -1303,6 +1346,18 @@ def _loadgen_measure(index, queries, k, budget_s):
             nm: metrics_mod.counter_value(nm) - base_counters[nm]
             for nm in counter_names}
     finally:
+        try:
+            prof = hostprof.snapshot()
+            out["hostprof"] = {
+                "hz": prof["hz"],
+                "samples": prof["samples"],
+                "overruns": prof["overruns"],
+                "stage_samples": prof["stage_samples"],
+                "top_stacks": hostprof.top_stacks(10),
+            }
+        except Exception:                                # noqa: BLE001
+            pass
+        hostprof.reset()
         try:
             sock.close()
         except OSError:

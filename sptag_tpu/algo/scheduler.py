@@ -52,7 +52,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from sptag_tpu.utils import devmem, flightrec, locksan, metrics, query_bucket
+from sptag_tpu.utils import (devmem, flightrec, hostprof, locksan, metrics,
+                             query_bucket)
 
 log = logging.getLogger(__name__)
 
@@ -426,6 +427,14 @@ class BeamSlotScheduler:
         engine = self._engine
         now = time.perf_counter()
         rec = flightrec.enabled()
+        if hostprof.armed():
+            # host-profiler stage pin (ISSUE 10): everything this worker
+            # thread does — seeding, segment dispatch, finalize, retire
+            # bookkeeping — is execute-stage serve work.  Re-pinned per
+            # cycle (one dict store) so a profiler armed mid-flight
+            # attributes the very next cycle; never cleared — the worker
+            # does nothing else.
+            hostprof.set_stage("execute")
         # ---- resize (grow for intake / compact a drained pool) ----------
         target = pool.target_capacity(len(incoming))
         residents = pool.live_count()

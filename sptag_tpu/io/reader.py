@@ -96,7 +96,8 @@ class VectorSetReader:
         parse = lambda b: _parse_block(b, opts)  # noqa: E731
         if len(blocks) > 1:
             with concurrent.futures.ThreadPoolExecutor(
-                    max_workers=len(blocks)) as pool:
+                    max_workers=len(blocks),
+                    thread_name_prefix="reader-parse") as pool:
                 parts = list(pool.map(parse, blocks))
         else:
             parts = [parse(blocks[0])]

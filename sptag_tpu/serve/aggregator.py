@@ -41,7 +41,8 @@ from typing import List, Optional, Tuple
 from sptag_tpu.serve import admission as admission_mod
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
-from sptag_tpu.utils import flightrec, metrics, qualmon, trace
+from sptag_tpu.utils import (flightrec, hostprof, locksan, metrics, qualmon,
+                             trace)
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -261,7 +262,11 @@ class AggregatorContext:
                  hedge_budget: float = 0.0,
                  hedge_min_ms: float = 1.0,
                  reconnect_base_ms: float = 250.0,
-                 reconnect_cap_s: float = RECONNECT_INTERVAL_S):
+                 reconnect_cap_s: float = RECONNECT_INTERVAL_S,
+                 host_prof_hz: float = 0.0,
+                 host_prof_events: int = 0,
+                 host_prof_dump_on_slow_query: bool = False,
+                 lock_contention_ledger: bool = False):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -329,6 +334,13 @@ class AggregatorContext:
         # reconnect backoff (replaces the fixed 30 s sweep)
         self.reconnect_base_ms = reconnect_base_ms
         self.reconnect_cap_s = reconnect_cap_s
+        # host sampling profiler + lock-contention ledger (ISSUE 10) —
+        # [Service] parity with the shard tier (utils/hostprof.py,
+        # utils/locksan.py); all off by default
+        self.host_prof_hz = host_prof_hz
+        self.host_prof_events = host_prof_events
+        self.host_prof_dump_on_slow_query = host_prof_dump_on_slow_query
+        self.lock_contention_ledger = lock_contention_ledger
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -402,7 +414,22 @@ class AggregatorContext:
             reconnect_cap_s=float(reader.get_parameter(
                 "Service", "ReconnectCapS",
                 str(RECONNECT_INTERVAL_S))),
+            host_prof_hz=float(reader.get_parameter(
+                "Service", "HostProfHz", "0")),
+            host_prof_events=int(reader.get_parameter(
+                "Service", "HostProfEvents", "0")),
+            host_prof_dump_on_slow_query=reader.get_parameter(
+                "Service", "HostProfDumpOnSlowQuery", "0").lower() in
+            ("1", "true", "on", "yes"),
+            lock_contention_ledger=reader.get_parameter(
+                "Service", "LockContentionLedger", "0").lower() in
+            ("1", "true", "on", "yes"),
         )
+        if ctx.lock_contention_ledger:
+            # arm before any client/connection locks are created (the
+            # ServiceContext.from_ini timing contract)
+            from sptag_tpu.utils import locksan
+            locksan.enable_contention()
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
             section = f"Server_{i}"
@@ -492,6 +519,17 @@ class AggregatorService:
                 enabled=True,
                 max_events=self.context.flight_recorder_events or None,
                 dump_dir=self.context.flight_dump_on_slow_query or None)
+        if self.context.lock_contention_ledger:
+            locksan.enable_contention()
+        if self.context.host_prof_hz > 0:
+            # host sampler (utils/hostprof.py, ISSUE 10): process-wide;
+            # never started at the default HostProfHz=0
+            hostprof.configure(
+                hz=self.context.host_prof_hz,
+                max_samples=self.context.host_prof_events or None,
+                dump_on_slow_query=self.context
+                .host_prof_dump_on_slow_query or None)
+            hostprof.start()
         if self.context.quality_sample_rate > 0:
             qualmon.configure(
                 sample_rate=self.context.quality_sample_rate,
@@ -687,8 +725,15 @@ class AggregatorService:
                             await writer.drain()
                             continue
                         degraded = decision == admission_mod.DEGRADE
+                    hp = hostprof.armed()
+                    if hp:
+                        # serve-stage pin (ISSUE 10): decode + id/
+                        # deadline stamping run whole between awaits
+                        hostprof.set_stage("decode")
                     body, rid, deadline_mono = self._prepare_request(
                         body, degraded)
+                    if hp:
+                        hostprof.clear_stage()
                     if deadline_mono is not None and \
                             time.perf_counter() >= deadline_mono:
                         # budget already spent before any fan-out
@@ -724,7 +769,13 @@ class AggregatorService:
                         if wire.MARKER_DEGRADED not in result.markers:
                             result.markers.append(wire.MARKER_DEGRADED)
                         metrics.inc("aggregator.degraded_responses")
+                    if hp:
+                        # per-request encode on the loop thread — the
+                        # rid pin is exact here (no awaits inside)
+                        hostprof.set_stage("encode", rid)
                     rbody = result.pack()
+                    if hp:
+                        hostprof.clear_stage()
                     t_send0 = time.perf_counter() if rec else 0.0
                     writer.write(wire.PacketHeader(
                         wire.PacketType.SearchResponse,
@@ -849,6 +900,11 @@ class AggregatorService:
         replies = await asyncio.gather(*tasks)
         rec = flightrec.enabled()
         t_merge0 = time.monotonic_ns() if rec else 0
+        hp = hostprof.armed()
+        if hp:
+            # the merge runs whole between awaits and serves exactly one
+            # request — the aggregator's execute-stage analog, rid exact
+            hostprof.set_stage("merge", rid)
         merged = wire.RemoteSearchResult(wire.ResultStatus.Success, [])
         for status, results, shard_rid, shard_markers in replies:
             if status != wire.ResultStatus.Success:
@@ -878,6 +934,8 @@ class AggregatorService:
                 rel_tol=self.context.merge_rel_tol,
                 replica_groups=([s.replica_group for _, s in targets]
                                 if declared else None))
+        if hp:
+            hostprof.clear_stage()
         if rec:
             flightrec.record("aggregator", "merge", rid,
                              dur_ns=time.monotonic_ns() - t_merge0,

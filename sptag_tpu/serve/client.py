@@ -166,7 +166,8 @@ class AnnClient:
                         self._sock = None
 
         self._hb_thread = threading.Thread(
-            target=pump, args=(self._hb_stop,), daemon=True)
+            target=pump, args=(self._hb_stop,), daemon=True,
+            name="client-heartbeat")
         self._hb_thread.start()
 
     def stop_heartbeat(self) -> None:
@@ -344,7 +345,8 @@ class PipelinedAnnClient:
             self._backoff.succeeded()
             self._sock = sock
             self._reader = threading.Thread(target=self._read_loop,
-                                            args=(sock,), daemon=True)
+                                            args=(sock,), daemon=True,
+                                            name="client-reader-pump")
             self._reader.start()
 
     @property

@@ -5,41 +5,43 @@ these when their `MetricsPort` is set:
 
 * ``GET /metrics`` — the process-wide registry (utils/metrics.py) in
   Prometheus text format 0.0.4: request/error counters, queue gauges, and
-  every trace-span latency as a log-bucketed histogram.
+  every trace-span latency as a log-bucketed histogram; plus the
+  self-rendered labeled series the shared registry can't express — the
+  device-memory ledger, the quality windows and (when the contention
+  ledger is on) ``lock_wait_ms{name=}`` / ``lock_hold_ms{name=}`` per-lock
+  gauges (utils/locksan.py, ISSUE 10).
 * ``GET /healthz`` — JSON from the owner's health callback (loaded
   indexes + sample counts for a server, backend connectivity for an
   aggregator); HTTP 200 when ``status`` is ``ok``, 503 otherwise, so load
   balancers can act on the code alone.
 * ``GET /debug/flight`` — the flight recorder's ring
-  (utils/flightrec.py) as Chrome trace-event JSON, loadable directly in
-  Perfetto / chrome://tracing.  Always answers 200; with the recorder
-  off the trace is empty and ``otherData.counters.enabled`` is 0.
-* ``GET /debug/memory`` — the device-memory ledger (utils/devmem.py):
-  per-component resident bytes plus the ``jax.live_arrays()``
-  cross-check, so "what is holding the HBM" is one curl away.
+  (utils/flightrec.py) as Chrome trace-event JSON.
+* ``GET /debug/memory`` — the device-memory ledger (utils/devmem.py).
 * ``GET /debug/admission`` — the overload-defense subsystem
-  (serve/admission.py): admission state machine, per-client fair-share
-  shares, hedge and reconnect-backoff accounting and the active
-  fault-injection plan.  Always answers 200; with no controller the
-  payload shows ``enabled: false``.
-* ``GET /debug/mutation`` — the live-mutation subsystem (ISSUE 9):
-  per-index snapshot epoch, WAL accounting (acked writes, home
-  folder), delta-shard occupancy, swap count and recent swap windows.
-  Always answers 200; a tier with no indexes shows ``enabled: false``.
+  (serve/admission.py).
+* ``GET /debug/mutation`` — the live-mutation subsystem (ISSUE 9).
 * ``GET /debug/quality`` — the search-quality observatory
-  (utils/qualmon.py): online recall windows with Wilson bounds per
-  (searchmode, shard), per-shard index-health payloads (graph degrees,
-  reciprocity, seed reachability, deleted fraction) and the shadow-path
-  accounting.  Always answers 200; off shows ``enabled: false``.  An
-  aggregator sharing its process with shard tiers (tests, single-host)
-  sees every shard's windows merged; separate processes each expose
-  their own view.
+  (utils/qualmon.py).
+* ``GET /debug/prof`` — the host sampling profiler (utils/hostprof.py,
+  ISSUE 10).  ``?action=`` selects ``snapshot`` (default; JSON state),
+  ``start`` (optionally ``&hz=``/``&events=`` — arms and launches the
+  sampler on demand even when ``HostProfHz`` was 0), ``stop``,
+  ``flamegraph`` (collapsed-stack text/plain for flamegraph.pl /
+  speedscope) and ``chrome`` (the sample ring as Chrome-trace JSON the
+  flight merge CLI can overlay on device timelines).
+* ``GET /debug/devicetrace`` — on-demand BOUNDED device trace: reuses
+  ``trace.start_trace``/``stop_trace`` (jax.profiler) for
+  ``?duration_ms=`` (default 500, capped at ``DEVICE_TRACE_MAX_MS``)
+  and returns the trace directory.  One at a time; a second request
+  while one runs answers 409.
 
-The /metrics exposition also carries the flight recorder's health
-counters (ring drops, dump errors, auto-dump rate-limit hits) as
-``flight_*`` gauges — they existed in ``flightrec.counters()`` but were
-invisible to scraping (ISSUE 6 satellite closing a PR-5 gap) — and the
-ledger's ``memory_device_bytes{component=…}`` gauges.
+Routing is a REGISTRY (`_routes`): every endpoint is a callable
+``params -> (body, content-type, status)`` and `routes()` lists the
+registered paths — the surface tests/test_hostprof.py parameterizes
+over.  Error paths are uniform: unknown paths answer 404 WITH a body, a
+route that raises answers 500 with a text body (counted as
+``metrics_http.handler_errors``) and the listener keeps serving — one
+broken callback must never kill the scrape endpoint.
 
 Port semantics: 0 = disabled (the owner never constructs this), a
 negative port binds OS-ephemeral (tests read the bound port back from
@@ -56,13 +58,28 @@ from __future__ import annotations
 
 import json
 import logging
+import tempfile
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
-from sptag_tpu.utils import devmem, flightrec, metrics, qualmon
+from sptag_tpu.utils import (devmem, flightrec, hostprof, locksan, metrics,
+                             qualmon)
 
 log = logging.getLogger(__name__)
+
+_JSON = "application/json"
+_TEXT = "text/plain; charset=utf-8"
+_PROM = "text/plain; version=0.0.4; charset=utf-8"
+
+#: hard ceiling on one on-demand device trace (ms) — the endpoint must
+#: never wedge a scrape thread on an unbounded profiling session
+DEVICE_TRACE_MAX_MS = 10_000.0
+
+#: one device trace at a time, process-wide (jax.profiler is global)
+_device_trace_lock = threading.Lock()
 
 
 def publish_flight_gauges() -> None:
@@ -78,6 +95,21 @@ def publish_flight_gauges() -> None:
     metrics.set_gauge("flight.dump_errors", c.get("dump_errors", 0))
     metrics.set_gauge("flight.dump_ratelimited",
                       c.get("dump_ratelimited", 0))
+
+
+def publish_hostprof_gauges() -> None:
+    """Host-profiler health counters as gauges at scrape time (the
+    flight-gauge pattern; names literal, GL602)."""
+    c = hostprof.counters()
+    metrics.set_gauge("hostprof.enabled", c.get("enabled", 0))
+    metrics.set_gauge("hostprof.running", c.get("running", 0))
+    metrics.set_gauge("hostprof.samples", c.get("samples", 0))
+    metrics.set_gauge("hostprof.overruns", c.get("overruns", 0))
+    metrics.set_gauge("hostprof.folded_overflow",
+                      c.get("folded_overflow", 0))
+
+
+_Route = Callable[[Dict[str, str]], Tuple[bytes, str, int]]
 
 
 class MetricsHttpServer:
@@ -97,6 +129,162 @@ class MetricsHttpServer:
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._routes: Dict[str, _Route] = {
+            "/metrics": self._route_metrics,
+            "/healthz": self._route_healthz,
+            "/debug/flight": self._route_flight,
+            "/debug/memory": self._route_memory,
+            "/debug/quality": self._route_quality,
+            "/debug/admission": self._route_admission,
+            "/debug/mutation": self._route_mutation,
+            "/debug/prof": self._route_prof,
+            "/debug/devicetrace": self._route_devicetrace,
+        }
+
+    def routes(self) -> List[str]:
+        """Registered paths — the parameterized-test surface: every
+        entry answers a GET with its declared content-type and a body,
+        and never kills the listener."""
+        return sorted(self._routes)
+
+    # ------------------------------------------------------------- routes
+
+    @staticmethod
+    def _route_metrics(params: Dict[str, str]) -> Tuple[bytes, str, int]:
+        publish_flight_gauges()
+        publish_hostprof_gauges()
+        # quality windows / memory ledger / lock-contention ledger render
+        # as labeled series the shared registry can't express (the devmem
+        # pattern); each is the empty string when it has nothing, so the
+        # off-path exposition is unchanged
+        body = (metrics.render_prometheus()
+                + devmem.render_prometheus()
+                + qualmon.render_prometheus()
+                + locksan.render_prometheus()).encode()
+        return body, _PROM, 200
+
+    def _route_healthz(self, params: Dict[str, str]
+                       ) -> Tuple[bytes, str, int]:
+        try:
+            state = self.health() if self.health else {"status": "ok"}
+        except Exception:                                # noqa: BLE001
+            # a broken health callback must answer 500, not reset the
+            # probe's connection — a load balancer reads a reset as
+            # process death
+            log.exception("health callback failed")
+            state = {"status": "error"}
+        code = (200 if state.get("status") == "ok"
+                else 500 if state.get("status") == "error"
+                else 503)
+        return json.dumps(state).encode(), _JSON, code
+
+    @staticmethod
+    def _route_flight(params: Dict[str, str]) -> Tuple[bytes, str, int]:
+        body = json.dumps(flightrec.export_chrome_trace()).encode()
+        return body, _JSON, 200
+
+    @staticmethod
+    def _route_memory(params: Dict[str, str]) -> Tuple[bytes, str, int]:
+        return json.dumps(devmem.snapshot()).encode(), _JSON, 200
+
+    @staticmethod
+    def _route_quality(params: Dict[str, str]) -> Tuple[bytes, str, int]:
+        return json.dumps(qualmon.snapshot()).encode(), _JSON, 200
+
+    def _route_admission(self, params: Dict[str, str]
+                         ) -> Tuple[bytes, str, int]:
+        try:
+            state = (self.admission() if self.admission
+                     else {"enabled": False})
+        except Exception:                                # noqa: BLE001
+            log.exception("admission callback failed")
+            state = {"enabled": False, "error": True}
+        return json.dumps(state).encode(), _JSON, 200
+
+    def _route_mutation(self, params: Dict[str, str]
+                        ) -> Tuple[bytes, str, int]:
+        try:
+            state = (self.mutation() if self.mutation
+                     else {"enabled": False})
+        except Exception:                                # noqa: BLE001
+            log.exception("mutation callback failed")
+            state = {"enabled": False, "error": True}
+        return json.dumps(state).encode(), _JSON, 200
+
+    @staticmethod
+    def _route_prof(params: Dict[str, str]) -> Tuple[bytes, str, int]:
+        """GET /debug/prof — host-profiler control + export surface
+        (utils/hostprof.py): start/stop/snapshot/flamegraph/chrome."""
+        action = params.get("action", "snapshot")
+        if action == "start":
+            hz = None
+            if params.get("hz"):
+                try:
+                    hz = float(params["hz"])
+                except ValueError:
+                    return (b'{"error": "hz must be a number"}\n',
+                            _JSON, 400)
+            if params.get("events"):
+                try:
+                    hostprof.configure(max_samples=int(params["events"]))
+                except ValueError:
+                    return (b'{"error": "events must be an integer"}\n',
+                            _JSON, 400)
+            started = hostprof.start(
+                hz_override=hz if hz is not None
+                else (hostprof.hz() or hostprof.DEFAULT_HZ))
+            return (json.dumps({"running": started,
+                                "hz": hostprof.hz()}).encode(),
+                    _JSON, 200)
+        if action == "stop":
+            hostprof.stop()
+            return (json.dumps(hostprof.counters()).encode(), _JSON, 200)
+        if action == "flamegraph":
+            return hostprof.flamegraph().encode(), _TEXT, 200
+        if action == "chrome":
+            return (json.dumps(hostprof.export_chrome_trace()).encode(),
+                    _JSON, 200)
+        if action == "snapshot":
+            return json.dumps(hostprof.snapshot()).encode(), _JSON, 200
+        return (json.dumps({"error": f"unknown action {action!r}",
+                            "actions": ["start", "stop", "snapshot",
+                                        "flamegraph", "chrome"]}).encode(),
+                _JSON, 400)
+
+    @staticmethod
+    def _route_devicetrace(params: Dict[str, str]
+                           ) -> Tuple[bytes, str, int]:
+        """GET /debug/devicetrace — one bounded jax profiler trace via
+        trace.start_trace/stop_trace; blocks THIS scrape thread for the
+        (capped) duration and returns the trace dir.  409 while another
+        trace runs — jax.profiler is process-global."""
+        try:
+            duration_ms = float(params.get("duration_ms", "500"))
+        except ValueError:
+            return (b'{"error": "duration_ms must be a number"}\n',
+                    _JSON, 400)
+        duration_ms = max(1.0, min(duration_ms, DEVICE_TRACE_MAX_MS))
+        if not _device_trace_lock.acquire(blocking=False):
+            return (b'{"error": "a device trace is already running"}\n',
+                    _JSON, 409)
+        try:
+            from sptag_tpu.utils import trace as trace_mod
+
+            logdir = params.get("dir") or tempfile.mkdtemp(
+                prefix="sptag-devicetrace-")
+            trace_mod.start_trace(logdir)
+            try:
+                time.sleep(duration_ms / 1000.0)
+            finally:
+                trace_mod.stop_trace()
+            metrics.inc("metrics_http.device_traces")
+            return (json.dumps({"dir": logdir,
+                                "duration_ms": duration_ms}).encode(),
+                    _JSON, 200)
+        finally:
+            _device_trace_lock.release()
+
+    # ---------------------------------------------------------- lifecycle
 
     def start(self) -> int:
         """Bind and serve on a daemon thread; returns the bound port."""
@@ -104,82 +292,32 @@ class MetricsHttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):                            # noqa: N802
+                # ThreadingHTTPServer mints anonymous "Thread-N" workers;
+                # name them so profiler samples and thread dumps read
+                # (the no-anonymous-threads contract, ISSUE 10 satellite)
+                cur = threading.current_thread()
+                if cur.name.startswith("Thread-"):
+                    cur.name = "metrics-http-conn"
+                path, _, qs = self.path.partition("?")
+                params = {k: v[-1] for k, v in
+                          urllib.parse.parse_qs(qs).items()}
+                route = owner._routes.get(path)
                 try:
-                    if self.path.split("?")[0] == "/metrics":
-                        publish_flight_gauges()
-                        # quality windows render as labeled series the
-                        # shared registry can't express (the devmem
-                        # pattern); empty string when nothing recorded,
-                        # so the off-path exposition is unchanged
-                        body = (metrics.render_prometheus()
-                                + devmem.render_prometheus()
-                                + qualmon.render_prometheus()).encode()
-                        ctype = "text/plain; version=0.0.4; charset=utf-8"
-                        code = 200
-                    elif self.path.split("?")[0] == "/debug/memory":
-                        body = json.dumps(devmem.snapshot()).encode()
-                        ctype = "application/json"
-                        code = 200
-                    elif self.path.split("?")[0] == "/debug/quality":
-                        # search-quality observatory (utils/qualmon.py):
-                        # config, recall windows + Wilson bounds, per-
-                        # shard index health, triage counters.  Always
-                        # 200; off shows enabled=false and empty views
-                        body = json.dumps(qualmon.snapshot()).encode()
-                        ctype = "application/json"
-                        code = 200
-                    elif self.path.split("?")[0] == "/debug/admission":
-                        # overload defense (serve/admission.py): state
-                        # machine, fair-share shares, hedge + reconnect
-                        # accounting, fault-injection plan.  Always 200;
-                        # without a controller shows enabled=false.
-                        try:
-                            state = (owner.admission()
-                                     if owner.admission
-                                     else {"enabled": False})
-                        except Exception:                # noqa: BLE001
-                            log.exception("admission callback failed")
-                            state = {"enabled": False, "error": True}
-                        body = json.dumps(state).encode()
-                        ctype = "application/json"
-                        code = 200
-                    elif self.path.split("?")[0] == "/debug/mutation":
-                        # live-mutation subsystem (core/index.py +
-                        # algo/bkt.py, ISSUE 9): per-index epoch / WAL /
-                        # delta / swap state.  Always 200; a tier with
-                        # no indexes (aggregator) shows enabled=false.
-                        try:
-                            state = (owner.mutation()
-                                     if owner.mutation
-                                     else {"enabled": False})
-                        except Exception:                # noqa: BLE001
-                            log.exception("mutation callback failed")
-                            state = {"enabled": False, "error": True}
-                        body = json.dumps(state).encode()
-                        ctype = "application/json"
-                        code = 200
-                    elif self.path.split("?")[0] == "/debug/flight":
-                        body = json.dumps(
-                            flightrec.export_chrome_trace()).encode()
-                        ctype = "application/json"
-                        code = 200
-                    elif self.path.split("?")[0] == "/healthz":
-                        try:
-                            state = owner.health() if owner.health else \
-                                {"status": "ok"}
-                        except Exception:                # noqa: BLE001
-                            # a broken health callback must answer 500,
-                            # not reset the probe's connection — a load
-                            # balancer reads a reset as process death
-                            log.exception("health callback failed")
-                            state = {"status": "error"}
-                        body = json.dumps(state).encode()
-                        ctype = "application/json"
-                        code = (200 if state.get("status") == "ok"
-                                else 500 if state.get("status") == "error"
-                                else 503)
+                    if route is None:
+                        body = (f"not found: {path}\n"
+                                f"routes: {', '.join(owner.routes())}\n"
+                                ).encode()
+                        ctype, code = _TEXT, 404
                     else:
-                        body, ctype, code = b"not found\n", "text/plain", 404
+                        body, ctype, code = route(params)
+                except Exception:                        # noqa: BLE001
+                    # a broken route answers 500 and the listener keeps
+                    # serving — counted so a flapping callback is visible
+                    metrics.inc("metrics_http.handler_errors")
+                    log.exception("debug route %s failed", path)
+                    body = b"internal error; see server log\n"
+                    ctype, code = _TEXT, 500
+                try:
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))

@@ -26,7 +26,8 @@ from sptag_tpu.serve import admission as admission_mod
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
-from sptag_tpu.utils import faultinject, flightrec, metrics, qualmon, trace
+from sptag_tpu.utils import (faultinject, flightrec, hostprof, locksan,
+                             metrics, qualmon, trace)
 
 log = logging.getLogger(__name__)
 
@@ -52,7 +53,9 @@ class SearchServer:
                  admission: Optional[
                      admission_mod.AdmissionController] = None,
                  fault_spec: Optional[str] = None,
-                 fault_seed: Optional[int] = None):
+                 fault_seed: Optional[int] = None,
+                 host_prof_hz: Optional[float] = None,
+                 host_prof_dump_on_slow_query: Optional[bool] = None):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -133,6 +136,16 @@ class SearchServer:
                 signals=self._admission_signals)
         else:
             self.admission = None
+        # host sampling profiler (utils/hostprof.py, ISSUE 10): process-
+        # wide like the flight recorder; ctor overrides are the test
+        # surface, [Service] HostProfHz/... the deployment one
+        self.host_prof_hz = (
+            host_prof_hz if host_prof_hz is not None
+            else context.settings.host_prof_hz)
+        self.host_prof_dump_on_slow_query = (
+            host_prof_dump_on_slow_query
+            if host_prof_dump_on_slow_query is not None
+            else context.settings.host_prof_dump_on_slow_query)
         # default per-request deadline (requests carrying their own —
         # wire trailer or $deadlinems text option — keep it)
         self.deadline_ms = context.settings.deadline_ms
@@ -179,6 +192,22 @@ class SearchServer:
                 max_events=self.context.settings.flight_recorder_events
                 or None,
                 dump_dir=self.flight_dump_dir or None)
+        if self.context.settings.lock_contention_ledger:
+            # ctor-built contexts (tests) never ran from_ini's early
+            # enable; late enabling still covers every SanLock at its
+            # next acquire
+            locksan.enable_contention()
+        if self.host_prof_hz > 0:
+            # arm + start the host sampler (utils/hostprof.py).  At the
+            # default HostProfHz=0 this branch never runs: no sampler
+            # thread, stage pins stay one flag test (the parity contract)
+            hostprof.configure(
+                hz=self.host_prof_hz,
+                max_samples=self.context.settings.host_prof_events
+                or None,
+                dump_on_slow_query=self.host_prof_dump_on_slow_query
+                or None)
+            hostprof.start()
         if self.quality_sample_rate > 0:
             qualmon.configure(
                 sample_rate=self.quality_sample_rate,
@@ -399,6 +428,12 @@ class SearchServer:
                     return
                 degraded = decision == admission_mod.DEGRADE
             t_dec0 = time.monotonic_ns() if rec else 0
+            hp = hostprof.armed()
+            if hp:
+                # serve-stage pin (utils/hostprof.py, ISSUE 10): samples
+                # landing on the loop thread during decode fold under
+                # stage:decode (the rid is unknown until unpack returns)
+                hostprof.set_stage("decode")
             with trace.span("server.decode"):
                 query = wire.RemoteQuery.unpack(body)
             if query is None:
@@ -419,6 +454,8 @@ class SearchServer:
                     self.flight_tier, "decode",
                     query.request_id if query is not None else "",
                     dur_ns=time.monotonic_ns() - t_dec0)
+            if hp:
+                hostprof.clear_stage()
             # deadline resolution (ISSUE 8): the wire trailer wins, the
             # $deadlinems text option covers reference clients, then the
             # operator's [Service] DeadlineMs default.  The value is a
@@ -536,11 +573,23 @@ class SearchServer:
                      else None)
         try:
             def run_batch():
-                with trace.span("server.execute_batch"):
-                    return self.executor.execute_batch(
-                        texts, on_ready=on_ready, rids=rids,
-                        degraded=deg_flags if deg_floor else None,
-                        degrade_floor=deg_floor)
+                if hostprof.armed():
+                    # execute-stage pin: rid attribution is EXACT when
+                    # the batch carries one request (the straggler /
+                    # slow-query case the profiler exists for); mixed
+                    # batches record the stage alone — per-query blame
+                    # inside a coalesced device batch would be a lie
+                    live = [r for r in rids if r]
+                    hostprof.set_stage(
+                        "execute", live[0] if len(live) == 1 else "")
+                try:
+                    with trace.span("server.execute_batch"):
+                        return self.executor.execute_batch(
+                            texts, on_ready=on_ready, rids=rids,
+                            degraded=deg_flags if deg_floor else None,
+                            degrade_floor=deg_floor)
+                finally:
+                    hostprof.clear_stage()
             results = await loop.run_in_executor(None, run_batch)
         except Exception:
             metrics.inc("server.batch_failures")
@@ -666,8 +715,15 @@ class SearchServer:
             if rec:
                 flightrec.record(self.flight_tier, "degrade", rid)
         t_enc0 = time.monotonic_ns() if rec else 0
+        hp = hostprof.armed()
+        if hp:
+            # per-query encode runs whole on the loop thread between
+            # awaits, so the rid pin is exact here
+            hostprof.set_stage("encode", rid)
         with trace.span("server.encode"):
             body = result.pack()
+        if hp:
+            hostprof.clear_stage()
         if rec:
             flightrec.record(self.flight_tier, "encode", rid,
                              dur_ns=time.monotonic_ns() - t_enc0)

@@ -146,6 +146,22 @@ class ServiceSettings:
     # raise instead of log)
     lock_sanitizer: bool = False
     locksan_watchdog_ms: float = 0.0
+    # host sampling profiler (utils/hostprof.py, ISSUE 10): HostProfHz>0
+    # starts the sampler at serve start — per-thread stacks folded into a
+    # bounded flamegraph aggregate with serve-stage + request-id
+    # attribution (GET /debug/prof).  0 (default): the sampler thread is
+    # never started and the stage pins are one flag test.
+    host_prof_hz: float = 0.0
+    # raw-sample ring capacity for the chrome-trace/merge export
+    # (0 = hostprof.DEFAULT_MAX_SAMPLES)
+    host_prof_events: int = 0
+    # bundle host stacks into the flight recorder's slow-query auto-dump
+    # (rides FlightDumpOnSlowQuery — needs that dir armed to dump)
+    host_prof_dump_on_slow_query: bool = False
+    # lock-contention ledger (utils/locksan.py, ISSUE 10): per-lock
+    # wait/hold accounting published as lock_wait_ms{name=} gauges.
+    # Enabled at config load, BEFORE the indexes build their locks.
+    lock_contention_ledger: bool = False
 
 
 class ServiceContext:
@@ -235,6 +251,16 @@ class ServiceContext:
             ("1", "true", "on", "yes", "strict"),
             locksan_watchdog_ms=float(reader.get_parameter(
                 "Service", "LockSanWatchdogMs", "0")),
+            host_prof_hz=float(reader.get_parameter(
+                "Service", "HostProfHz", "0")),
+            host_prof_events=int(reader.get_parameter(
+                "Service", "HostProfEvents", "0")),
+            host_prof_dump_on_slow_query=reader.get_parameter(
+                "Service", "HostProfDumpOnSlowQuery", "0").lower() in
+            ("1", "true", "on", "yes"),
+            lock_contention_ledger=reader.get_parameter(
+                "Service", "LockContentionLedger", "0").lower() in
+            ("1", "true", "on", "yes"),
         )
         if s.lock_sanitizer:
             # before the indexes load: their writer locks must be created
@@ -244,6 +270,12 @@ class ServiceContext:
                 strict=(reader.get_parameter(
                     "Service", "LockSanitizer", "0").lower() == "strict"),
                 watchdog_ms=(s.locksan_watchdog_ms or None))
+        if s.lock_contention_ledger:
+            # same timing contract as the sanitizer: arm BEFORE index
+            # load so the indexes' writer locks are wrapped for the
+            # ledger even with the order sanitizer off
+            from sptag_tpu.utils import locksan
+            locksan.enable_contention()
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
         for name in (t.strip() for t in index_list.split(",")):

@@ -21,6 +21,14 @@ cross-host merges stay per-rid-correct but tier clocks may be offset.
 
 `--rid` narrows the output to one request id (plus untagged pool-level
 events are dropped) — the "explain THIS query" artifact.
+
+Host-profiler overlay (ISSUE 10): `utils/hostprof.py` exports its
+sample ring in the same dump schema (tier ``hostprof``, kind
+``sample``, rid-tagged where attribution is exact), so
+``hostprof.write_trace`` files and ``/debug/prof?action=chrome`` output
+merge right here — host stacks land on the same Perfetto timeline as
+the flight spans and sampled device segments, one track per sampled
+thread.
 """
 
 from __future__ import annotations

@@ -90,6 +90,20 @@ _last_dump_mono = 0.0
 
 _tls = threading.local()
 
+#: optional auto-dump enricher (utils/hostprof.py registers its host-
+#: stack payload here when HostProfDumpOnSlowQuery is on): called once
+#: per dump_to_file, its dict merges into the dump's otherData so one
+#: slow-query artifact bundles the flight timeline AND the host stacks
+_dump_enricher = None
+
+
+def set_dump_enricher(fn) -> None:
+    """Register `fn() -> dict` to enrich auto-dump otherData (None
+    deregisters).  A failing enricher is logged + counted as a dump
+    error, never fatal — the flight trace must still land."""
+    global _dump_enricher
+    _dump_enricher = fn
+
 
 class _Buf:
     """One thread's lock-free event buffer (deque append is atomic).
@@ -168,7 +182,8 @@ def reset() -> None:
     global _enabled, _max_events, _dump_dir, _dump_max_files
     global _epoch, _ring, _ring_dropped, _dump_errors, _dump_ratelimited
     global _retired_recorded, _retired_dropped, _last_dump_mono
-    global _dump_min_interval_s
+    global _dump_min_interval_s, _dump_enricher
+    _dump_enricher = None
     with _reg_lock:
         _enabled = False
         _max_events = DEFAULT_MAX_EVENTS
@@ -424,6 +439,13 @@ def dump_to_file(reason: str, rid: str = "") -> Optional[str]:
         st = query_stats(rid)
         if st:
             other["query_stats"] = dict(st)
+    if _dump_enricher is not None:
+        try:
+            other.update(_dump_enricher() or {})
+        except Exception:                                # noqa: BLE001
+            with _reg_lock:
+                _dump_errors += 1
+            log.exception("flight dump enricher failed")
     trace = export_chrome_trace(other_data=other)
     try:
         os.makedirs(_dump_dir, exist_ok=True)

@@ -33,6 +33,18 @@ Adopted by serve/client.py, core/index.py (and through it algo/bkt.py),
 and utils/threadpool.py; tests/conftest.py enables the sanitizer for the
 whole tier-1 suite, so every serve/index test doubles as an inversion
 probe.
+
+Contention ledger (ISSUE 10): a second opt-in — env
+``SPTAG_LOCKSAN_CONTENTION=1`` or ini ``[Service] LockContentionLedger``
+— makes every SanLock account per-lock wait and hold times (acquires,
+contended count, total/max wait ms, total/max hold ms).  Counters are
+instance-local and updated only while the lock is held, so the lock
+itself serializes them; the exposition aggregates by lock NAME and
+self-renders as ``lock_wait_ms{name=}`` / ``lock_hold_ms{name=}`` /
+``lock_acquires{name=}`` / ``lock_contended{name=}`` gauges on /metrics
+(serve/metrics_http.py), the per-lock complement to the host profiler's
+stack samples (utils/hostprof.py): hostprof shows WHICH waits dominate,
+the ledger shows WHOSE lock they are.
 """
 
 from __future__ import annotations
@@ -43,6 +55,7 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 from typing import Dict, List, Optional, Set
 
 from sptag_tpu.utils import metrics
@@ -63,16 +76,35 @@ _cfg_lock = threading.Lock()
 _enabled_override: Optional[bool] = None
 _strict_override: Optional[bool] = None
 _watchdog_ms_override: Optional[float] = None
+_contention_override: Optional[bool] = None
 
 
 def _env_mode() -> str:
     return os.environ.get("SPTAG_LOCKSAN", "").strip().lower()
 
 
-def enabled() -> bool:
+def _san_enabled() -> bool:
     if _enabled_override is not None:
         return _enabled_override
     return _env_mode() in ("1", "true", "on", "log", "strict", "raise")
+
+
+def contention_enabled() -> bool:
+    """The opt-in lock-contention ledger (ISSUE 10): per-lock wait/hold
+    accounting published as ``lock_wait_ms{name=}`` gauges on /metrics.
+    Env ``SPTAG_LOCKSAN_CONTENTION=1`` or ini ``[Service]
+    LockContentionLedger``."""
+    if _contention_override is not None:
+        return _contention_override
+    return os.environ.get("SPTAG_LOCKSAN_CONTENTION", "").strip().lower() \
+        in ("1", "true", "on", "yes")
+
+
+def enabled() -> bool:
+    """Wrap locks at creation?  True when either the order sanitizer or
+    the contention ledger wants them — the ledger rides the same SanLock
+    wrappers."""
+    return _san_enabled() or contention_enabled()
 
 
 def strict() -> bool:
@@ -104,6 +136,22 @@ def enable(strict: Optional[bool] = None,
             _watchdog_ms_override = watchdog_ms
 
 
+def enable_contention() -> None:
+    """Turn the contention ledger on for locks acquired from now on
+    (pre-existing SanLocks join the ledger at their next acquire; plain
+    locks created while every locksan feature was off stay unwrapped —
+    like `enable()`, arm BEFORE building the structures to cover)."""
+    global _contention_override
+    with _cfg_lock:
+        _contention_override = True
+
+
+def disable_contention() -> None:
+    global _contention_override
+    with _cfg_lock:
+        _contention_override = False
+
+
 def disable() -> None:
     global _enabled_override, _strict_override, _watchdog_ms_override
     with _cfg_lock:
@@ -116,10 +164,12 @@ def reset_config() -> None:
     """Drop every enable()/disable() override — the environment decides
     again (test hygiene)."""
     global _enabled_override, _strict_override, _watchdog_ms_override
+    global _contention_override
     with _cfg_lock:
         _enabled_override = None
         _strict_override = None
         _watchdog_ms_override = None
+        _contention_override = None
 
 
 # --------------------------------------------------------------------------
@@ -247,6 +297,101 @@ def _watchdog_dump(name: str, waited_s: float) -> None:
 
 
 # --------------------------------------------------------------------------
+# contention ledger (ISSUE 10)
+# --------------------------------------------------------------------------
+
+#: SanLock instances that recorded at least one acquire while the ledger
+#: was on.  Weak so a retired scheduler's pool locks don't pin memory;
+#: several instances may share a NAME (one VectorIndex._lock per index)
+#: and the exposition aggregates by name.
+_ledger_locks: "weakref.WeakSet[SanLock]" = weakref.WeakSet()
+
+
+def _ledger_register(lock: "SanLock") -> None:
+    with _cfg_lock:
+        _ledger_locks.add(lock)
+
+
+def contention_snapshot() -> Dict[str, Dict[str, float]]:
+    """Per-lock-NAME wait/hold aggregate: acquires, contended count,
+    total/max wait ms, total/max hold ms.  Instance counters are
+    serialized by the lock they describe (updated while it is held), so
+    this racy read is at worst one acquisition stale."""
+    out: Dict[str, Dict[str, float]] = {}
+    locks = list(_ledger_locks)
+    for lk in locks:
+        agg = out.setdefault(lk.name, {
+            "acquires": 0, "contended": 0,
+            "wait_ms": 0.0, "wait_ms_max": 0.0,
+            "hold_ms": 0.0, "hold_ms_max": 0.0})
+        agg["acquires"] += lk._c_acquires
+        agg["contended"] += lk._c_contended
+        agg["wait_ms"] += lk._c_wait_ms
+        agg["wait_ms_max"] = max(agg["wait_ms_max"], lk._c_wait_max)
+        agg["hold_ms"] += lk._c_hold_ms
+        agg["hold_ms_max"] = max(agg["hold_ms_max"], lk._c_hold_max)
+    for agg in out.values():
+        for k in ("wait_ms", "wait_ms_max", "hold_ms", "hold_ms_max"):
+            agg[k] = round(agg[k], 3)
+    return out
+
+
+def render_prometheus() -> str:
+    """Self-rendered labeled series for the /metrics exposition (the
+    devmem/qualmon pattern — the shared registry has no labels):
+    ``lock_wait_ms{name=}`` / ``lock_wait_ms_max`` / ``lock_hold_ms`` /
+    ``lock_acquires`` / ``lock_contended``.  Empty string when the
+    ledger is off or has seen nothing, so the default exposition is
+    unchanged."""
+    snap = contention_snapshot()
+    if not snap:
+        return ""
+    lines: List[str] = []
+    series = (("lock_wait_ms", "wait_ms",
+               "total milliseconds threads waited to acquire the lock"),
+              ("lock_wait_ms_max", "wait_ms_max",
+               "longest single wait in milliseconds"),
+              ("lock_hold_ms", "hold_ms",
+               "total milliseconds the lock was held"),
+              ("lock_hold_ms_max", "hold_ms_max",
+               "longest single hold in milliseconds"),
+              ("lock_acquires", "acquires", "total acquisitions"),
+              ("lock_contended", "contended",
+               "acquisitions that found the lock already held"))
+    for metric, key, help_text in series:
+        lines.append(f"# HELP {metric} {help_text}")
+        lines.append(f"# TYPE {metric} gauge")
+        for name in sorted(snap):
+            label = name.replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(f'{metric}{{name="{label}"}} {snap[name][key]}')
+    return "\n".join(lines) + "\n"
+
+
+def reset_contention() -> None:
+    """Zero the ledger and drop the enable_contention() override — the
+    environment decides again (test isolation; wired into conftest's
+    autouse telemetry reset).  Live locks keep recording if the env
+    keeps the ledger on."""
+    global _contention_override
+    with _cfg_lock:
+        _contention_override = None
+    locks = list(_ledger_locks)
+    for lk in locks:
+        lk._c_acquires = 0
+        lk._c_contended = 0
+        lk._c_wait_ms = 0.0
+        lk._c_wait_max = 0.0
+        lk._c_hold_ms = 0.0
+        lk._c_hold_max = 0.0
+        # let the survivor RE-register at its next ledger'd acquire —
+        # without this a long-lived lock (module fixture, process
+        # singleton) would vanish from the exposition forever
+        lk._c_registered = False
+    with _cfg_lock:
+        _ledger_locks.clear()
+
+
+# --------------------------------------------------------------------------
 # the wrappers
 # --------------------------------------------------------------------------
 
@@ -258,6 +403,16 @@ class SanLock:
     def __init__(self, name: str):
         self.name = name
         self._inner = self._make_inner()
+        # contention-ledger counters (ISSUE 10): instance-local, updated
+        # only while THIS lock is held, so the lock itself serializes
+        # them — no extra synchronization on the acquire path
+        self._c_acquires = 0
+        self._c_contended = 0
+        self._c_wait_ms = 0.0
+        self._c_wait_max = 0.0
+        self._c_hold_ms = 0.0
+        self._c_hold_max = 0.0
+        self._c_registered = False
 
     @staticmethod
     def _make_inner():
@@ -265,37 +420,85 @@ class SanLock:
 
     # ---- protocol ----------------------------------------------------
 
-    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+    def _acquire_inner(self, blocking: bool, timeout: float) -> bool:
         if not blocking:
-            ok = self._inner.acquire(False)
-        elif timeout is not None and timeout >= 0:
-            ok = self._inner.acquire(True, timeout)
-        else:
-            wd = watchdog_ms() / 1000.0
-            if wd > 0:
-                ok = self._inner.acquire(True, wd)
-                if not ok:
-                    t0 = time.monotonic()
-                    _watchdog_dump(self.name, wd)
-                    self._inner.acquire()
-                    metrics.observe("locksan.stall_wait",
-                                    wd + time.monotonic() - t0)
-                    ok = True
-            else:
+            return self._inner.acquire(False)
+        if timeout is not None and timeout >= 0:
+            return self._inner.acquire(True, timeout)
+        wd = watchdog_ms() / 1000.0
+        if wd > 0:
+            ok = self._inner.acquire(True, wd)
+            if not ok:
+                t0 = time.monotonic()
+                _watchdog_dump(self.name, wd)
                 self._inner.acquire()
+                metrics.observe("locksan.stall_wait",
+                                wd + time.monotonic() - t0)
+            return True
+        self._inner.acquire()
+        return True
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        led = contention_enabled()
+        if not led:
+            ok = self._acquire_inner(blocking, timeout)
+        else:
+            # ledger path: a failed non-blocking probe marks the acquire
+            # CONTENDED; the wait is whatever the real acquisition then
+            # costs.  An uncontended acquire records ~µs of wait — the
+            # probe itself — which keeps totals honest without a branch
+            # in the common case.
+            t0 = time.perf_counter()
+            contended = False
+            if blocking and self._inner.acquire(False):
                 ok = True
+            elif blocking:
+                contended = True
+                ok = self._acquire_inner(True, timeout)
+            else:
+                ok = self._inner.acquire(False)
+                contended = not ok
+            if ok:
+                wait_ms = (time.perf_counter() - t0) * 1000.0
+                self._c_acquires += 1
+                if contended:
+                    self._c_contended += 1
+                self._c_wait_ms += wait_ms
+                if wait_ms > self._c_wait_max:
+                    self._c_wait_max = wait_ms
+                if not self._c_registered:
+                    self._c_registered = True
+                    _ledger_register(self)
+                # outermost hold starts now (reentrant re-acquires keep
+                # the original timestamp)
+                holds = getattr(_tls, "holds", None)
+                if holds is None:
+                    holds = _tls.holds = {}
+                holds.setdefault(self.name, time.perf_counter())
         if ok:
             self._note_acquired()
         return ok
 
     def release(self) -> None:
-        self._inner.release()
         stack = getattr(_tls, "stack", None)
+        still_held = False
         if stack:
             for i in range(len(stack) - 1, -1, -1):
                 if stack[i] == self.name:
                     del stack[i]
                     break
+            still_held = self.name in stack
+        if not still_held:
+            # outermost release: account the hold BEFORE dropping the
+            # lock — the counters are serialized by holding it
+            holds = getattr(_tls, "holds", None)
+            t0 = holds.pop(self.name, None) if holds else None
+            if t0 is not None and contention_enabled():
+                hold_ms = (time.perf_counter() - t0) * 1000.0
+                self._c_hold_ms += hold_ms
+                if hold_ms > self._c_hold_max:
+                    self._c_hold_max = hold_ms
+        self._inner.release()
 
     def locked(self) -> bool:
         # RLock grew .locked() only in 3.12; fall back to _is_owned-style

@@ -56,7 +56,13 @@ class ThreadPool:
                     f"ThreadPool {self.name!r} is stopped; create a new "
                     "pool instead of re-initializing it")
             for _ in range(max(1, threads)):
-                t = threading.Thread(target=self._run, daemon=True)
+                # named workers: profiler samples, locksan watchdog
+                # dumps and flight tracks must attribute to the pool,
+                # not an anonymous Thread-N (ISSUE 10 satellite)
+                t = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"{self.name or 'pool'}-worker-"
+                         f"{len(self._workers)}")
                 t.start()
                 self._workers.append(t)
 

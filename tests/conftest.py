@@ -46,8 +46,8 @@ def _reset_telemetry_registries():
     registries — all are process-global, so without this a span/counter/
     event assertion in one test would see every earlier test's serving
     traffic (and the suite's pass/fail would depend on execution order)."""
-    from sptag_tpu.utils import (devmem, faultinject, flightrec, metrics,
-                                 qualmon, trace)
+    from sptag_tpu.utils import (devmem, faultinject, flightrec, hostprof,
+                                 locksan, metrics, qualmon, trace)
 
     trace.reset()
     metrics.reset()
@@ -55,6 +55,8 @@ def _reset_telemetry_registries():
     devmem.reset()
     qualmon.reset()
     faultinject.reset()
+    hostprof.reset()
+    locksan.reset_contention()
     yield
 
 

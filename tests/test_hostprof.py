@@ -1,0 +1,624 @@
+"""Host profiler + perf sentinel plumbing (ISSUE 10): sampler fold/
+attribution semantics, the /debug/prof + /debug/devicetrace endpoints,
+the uniform /debug route error contract on both tiers, the lock-
+contention gauges, the no-anonymous-threads contract, the aggregator+
+2-shard e2e (flamegraph with rid-attributed serve stages, lock gauges
+on /metrics, host stacks bundled into the slow-query auto-dump) and the
+HostProfHz=0 byte-parity / sampler-never-started contract."""
+
+import json
+import os
+import re
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.serve import wire
+from sptag_tpu.serve.aggregator import (AggregatorContext,
+                                        AggregatorService, RemoteServer)
+from sptag_tpu.serve.metrics_http import MetricsHttpServer
+from sptag_tpu.serve.server import SearchServer
+from sptag_tpu.serve.service import (SearchExecutor, ServiceContext,
+                                     ServiceSettings)
+from sptag_tpu.tools import flight as flight_cli
+from sptag_tpu.utils import flightrec, hostprof, locksan
+
+from tests.test_serve import _ServerThread
+
+
+def _http_get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    ctype = resp.getheader("Content-Type") or ""
+    conn.close()
+    return resp.status, body, ctype
+
+
+# ---------------------------------------------------------------------------
+# sampler unit semantics
+# ---------------------------------------------------------------------------
+
+def test_hostprof_off_is_zero_work():
+    """Defaults: unarmed — pins are a flag test that records nothing,
+    no sampler thread exists, counters stay zero."""
+    assert not hostprof.armed() and not hostprof.running()
+    hostprof.set_stage("execute", "rid-x")
+    hostprof.clear_stage()
+    with hostprof.stage("decode", "rid-y"):
+        pass
+    c = hostprof.counters()
+    assert c == {"enabled": 0, "running": 0, "samples": 0, "ticks": 0,
+                 "overruns": 0, "distinct_stacks": 0,
+                 "folded_overflow": 0}
+    assert hostprof.snapshot()["rid_samples"] == {}
+    assert not any(t.name == "hostprof-sampler"
+                   for t in threading.enumerate())
+    # start() without a configured rate must refuse (never a thread)
+    assert hostprof.start() is False
+    assert not any(t.name == "hostprof-sampler"
+                   for t in threading.enumerate())
+
+
+def test_sampler_folds_stage_and_rid_attribution():
+    hostprof.configure(hz=400)
+    assert hostprof.armed() and not hostprof.running()
+    assert hostprof.start() is True
+    done = threading.Event()
+
+    def busy():
+        hostprof.set_stage("execute", "rid-unit-1")
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.25:
+            sum(range(500))
+        hostprof.clear_stage()
+        done.set()
+
+    t = threading.Thread(target=busy, name="unit-busy")
+    t.start()
+    t.join()
+    assert done.is_set()
+    hostprof.stop()
+    assert not hostprof.running()
+    snap = hostprof.snapshot()
+    assert snap["samples"] > 0 and snap["ticks"] > 0
+    assert snap["stage_samples"].get("execute", 0) >= 5
+    assert snap["rid_samples"].get("rid-unit-1", 0) >= 5
+    # flamegraph: collapsed-stack lines "frames... count", thread name
+    # leading, synthetic stage frame injected after it
+    fg = hostprof.flamegraph()
+    assert re.search(r"^unit-busy;stage:execute;\S.* \d+$", fg,
+                     re.MULTILINE), fg[:800]
+    # top_stacks is count-descending and bounded
+    tops = hostprof.top_stacks(3)
+    assert len(tops) <= 3
+    assert all(tops[i][1] >= tops[i + 1][1]
+               for i in range(len(tops) - 1))
+
+
+def test_raw_ring_bounded_and_chrome_export_merges():
+    """The raw ring rides the flightrec event schema: bounded by
+    HostProfEvents, exported as Chrome-trace JSON the flight merge CLI
+    accepts next to a real flight dump (the overlay contract)."""
+    hostprof.configure(hz=500, max_samples=64)
+    hostprof.start()
+    time.sleep(0.25)
+    hostprof.stop()
+    raws = hostprof.raw_events()
+    assert 0 < len(raws) <= 64
+    for e in raws[:5]:
+        assert e["tier"] == "hostprof" and e["kind"] == "sample"
+        assert "stack" in e["payload"] and "tname" in e
+    trace = hostprof.export_chrome_trace()
+    assert trace["flightEvents"] and trace["traceEvents"]
+    assert trace["otherData"]["hostprof"]["samples"] > 0
+    names = {ev.get("args", {}).get("name") for ev in
+             trace["traceEvents"] if ev.get("ph") == "M"}
+    assert "hostprof" in names
+
+
+def test_merge_cli_overlays_hostprof_on_flight_dump(tmp_path):
+    flightrec.configure(enabled=True)
+    flightrec.record("server", "execute", "rid-m", dur_ns=1000)
+    fpath = str(tmp_path / "flight.json")
+    flightrec.write_trace(fpath)
+    hostprof.configure(hz=500)
+    hostprof.start()
+    time.sleep(0.1)
+    hostprof.stop()
+    hpath = hostprof.write_trace(str(tmp_path / "host.json"))
+    out = str(tmp_path / "merged.json")
+    assert flight_cli.main(["-o", out, fpath, hpath]) == 0
+    merged = json.load(open(out))
+    tiers = {e["tier"] for e in merged["flightEvents"]}
+    assert "hostprof" in tiers and "server" in tiers
+
+
+def test_dump_enricher_bundles_host_stacks(tmp_path):
+    """HostProfDumpOnSlowQuery: a flight auto-dump carries
+    otherData.hostprof (samples + top stacks) once the enricher is
+    registered."""
+    dump_dir = str(tmp_path / "dumps")
+    flightrec.configure(enabled=True, dump_dir=dump_dir)
+    hostprof.configure(hz=500, dump_on_slow_query=True)
+    hostprof.start()
+    time.sleep(0.1)
+    flightrec.record("server", "request", "rid-d", dur_ns=100)
+    path = flightrec.dump_to_file("slow", "rid-d")
+    hostprof.stop()
+    assert path is not None
+    dump = json.load(open(path))
+    hp = dump["otherData"]["hostprof"]
+    assert hp["samples"] > 0 and "top_stacks" in hp
+    # deregistration: dumps stop bundling once the knob is off
+    hostprof.configure(dump_on_slow_query=False)
+    flightrec.configure(dump_min_interval_s=0.0)
+    path2 = flightrec.dump_to_file("slow", "rid-d")
+    assert "hostprof" not in json.load(open(path2))["otherData"]
+
+
+def test_live_hz_change_repaces_running_sampler():
+    """start() on a running sampler with a new hz must actually change
+    the sampling rate (the loop re-reads the configured hz each tick)
+    — snapshot() must never report a rate the sampler isn't running."""
+    hostprof.configure(hz=20)
+    hostprof.start()
+    time.sleep(0.15)
+    assert hostprof.start(hz_override=400) is True     # still running
+    assert hostprof.hz() == 400.0
+    before = hostprof.counters()["ticks"]
+    time.sleep(0.25)
+    gained = hostprof.counters()["ticks"] - before
+    hostprof.stop()
+    # 0.25s at 400 Hz ≈ 100 ticks; at the old 20 Hz it would be ~5.
+    # Loose floor: even a contended box beats the old rate 5x.
+    assert gained >= 25, gained
+
+
+def test_stop_start_cycles_leave_one_sampler():
+    """Rapid stop()/start() cycling never strands a second sampler
+    thread (each sampler owns its own stop event)."""
+    for _ in range(5):
+        hostprof.configure(hz=500)
+        assert hostprof.start() is True
+        hostprof.stop()
+        hostprof.start()
+        hostprof.stop()
+    time.sleep(0.05)
+    alive = [t for t in threading.enumerate()
+             if t.name == "hostprof-sampler"]
+    assert alive == [], alive
+
+
+def test_reset_restores_defaults():
+    hostprof.configure(hz=250, max_samples=32, dump_on_slow_query=True)
+    hostprof.start()
+    time.sleep(0.05)
+    hostprof.reset()
+    assert not hostprof.armed() and not hostprof.running()
+    assert hostprof.counters()["samples"] == 0
+    assert hostprof.flamegraph() == ""
+    assert not any(t.name == "hostprof-sampler"
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# lock-contention ledger
+# ---------------------------------------------------------------------------
+
+def test_contention_ledger_wait_hold_accounting():
+    locksan.enable_contention()
+    try:
+        lk = locksan.make_lock("unit.contended_lock")
+        holder_ready = threading.Event()
+        release_now = threading.Event()
+
+        def holder():
+            with lk:
+                holder_ready.set()
+                release_now.wait(5)
+
+        t = threading.Thread(target=holder, name="unit-holder")
+        t.start()
+        assert holder_ready.wait(5)
+        t0 = time.perf_counter()
+        waiter_done = []
+
+        def waiter():
+            with lk:
+                waiter_done.append(time.perf_counter() - t0)
+
+        w = threading.Thread(target=waiter, name="unit-waiter")
+        w.start()
+        time.sleep(0.05)
+        release_now.set()
+        t.join()
+        w.join()
+        snap = locksan.contention_snapshot()["unit.contended_lock"]
+        assert snap["acquires"] == 2
+        assert snap["contended"] >= 1
+        assert snap["wait_ms"] >= 40.0
+        assert snap["hold_ms"] >= 40.0
+        assert snap["wait_ms_max"] <= snap["wait_ms"] + 1e-6
+        rendered = locksan.render_prometheus()
+        assert 'lock_wait_ms{name="unit.contended_lock"}' in rendered
+        assert 'lock_contended{name="unit.contended_lock"}' in rendered
+    finally:
+        locksan.reset_contention()
+    assert locksan.render_prometheus() == ""
+
+
+def test_contention_off_keeps_plain_counters_zero():
+    """With the ledger off (and the suite's sanitizer on), SanLocks do
+    no contention accounting and the exposition stays empty."""
+    lk = locksan.make_lock("unit.quiet_lock")
+    with lk:
+        pass
+    assert "unit.quiet_lock" not in locksan.contention_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# /debug/prof + /debug/devicetrace endpoints (standalone listener)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def standalone_http():
+    srv = MetricsHttpServer(-1)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_debug_prof_actions(standalone_http):
+    port = standalone_http.port
+    # snapshot (default): off state
+    status, body, ctype = _http_get(port, "/debug/prof")
+    assert status == 200 and ctype.startswith("application/json")
+    assert json.loads(body)["enabled"] is False
+    # start on demand — even with HostProfHz=0 configured (the
+    # "off-by-default, always-available" contract)
+    status, body, _ = _http_get(port,
+                                "/debug/prof?action=start&hz=400")
+    assert status == 200 and json.loads(body)["running"] is True
+    assert any(t.name == "hostprof-sampler"
+               for t in threading.enumerate())
+    time.sleep(0.15)
+    status, body, ctype = _http_get(port,
+                                    "/debug/prof?action=flamegraph")
+    assert status == 200 and ctype.startswith("text/plain")
+    assert re.search(r" \d+$", body, re.MULTILINE), body[:300]
+    status, body, _ = _http_get(port, "/debug/prof?action=chrome")
+    assert status == 200 and json.loads(body)["traceEvents"]
+    status, body, _ = _http_get(port, "/debug/prof?action=stop")
+    assert status == 200 and json.loads(body)["running"] == 0
+    # bad inputs answer 400, never kill the listener
+    status, body, _ = _http_get(port, "/debug/prof?action=bogus")
+    assert status == 400 and "unknown action" in body
+    status, _, _ = _http_get(port, "/debug/prof?action=start&hz=abc")
+    assert status == 400
+    status, _, _ = _http_get(port, "/debug/prof")
+    assert status == 200
+
+
+def test_debug_devicetrace_bounded(standalone_http, tmp_path):
+    port = standalone_http.port
+    logdir = str(tmp_path / "devtrace")
+    t0 = time.perf_counter()
+    status, body, _ = _http_get(
+        port, f"/debug/devicetrace?duration_ms=60&dir={logdir}")
+    took = time.perf_counter() - t0
+    assert status == 200, body
+    out = json.loads(body)
+    assert out["dir"] == logdir and out["duration_ms"] == 60.0
+    assert os.path.isdir(logdir)
+    assert took < 30.0
+    status, _, _ = _http_get(port,
+                             "/debug/devicetrace?duration_ms=nope")
+    assert status == 400
+
+
+# ---------------------------------------------------------------------------
+# the /debug route contract on both tiers
+# ---------------------------------------------------------------------------
+
+EXPECTED_ROUTES = ["/debug/admission", "/debug/devicetrace",
+                   "/debug/flight", "/debug/memory", "/debug/mutation",
+                   "/debug/prof", "/debug/quality", "/healthz",
+                   "/metrics"]
+
+
+@pytest.fixture(scope="module")
+def two_tiers():
+    """A FLAT shard server + an aggregator over it, both with metrics
+    listeners — the parameterized /debug route surface."""
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((60, 8)).astype(np.float32)
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=3))
+    ctx.add_index("main", index)
+    server = SearchServer(ctx, batch_window_ms=1.0, metrics_port=-1)
+    ts = _ServerThread(server)
+    ts.start()
+    host, port = ts.wait_ready(60)
+    agg_ctx = AggregatorContext(search_timeout_s=20.0, metrics_port=-1)
+    agg_ctx.servers = [RemoteServer(host, port)]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    tg.wait_ready(60)
+    try:
+        yield {"server": server, "aggregator": agg,
+               "data": data, "addr": (host, port)}
+    finally:
+        tg.stop()
+        ts.stop()
+
+
+def test_routes_listing_matches_contract(two_tiers):
+    assert two_tiers["server"]._metrics_http.routes() == EXPECTED_ROUTES
+    assert (two_tiers["aggregator"]._metrics_http.routes()
+            == EXPECTED_ROUTES)
+
+
+@pytest.mark.parametrize("tier", ["server", "aggregator"])
+@pytest.mark.parametrize("route", EXPECTED_ROUTES)
+def test_debug_routes_answer_with_body_and_content_type(two_tiers, tier,
+                                                        route):
+    """Every registered route on BOTH tiers answers a GET with a
+    non-empty body and its declared content-type (ISSUE 10 satellite —
+    previously /debug endpoints could die silently or mislabel)."""
+    port = two_tiers[tier]._metrics_http.port
+    path = (route + "?duration_ms=30" if route == "/debug/devicetrace"
+            else route)
+    status, body, ctype = _http_get(port, path)
+    assert status == 200, (route, status, body[:200])
+    assert body, route
+    if route == "/metrics":
+        assert ctype.startswith("text/plain; version=0.0.4")
+    else:
+        assert ctype.startswith("application/json"), (route, ctype)
+        json.loads(body)
+
+
+@pytest.mark.parametrize("tier", ["server", "aggregator"])
+def test_unknown_debug_path_is_404_with_body(two_tiers, tier):
+    port = two_tiers[tier]._metrics_http.port
+    status, body, ctype = _http_get(port, "/debug/nope")
+    assert status == 404
+    assert "not found" in body and "/debug/prof" in body
+    assert ctype.startswith("text/plain")
+
+
+def test_broken_route_answers_500_listener_survives(two_tiers):
+    """A route that raises answers 500 with a body; the listener keeps
+    serving the next scrape (one broken callback must never kill the
+    operator surface)."""
+    mh = two_tiers["server"]._metrics_http
+
+    def boom(params):
+        raise RuntimeError("deliberately broken route")
+
+    mh._routes["/debug/boom"] = boom
+    try:
+        port = mh.port
+        status, body, ctype = _http_get(port, "/debug/boom")
+        assert status == 500
+        assert "internal error" in body
+        assert ctype.startswith("text/plain")
+        status, _, _ = _http_get(port, "/metrics")
+        assert status == 200
+    finally:
+        mh._routes.pop("/debug/boom", None)
+
+
+# ---------------------------------------------------------------------------
+# thread naming (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+def test_no_anonymous_threads_with_running_tiers(two_tiers):
+    """A running server + aggregator (after real traffic and a scrape)
+    has no anonymous Thread-N threads — profiler samples, locksan
+    watchdog dumps and flight tracks must attribute every long-lived
+    thread."""
+    from sptag_tpu.serve.client import AnnClient
+
+    host, port = two_tiers["addr"]
+    client = AnnClient(host, port, timeout_s=20.0)
+    client.connect()
+    q = "|".join(str(x) for x in two_tiers["data"][1])
+    res = client.search(q)
+    assert res.status == wire.ResultStatus.Success
+    client.close()
+    _http_get(two_tiers["server"]._metrics_http.port, "/metrics")
+    anon = [t.name for t in threading.enumerate()
+            if re.fullmatch(r"Thread-\d+( \(.*\))?", t.name)]
+    assert anon == [], f"anonymous threads alive: {anon}"
+
+
+# ---------------------------------------------------------------------------
+# e2e: aggregator + 2 shards under load with the profiler on
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def beam_index():
+    """Tiny continuous-batching BKT index (the test_flightrec recipe) —
+    the e2e needs the scheduler/executor path the profiler pins."""
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((120, 8)).astype(np.float32)
+    idx = sp.create_instance("BKT", "Float")
+    for p, v in [("DistCalcMethod", "L2"), ("BKTKmeansK", "4"),
+                 ("TPTNumber", "2"), ("TPTLeafSize", "16"),
+                 ("NeighborhoodSize", "8"), ("CEF", "32"),
+                 ("RefineIterations", "0"), ("SearchMode", "beam"),
+                 ("MaxCheck", "64"), ("BeamSegmentIters", "2"),
+                 ("ContinuousBatching", "1")]:
+        assert idx.set_parameter(p, v), p
+    idx.build(data)
+    idx.search_batch(data[:1], 3)
+    yield idx, data
+    idx.close()
+
+
+def test_hostprof_e2e_aggregator_two_shards(beam_index, tmp_path):
+    """THE acceptance loop: aggregator + 2 shards under load with
+    HostProfHz>0 — the flamegraph snapshot contains serve-stage frames
+    with rid-attributed samples for a known slow query, lock_wait_ms
+    gauges appear on /metrics, and the slow-query auto-dump bundles
+    host stacks with the flight trace."""
+    idx, data = beam_index
+    dump_dir = str(tmp_path / "dumps")
+    ctx_a = ServiceContext(ServiceSettings(default_max_result=3,
+                                           lock_contention_ledger=True))
+    ctx_a.add_index("shard_a", idx)
+    ctx_b = ServiceContext(ServiceSettings(default_max_result=3))
+    ctx_b.add_index("shard_b", idx)
+    srv_a = SearchServer(ctx_a, batch_window_ms=1.0, metrics_port=-1,
+                         slow_query_threshold_ms=1e-6,
+                         flight_recorder=True, flight_dump_dir=dump_dir,
+                         flight_tier="hp_server_a",
+                         host_prof_hz=500.0,
+                         host_prof_dump_on_slow_query=True)
+    srv_b = SearchServer(ctx_b, batch_window_ms=1.0,
+                         flight_recorder=True,
+                         flight_tier="hp_server_b")
+    ta, tb = _ServerThread(srv_a), _ServerThread(srv_b)
+    ta.start()
+    tb.start()
+    (ha, pa), (hb, pb) = ta.wait_ready(60), tb.wait_ready(60)
+    agg_ctx = AggregatorContext(search_timeout_s=30.0,
+                                flight_recorder=True)
+    agg_ctx.servers = [RemoteServer(ha, pa), RemoteServer(hb, pb)]
+    agg = AggregatorService(agg_ctx)
+    tg = _ServerThread(agg)
+    tg.start()
+    hg, pg = tg.wait_ready(60)
+    mport = srv_a._metrics_http.port
+    rid = "e2e-hp-slow-0007"
+    try:
+        from sptag_tpu.serve.client import AnnClient
+
+        assert hostprof.running() and hostprof.hz() == 500.0
+        client = AnnClient(hg, pg, timeout_s=30.0)
+        client.connect()
+        # load: a burst of ordinary queries through the fan-out
+        for i in range(12):
+            q = ("$indexname:shard_a,shard_b $maxcheck:32 "
+                 + "|".join(str(x) for x in data[i]))
+            res = client.search(q, request_id="e2e-hp-load-%03d" % i)
+            assert res.status == wire.ResultStatus.Success
+        # the known slow query: a fat beam budget, sent alone so the
+        # shard executes it as a single-rid batch (exact attribution)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            q = ("$indexname:shard_a,shard_b $maxcheck:4096 "
+                 + "|".join(str(x) for x in data[40]))
+            res = client.search(q, request_id=rid)
+            assert res.status == wire.ResultStatus.Success
+            snap = json.loads(_http_get(
+                mport, "/debug/prof?action=snapshot")[1])
+            if snap["rid_samples"].get(rid):
+                break
+            time.sleep(0.05)
+        client.close()
+        snap = json.loads(_http_get(mport,
+                                    "/debug/prof?action=snapshot")[1])
+        assert snap["enabled"] and snap["running"]
+        assert snap["samples"] > 0
+        # rid-attributed samples for the known slow query
+        assert snap["rid_samples"].get(rid, 0) > 0, snap["rid_samples"]
+        # serve-stage frames in the flamegraph snapshot
+        status, fg, ctype = _http_get(mport,
+                                      "/debug/prof?action=flamegraph")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert "stage:execute;" in fg, fg[:1000]
+        stages = set(snap["stage_samples"])
+        assert "execute" in stages, stages
+        # lock-contention gauges on /metrics (LockContentionLedger on)
+        status, body, _ = _http_get(mport, "/metrics")
+        assert status == 200
+        assert "lock_wait_ms{" in body, body[-2000:]
+        assert "hostprof_samples" in body
+        # the slow-query auto-dump bundles host stacks + flight trace
+        deadline = time.time() + 15
+        bundled = None
+        while time.time() < deadline and bundled is None:
+            if os.path.isdir(dump_dir):
+                for fn in sorted(os.listdir(dump_dir)):
+                    if not fn.endswith(".json"):
+                        continue
+                    dump = json.load(open(os.path.join(dump_dir, fn)))
+                    if "hostprof" in dump.get("otherData", {}):
+                        bundled = dump
+                        break
+            time.sleep(0.1)
+        assert bundled is not None, "no auto-dump bundled host stacks"
+        assert bundled["otherData"]["hostprof"]["samples"] >= 0
+        assert "top_stacks" in bundled["otherData"]["hostprof"]
+        assert bundled["flightEvents"], "flight trace missing from dump"
+    finally:
+        tg.stop()
+        tb.stop()
+        ta.stop()
+
+
+# ---------------------------------------------------------------------------
+# HostProfHz=0 (default): byte parity + sampler never started
+# ---------------------------------------------------------------------------
+
+def test_hostprof_off_parity_serve_bytes_and_no_sampler():
+    """With every ISSUE 10 knob at its default, the serve path produces
+    byte-identical wire responses to the reference layout and the
+    sampler thread is never started (the ci_check.sh standalone parity
+    pass)."""
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((50, 8)).astype(np.float32)
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("main", index)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        assert not hostprof.armed()
+        qtext = "|".join(str(x) for x in data[7])
+        expected_result = SearchExecutor(ctx).execute(qtext)
+        expected_result.request_id = ""
+        expected_body = expected_result.pack()
+        expected = wire.PacketHeader(
+            wire.PacketType.SearchResponse, wire.PacketProcessStatus.Ok,
+            len(expected_body), 1, 77).pack() + expected_body
+
+        body = wire.RemoteQuery(qtext).pack()
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, 77).pack() + body)
+        s.settimeout(10)
+        got = b""
+        while len(got) < len(expected):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        s.close()
+        assert got == expected
+        c = hostprof.counters()
+        assert c == {"enabled": 0, "running": 0, "samples": 0,
+                     "ticks": 0, "overruns": 0, "distinct_stacks": 0,
+                     "folded_overflow": 0}
+        assert not any(th.name == "hostprof-sampler"
+                       for th in threading.enumerate())
+    finally:
+        t.stop()

@@ -587,6 +587,87 @@ def test_issue8_overload_defense_names_are_literals():
     assert found == [], "\n".join(f.format() for f in found)
 
 
+def test_gl607_dynamic_stage_flagged():
+    """Host-profiler stage names are cardinality-bounded (ISSUE 10):
+    the folded-stack aggregate injects a synthetic stage frame per
+    sample and never expires one — f-strings, concatenation and
+    per-call variables are flagged like the rest of the GL6xx family,
+    for both set_stage and the context-manager form."""
+    src = (
+        "from sptag_tpu.utils import hostprof\n"
+        "def pin(phase, rid):\n"
+        "    hostprof.set_stage(f'stage_{phase}', rid)\n"
+        "def pin2(phase):\n"
+        "    with hostprof.stage('pre_' + phase):\n"
+        "        pass\n"
+    )
+    found = lint_one(src, select=["GL607"])
+    assert rules_of(found) == ["GL607"]
+    assert len(found) == 2
+    assert "string literal" in found[0].message
+
+
+def test_gl607_literal_stage_and_dynamic_rid_clean():
+    """Literal / module-constant stages pass; the rid argument is out
+    of scope (bounded LRU by design), as are keyword and from-import
+    forms with literals."""
+    src = (
+        "from sptag_tpu.utils import hostprof\n"
+        "from sptag_tpu.utils.hostprof import set_stage\n"
+        "STAGE = 'execute'\n"
+        "def pin(rid):\n"
+        "    hostprof.set_stage('decode', rid)\n"
+        "    hostprof.set_stage(STAGE, rid)\n"
+        "    set_stage(stage='encode', rid=rid)\n"
+        "    with hostprof.stage('merge', rid):\n"
+        "        pass\n"
+    )
+    assert lint_one(src, select=["GL607"]) == []
+    dirty = (
+        "from sptag_tpu.utils.hostprof import set_stage\n"
+        "def pin(name):\n"
+        "    set_stage(name)\n"
+    )
+    assert rules_of(lint_one(dirty, select=["GL607"])) == ["GL607"]
+
+
+def test_gl607_out_of_family_hostprof_calls_clean():
+    """Only set_stage/stage carry stage names; clear_stage, start,
+    configure and unrelated modules binding `hostprof` stay out of
+    scope."""
+    src = (
+        "from sptag_tpu.utils import hostprof\n"
+        "import contextlib as hostprof2\n"
+        "def lifecycle(hz, why):\n"
+        "    hostprof.configure(hz=hz)\n"
+        "    hostprof.start(hz)\n"
+        "    hostprof.clear_stage()\n"
+        "    hostprof2.suppress(why)\n"
+    )
+    assert lint_one(src, select=["GL607"]) == []
+
+
+def test_issue10_hostprof_wiring_names_are_literals():
+    """ISSUE 10 CI satellite: GL601/602/603/607 coverage extends to the
+    profiler module and every serve/scheduler file it wired into, with
+    NO new baseline entries (the files lint clean with no baseline
+    applied at all)."""
+    paths = [
+        "sptag_tpu/utils/hostprof.py",
+        "sptag_tpu/serve/metrics_http.py",
+        "sptag_tpu/serve/server.py",
+        "sptag_tpu/serve/aggregator.py",
+        "sptag_tpu/algo/scheduler.py",
+    ]
+    srcs = {}
+    for p in paths:
+        with open(os.path.join(REPO, p), encoding="utf-8") as fh:
+            srcs[p] = fh.read()
+    found = lint_sources(srcs, select=["GL601", "GL602", "GL603",
+                                       "GL607"])
+    assert found == [], "\n".join(f.format() for f in found)
+
+
 def test_gl606_out_of_family_qualmon_calls_clean():
     """Only gauge/inc carry names; record_sample's mode/shard labels,
     note_health's shard, and unrelated modules binding `qualmon` stay

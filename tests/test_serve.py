@@ -128,7 +128,10 @@ class _ServerThread(threading.Thread):
     background thread with its own loop."""
 
     def __init__(self, server):
-        super().__init__(daemon=True)
+        # named like the production threads: the no-anonymous-threads
+        # contract (tests/test_hostprof.py) enumerates every live thread
+        super().__init__(daemon=True,
+                         name=f"test-loop-{type(server).__name__}")
         self.server = server
         self.addr = None
         self.loop = None

@@ -1,0 +1,276 @@
+"""benchdiff — the noise-aware perf-regression sentinel (ISSUE 10).
+
+Five BENCH_r*.json snapshots sit in the repo root and until now nothing
+machine-checked that a PR didn't regress QPS-at-SLO or %-of-peak — the
+perf trajectory was tracked by hope.  This tool compares the CURRENT
+bench artifact against a PINNED BASELINE artifact and exits nonzero with
+a readable table when a watched metric regressed:
+
+    python -m tools.benchdiff BENCH_r05.json BENCH_current.json
+    python -m tools.benchdiff --json baseline.json current.json
+
+Design decisions, in order of importance:
+
+* **Noise-aware**: a metric regresses only when the relative change
+  exceeds its threshold AND the absolute change exceeds its min-delta
+  floor.  Bench numbers on a contended CI host jitter by several
+  percent; the floors keep a 3-QPS wiggle on a 20-QPS beam stage from
+  crying wolf, the relative thresholds keep a 500-QPS drop on a
+  15k-QPS dense stage from hiding inside them.
+* **Platform-gated**: an artifact measured on ``cpu`` is NOT comparable
+  to one measured on ``tpu`` — throughput metrics are skipped with a
+  visible note (recall and result-quality metrics still diff; the
+  algorithm is platform-independent).
+* **Schema-versioned**: artifacts stamp ``schema_version`` (bench.py);
+  the sentinel diffs the INTERSECTION of watched keys present in both
+  artifacts and prints both versions, so a baseline from an older
+  schema degrades to fewer checks, never to a false alarm.
+* **Direction-aware**: QPS/recall/%-of-peak regress DOWN, latency
+  regresses UP; improvements are reported but never fail the gate.
+
+Driver-wrapped artifacts (``{"parsed": {...}}``) unwrap automatically —
+the same convention as tools/perf_report.py.  Exit codes: 0 pass,
+1 regression, 2 usage/load error.  Wired into tools/ci_check.sh as a
+self-test (identical artifacts must pass; a doctored −20 % loadgen p99
+must fail); the intended PR gate is
+``python -m tools.benchdiff BENCH_r<pinned>.json <fresh bench output>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: artifact schema this sentinel was written against (bench.py stamps
+#: the same constant into new artifacts)
+SCHEMA_VERSION = 1
+
+HIGHER = "higher"     # regression = value went DOWN
+LOWER = "lower"       # regression = value went UP
+
+
+class Metric:
+    """One watched key: dotted path, direction, relative threshold and
+    absolute min-delta floor (both must be exceeded to flag), and
+    whether the number depends on the measuring platform."""
+
+    __slots__ = ("path", "direction", "rel", "floor", "platform_bound")
+
+    def __init__(self, path: str, direction: str, rel: float,
+                 floor: float, platform_bound: bool = True):
+        self.path = path
+        self.direction = direction
+        self.rel = rel
+        self.floor = floor
+        self.platform_bound = platform_bound
+
+
+#: the watched surface — per-stage throughput, latency, recall and
+#: roofline %-of-peak.  Thresholds are deliberately loose (the bench
+#: harness is single-run, not a statistics engine); tighten per-metric
+#: as history accumulates rather than globally.
+METRICS: List[Metric] = [
+    # headline + per-stage throughput
+    Metric("value", HIGHER, 0.15, 50.0),
+    Metric("flat_qps", HIGHER, 0.15, 25.0),
+    Metric("int8_qps", HIGHER, 0.15, 25.0),
+    Metric("kdt_cosine_qps", HIGHER, 0.20, 10.0),
+    Metric("kdt_dense_qps", HIGHER, 0.20, 25.0),
+    Metric("beam_qps", HIGHER, 0.20, 2.0),
+    # latency (lower is better)
+    Metric("p50_batch_ms", LOWER, 0.20, 20.0),
+    Metric("p99_batch_ms", LOWER, 0.20, 30.0),
+    # result quality (platform-independent: the algorithm answered
+    # worse, whatever measured it)
+    Metric("recall_at_10", HIGHER, 0.01, 0.005, platform_bound=False),
+    Metric("int8_recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
+    Metric("beam_recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
+    Metric("kdt_cosine_recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
+    # open-loop serving capacity + tail (ISSUE 8's loadgen stage)
+    Metric("loadgen.qps_at_slo", HIGHER, 0.20, 16.0),
+    Metric("loadgen.p50_ms", LOWER, 0.20, 5.0),
+    Metric("loadgen.p99_ms", LOWER, 0.20, 10.0),
+    # mutation-under-load stage (ISSUE 9)
+    Metric("mutate.read_qps", HIGHER, 0.20, 25.0),
+    Metric("mutate.p99_steady_ms", LOWER, 0.25, 10.0),
+    # roofline %-of-peak per kernel family (ISSUE 6's ledger rows):
+    # regressing the fraction of peak is the canary that a "faster in
+    # QPS" change actually left device efficiency on the floor
+    Metric("roofline.rows.flat.pct_peak", HIGHER, 0.20, 2.0),
+    Metric("roofline.rows.dense.pct_peak", HIGHER, 0.20, 2.0),
+    Metric("roofline.rows.beam.pct_peak", HIGHER, 0.20, 2.0),
+    Metric("roofline.rows.int8.pct_peak", HIGHER, 0.20, 2.0),
+]
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    """Load one bench artifact, unwrapping the driver envelope
+    (``{"parsed": {...}}``) like tools/perf_report.py does."""
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict):
+        raise ValueError(f"{path}: artifact is not a JSON object")
+    if isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]
+    return obj
+
+
+def resolve(obj: Dict[str, Any], dotted: str) -> Optional[float]:
+    """Walk a dotted path; returns a float or None when any hop is
+    missing/None/non-numeric (missing keys are SKIPPED, not failed —
+    stages are budget-gated and may legitimately be absent)."""
+    cur: Any = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+class Verdict:
+    __slots__ = ("metric", "base", "cur", "delta_pct", "status", "note")
+
+    def __init__(self, metric: Metric, base: float, cur: float,
+                 status: str, note: str = ""):
+        self.metric = metric
+        self.base = base
+        self.cur = cur
+        self.delta_pct = ((cur - base) / abs(base) * 100.0
+                          if base else float("inf") if cur else 0.0)
+        self.status = status
+        self.note = note
+
+
+def judge(metric: Metric, base: float, cur: float) -> Verdict:
+    delta = cur - base
+    worse = -delta if metric.direction == HIGHER else delta
+    rel = worse / abs(base) if base else (1.0 if worse > 0 else 0.0)
+    # inclusive comparisons: a change AT the threshold counts — "a 20%
+    # p99 regression fails a 20% gate" reads as operators expect
+    if worse > 0 and rel >= metric.rel and worse >= metric.floor:
+        return Verdict(metric, base, cur, "REGRESSED",
+                       f"worse by {rel * 100.0:.1f}% "
+                       f"(> {metric.rel * 100.0:.0f}% and "
+                       f"> {metric.floor:g} abs)")
+    if worse > 0:
+        return Verdict(metric, base, cur, "ok",
+                       "within noise thresholds")
+    if worse < 0 and rel < -metric.rel and -worse > metric.floor:
+        return Verdict(metric, base, cur, "improved", "")
+    return Verdict(metric, base, cur, "ok", "")
+
+
+def diff(baseline: Dict[str, Any], current: Dict[str, Any]
+         ) -> Tuple[List[Verdict], List[str]]:
+    """Judge every watched metric present in BOTH artifacts; returns
+    (verdicts, notes).  Platform-bound metrics are skipped with a note
+    when the two artifacts were measured on different backends."""
+    notes: List[str] = []
+    base_platform = baseline.get("platform", "")
+    cur_platform = current.get("platform", "")
+    platforms_differ = (base_platform and cur_platform
+                        and base_platform != cur_platform)
+    if platforms_differ:
+        notes.append(
+            f"platform mismatch (baseline={base_platform!r}, "
+            f"current={cur_platform!r}): throughput/latency/roofline "
+            "metrics skipped, quality metrics still checked")
+    sv_base = baseline.get("schema_version", 0)
+    sv_cur = current.get("schema_version", 0)
+    if sv_base != sv_cur:
+        notes.append(f"schema_version differs (baseline={sv_base}, "
+                     f"current={sv_cur}): diffing shared keys only")
+    verdicts: List[Verdict] = []
+    for m in METRICS:
+        if platforms_differ and m.platform_bound:
+            continue
+        base_v = resolve(baseline, m.path)
+        cur_v = resolve(current, m.path)
+        if base_v is None or cur_v is None:
+            continue
+        verdicts.append(judge(m, base_v, cur_v))
+    if not verdicts:
+        notes.append("no watched metric present in both artifacts — "
+                     "nothing was checked")
+    return verdicts, notes
+
+
+def render_table(verdicts: List[Verdict], notes: List[str],
+                 baseline_path: str, current_path: str,
+                 show_all: bool = False) -> str:
+    lines = [f"benchdiff: {current_path} vs baseline {baseline_path}"]
+    for n in notes:
+        lines.append(f"  note: {n}")
+    rows = [v for v in verdicts
+            if show_all or v.status in ("REGRESSED", "improved")]
+    if not rows and verdicts:
+        lines.append(f"  {len(verdicts)} metric(s) checked, all within "
+                     "thresholds")
+    if rows:
+        header = (f"  {'metric':<34} {'baseline':>12} {'current':>12} "
+                  f"{'Δ%':>8}  status")
+        lines.append(header)
+        lines.append("  " + "-" * (len(header) - 2))
+        for v in rows:
+            lines.append(
+                f"  {v.metric.path:<34} {v.base:>12.3f} {v.cur:>12.3f} "
+                f"{v.delta_pct:>+8.1f}  {v.status}"
+                + (f" — {v.note}" if v.note and v.status == "REGRESSED"
+                   else ""))
+    regressed = [v for v in verdicts if v.status == "REGRESSED"]
+    lines.append(
+        f"  verdict: {'FAIL — ' + str(len(regressed)) + ' regression(s)' if regressed else 'PASS'}"
+        f" ({len(verdicts)} checked)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.benchdiff",
+        description="Compare a bench artifact against a pinned baseline "
+                    "and fail on perf regressions.")
+    parser.add_argument("baseline", help="pinned baseline artifact "
+                        "(e.g. BENCH_r05.json)")
+    parser.add_argument("current", help="freshly produced artifact")
+    parser.add_argument("--json", action="store_true",
+                        help="emit machine-readable verdicts instead of "
+                        "the table")
+    parser.add_argument("--show-all", action="store_true",
+                        help="print every checked metric, not only "
+                        "regressions/improvements")
+    args = parser.parse_args(argv)
+    try:
+        baseline = load_artifact(args.baseline)
+        current = load_artifact(args.current)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"benchdiff: cannot load artifacts: {e}", file=sys.stderr)
+        return 2
+    verdicts, notes = diff(baseline, current)
+    if args.json:
+        print(json.dumps({
+            "baseline": args.baseline, "current": args.current,
+            "schema_version": SCHEMA_VERSION,
+            "notes": notes,
+            "verdicts": [
+                {"metric": v.metric.path, "baseline": v.base,
+                 "current": v.cur,
+                 "delta_pct": round(v.delta_pct, 3),
+                 "status": v.status, "note": v.note}
+                for v in verdicts],
+            "pass": not any(v.status == "REGRESSED" for v in verdicts),
+        }, indent=2))
+    else:
+        print(render_table(verdicts, notes, args.baseline, args.current,
+                           show_all=args.show_all))
+    return 1 if any(v.status == "REGRESSED" for v in verdicts) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -84,6 +84,42 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_mutation.py -q \
 echo "== GL411 persistence-path lint (standalone) =="
 python -m tools.graftlint sptag_tpu/ --select GL411
 
+# the ISSUE 10 observability gate, standalone: with HostProfHz=0 (the
+# default) the serve tier's wire bytes stay byte-identical, the sampler
+# thread is never started and the stage pins are one flag test
+echo "== host profiler off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_hostprof.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 10 regression sentinel, self-tested: identical artifacts
+# pass; a doctored 20% loadgen-p99 regression fails with a table naming
+# the regressed metric — if this breaks, the perf gate is asleep
+echo "== benchdiff self-test (identity + doctored regression) =="
+python -m tools.benchdiff BENCH_r05.json BENCH_r05.json
+python - <<'PYEOF'
+import copy, json, os, subprocess, sys, tempfile
+base = json.load(open("BENCH_r05.json"))
+cur = copy.deepcopy(base)
+broot = base["parsed"] if isinstance(base.get("parsed"), dict) else base
+croot = cur["parsed"] if isinstance(cur.get("parsed"), dict) else cur
+broot["loadgen"] = {"qps_at_slo": 512.0, "p50_ms": 20.0, "p99_ms": 100.0}
+croot["loadgen"] = {"qps_at_slo": 512.0, "p50_ms": 20.0, "p99_ms": 120.0}
+d = tempfile.mkdtemp()
+bp, cp = os.path.join(d, "b.json"), os.path.join(d, "c.json")
+json.dump(base, open(bp, "w")); json.dump(cur, open(cp, "w"))
+r = subprocess.run([sys.executable, "-m", "tools.benchdiff", bp, cp],
+                   capture_output=True, text=True)
+assert r.returncode == 1, \
+    f"doctored regression must exit nonzero: rc={r.returncode}\n{r.stdout}"
+assert "loadgen.p99_ms" in r.stdout and "REGRESSED" in r.stdout, r.stdout
+print("benchdiff self-test OK (doctored -20% p99 headroom fails)")
+PYEOF
+
+# the ISSUE 10 lint gate, standalone: host-profiler stage names are
+# string literals (GL607, the GL6xx cardinality family)
+echo "== GL607 hostprof-stage lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL607
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

@@ -30,6 +30,14 @@ Rules:
   one.  The `mode`/`shard` LABELS are out of scope — they are bounded
   by deployment (search modes are an enum, shards come from the
   service config), exactly like flightrec's tier argument.
+* GL607 — the stage argument of a host-profiler pin
+  (`hostprof.set_stage(stage, ...)` / `hostprof.stage(stage, ...)`) is
+  not a string literal or module-level string constant: the folded-
+  stack aggregate injects a synthetic ``stage:<name>`` frame per
+  sample and the per-stage counters never expire a name, so stages
+  are a bounded taxonomy (decode/queue/execute/encode/merge) by the
+  same cardinality argument.  The `rid` argument is out of scope —
+  rid attribution is a bounded LRU by design.
 
 Calls are resolved through import aliases (`from sptag_tpu.utils import
 trace` / `import sptag_tpu.utils.metrics as metrics` / from-imports of the
@@ -54,23 +62,28 @@ RULES = {
              "dynamic kinds make the event taxonomy unbounded",
     "GL606": "quality-monitor series name is not a string literal — "
              "dynamic names make the quality exposition unbounded",
+    "GL607": "host-profiler stage name is not a string literal — "
+             "dynamic stages make the folded-stack taxonomy unbounded",
 }
 
 _TRACE_MODULE = "sptag_tpu.utils.trace"
 _METRICS_MODULE = "sptag_tpu.utils.metrics"
 _FLIGHT_MODULE = "sptag_tpu.utils.flightrec"
 _QUALMON_MODULE = "sptag_tpu.utils.qualmon"
+_HOSTPROF_MODULE = "sptag_tpu.utils.hostprof"
 
 _TRACE_FNS = {"span", "record"}
 _METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
                 "observe", "counter_value", "histogram_or_none"}
 _FLIGHT_FNS = {"record", "span"}
 _QUALMON_FNS = {"gauge", "inc"}
+_HOSTPROF_FNS = {"set_stage", "stage"}
 
 #: per-rule (positional index, keyword name) of the argument that must
 #: be a bounded string — GL60x's lint surface
 _NAME_ARG = {"GL601": (0, "name"), "GL602": (0, "name"),
-             "GL603": (1, "kind"), "GL606": (0, "name")}
+             "GL603": (1, "kind"), "GL606": (0, "name"),
+             "GL607": (0, "stage")}
 
 
 def _module_str_constants(mod: ModuleInfo) -> Set[str]:
@@ -101,6 +114,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL603"
         if full == _QUALMON_MODULE and func.attr in _QUALMON_FNS:
             return "GL606"
+        if full == _HOSTPROF_MODULE and func.attr in _HOSTPROF_FNS:
+            return "GL607"
         return None
     if isinstance(func, ast.Name):
         target = mod.from_imports.get(func.id, "")
@@ -113,6 +128,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL603"
         if modpath == _QUALMON_MODULE and sym in _QUALMON_FNS:
             return "GL606"
+        if modpath == _HOSTPROF_MODULE and sym in _HOSTPROF_FNS:
+            return "GL607"
     return None
 
 
@@ -167,7 +184,8 @@ def _check_module(mod: ModuleInfo) -> List[Finding]:
         if arg is None or _is_bounded(arg, constants):
             continue
         fn_name = _dotted(node.func) or "<call>"
-        what = "kind" if rule == "GL603" else "name"
+        what = ("kind" if rule == "GL603"
+                else "stage" if rule == "GL607" else "name")
         out.append(Finding(
             rule, mod.relpath, node.lineno,
             f"`{fn_name}` {what} is {_describe(arg)} — use a string "
