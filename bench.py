@@ -1051,6 +1051,21 @@ def run_bench():
                 result["mutate_error"] = repr(e)[:300]
             checkpoint()
 
+        # in-mesh sharded serving stage (ISSUE 11): socket fan-out
+        # aggregator vs one-dispatch mesh serve over IDENTICAL same-host
+        # shards — QPS + p99 per path, recall@10, id-parity verdict.
+        # Subprocess with a forced 8-device CPU host mesh (the parent's
+        # backend may be single-device); tools/benchdiff.py holds the
+        # inmesh_qps / speedup / recall lines.
+        sb_mesh = _stage_budget(result, "mesh_serve", budget_s,
+                                180.0, 60.0)
+        if sb_mesh is not None:
+            try:
+                result["mesh_serve"] = _mesh_serve_measure(sb_mesh)
+            except Exception as e:                       # noqa: BLE001
+                result["mesh_serve_error"] = repr(e)[:300]
+            checkpoint()
+
         # host-span tracing report (utils/trace.py) — where the wall time
         # went, for the judge and for regression diffing.  The FULL report
         # (count/total/max plus registry-derived p50/p90/p99, including
@@ -1391,6 +1406,271 @@ def _loadgen_measure(index, queries, k, budget_s):
         th.join(timeout=10)
         loop.close()
     return out
+
+
+def _mesh_serve_measure(budget_s):
+    """In-mesh sharded serving stage (ISSUE 11): same-host shards served
+    two ways over identical shard contents — (a) the socket fan-out
+    aggregator over one SearchServer per shard with a host-side merge
+    (the reference topology), (b) ONE SearchServer over the mesh index
+    with [Service] MeshServe semantics (shard-local walk + ICI top-k
+    merge in one compiled dispatch, responses streaming from the
+    mesh-wide slot scheduler).  Reports QPS + p99 per path, recall@10,
+    and the id-parity verdict.
+
+    Runs in a SUBPROCESS because the mesh needs
+    ``XLA_FLAGS=--xla_force_host_platform_device_count`` set BEFORE jax
+    initializes — the parent may already hold a single-device backend."""
+    remaining = max(30.0, budget_s - (time.time() - _t_start))
+    env = dict(os.environ,
+               BENCH_MESH_CHILD="1",
+               BENCH_MESH_BUDGET_S=str(remaining - 15.0),
+               JAX_PLATFORMS="cpu",
+               SPTAG_TPU_PLATFORM="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip())
+    env.pop("BENCH_CHILD", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=remaining)
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {"error": "mesh child produced no JSON",
+            "rc": proc.returncode,
+            "stderr": proc.stderr[-500:]}
+
+
+def _mesh_serve_child():
+    """Child half of the mesh_serve stage (BENCH_MESH_CHILD=1): builds a
+    small 8-shard mesh index on the forced CPU host mesh, serves it both
+    ways, and prints one JSON line."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from sptag_tpu.core.index import load_index
+    from sptag_tpu.core.types import DistCalcMethod
+    from sptag_tpu.parallel.sharded import (
+        ServingAdapter, ShardedBKTIndex, make_mesh)
+    from sptag_tpu.serve.aggregator import (
+        AggregatorContext, AggregatorService, RemoteServer)
+    from sptag_tpu.serve.client import PipelinedAnnClient
+    from sptag_tpu.serve.server import SearchServer
+    from sptag_tpu.serve.service import ServiceContext, ServiceSettings
+
+    budget_s = float(os.environ.get("BENCH_MESH_BUDGET_S", "180"))
+    t0 = time.time()
+    n_shards = min(8, len(jax.devices()))
+    n, d, k, mc = 4096, 64, 10, 256
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    queries = rng.standard_normal((192, d)).astype(np.float32)
+    # SearchMode=beam pins the fan-out servers to the SAME engine family
+    # the mesh path runs — the single-chip default (dense) would compare
+    # different algorithms, not different serving topologies
+    params = {"BKTNumber": 1, "BKTKmeansK": 8, "TPTNumber": 2,
+              "TPTLeafSize": 64, "NeighborhoodSize": 8, "CEF": 24,
+              "MaxCheckForRefineGraph": 128, "RefineIterations": 1,
+              "MaxCheck": mc, "SearchMode": "beam"}
+    folder = tempfile.mkdtemp(prefix="mesh_bench_")
+    import atexit
+    import shutil
+
+    # the child is the only consumer: repeat bench runs must not pile
+    # shard folders into TMPDIR (exit-time, so every early return and
+    # exception path is covered)
+    atexit.register(shutil.rmtree, folder, ignore_errors=True)
+    mesh_index = ShardedBKTIndex.build(
+        data, DistCalcMethod.L2, mesh=make_mesh(jax.devices()[:n_shards]),
+        params=params, save_to=folder)
+    out = {"shards": n_shards, "n": n, "d": d, "k": k, "max_check": mc,
+           "build_s": round(time.time() - t0, 1)}
+
+    import asyncio
+
+    class _Srv(threading.Thread):
+        def __init__(self, server, tag):
+            super().__init__(daemon=True, name=f"bench-mesh-{tag}")
+            self.server, self.addr = server, None
+            self._ready = threading.Event()
+
+        def run(self):
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+
+            async def boot():
+                self.addr = await self.server.start("127.0.0.1", 0)
+                self._ready.set()
+
+            self._boot_task = self.loop.create_task(boot())
+            self.loop.run_forever()
+
+        def wait_ready(self):
+            assert self._ready.wait(60)
+            return self.addr
+
+        def halt(self):
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.server.stop(), self.loop).result(timeout=5)
+            except Exception:                            # noqa: BLE001
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.join(timeout=5)
+
+    import base64
+
+    def _qtext(row):
+        qb = base64.b64encode(queries[row].tobytes()).decode()
+        return f"$resultnum:{k} $maxcheck:{mc} #{qb}"
+
+    def _measure(host, port, seconds, workers=8, warmup_s=3.0):
+        """Closed-loop QPS + latency percentiles: `workers` threads over
+        one pipelined connection, round-robin queries.  The warmup
+        window (discarded) pays the concurrency-bucket compiles so the
+        measured p99 is steady-state, not XLA's."""
+        client = PipelinedAnnClient(host, port, timeout_s=30.0)
+        client.connect()
+        state = {"stop_at": time.time() + warmup_s, "record": False,
+                 "errors": 0}
+        lat, lock = [], threading.Lock()
+
+        def worker(wid):
+            i = wid
+            while time.time() < state["stop_at"]:
+                row = i % len(queries)
+                i += workers
+                t1 = time.perf_counter()
+                try:
+                    res = client.search(_qtext(row))
+                    ok = res is not None and not getattr(
+                        res, "timed_out", False)
+                except Exception:                        # noqa: BLE001
+                    ok = False
+                dt = time.perf_counter() - t1
+                # failures are COUNTED, never silent: a dead worker or
+                # dropped replies would otherwise deflate one path's QPS
+                # and skew the speedup verdict with no trace in the JSON
+                with lock:
+                    if not ok:
+                        state["errors"] += 1
+                    elif state["record"]:
+                        lat.append(dt)
+
+        def run_phase():
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        daemon=True,
+                                        name=f"bench-mesh-load-{w}")
+                       for w in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=seconds + warmup_s + 60)
+
+        run_phase()                                       # warmup
+        state["record"] = True
+        # warmup failures (cold-compile timeouts are exactly what the
+        # warmup absorbs) must not pollute the measured window's count
+        state["errors"] = 0
+        state["stop_at"] = time.time() + seconds
+        t1 = time.time()
+        run_phase()                                       # measured
+        wall = time.time() - t1
+        client.close()
+        lat.sort()
+        return {
+            "qps": round(len(lat) / max(wall, 1e-9), 1),
+            "requests": len(lat),
+            "errors": state["errors"],
+            "p50_ms": round(lat[len(lat) // 2] * 1000, 2) if lat else 0,
+            "p99_ms": round(lat[int(len(lat) * 0.99)] * 1000, 2)
+            if lat else 0,
+        }
+
+    def _sample_ids(host, port, rows):
+        """Sequential sample of merged top-k ids per path (single
+        in-flight request -> (1, D) dispatch shapes on both paths)."""
+        client = PipelinedAnnClient(host, port, timeout_s=30.0)
+        client.connect()
+        got = []
+        for row in rows:
+            res = client.search(_qtext(row))
+            cand = []
+            for r in res.results:
+                shard = int(r.index_name[1:]) if r.index_name[0] == "s" \
+                    else 0
+                for vid, dist in zip(r.ids, r.dists):
+                    if vid >= 0:
+                        cand.append(
+                            (float(dist),
+                             shard * mesh_index.n_local + int(vid)
+                             if r.index_name[0] == "s" else int(vid)))
+            cand.sort(key=lambda t: t[0])
+            got.append([g for _, g in cand[:k]])
+        client.close()
+        return got
+
+    seconds = max(5.0, min(15.0, (budget_s - (time.time() - t0)) / 4))
+    sample_rows = list(range(24))
+
+    # ---- (a) socket fan-out: one server per shard + aggregator ----------
+    shard_srvs = []
+    for s in range(n_shards):
+        ctx = ServiceContext(ServiceSettings(default_max_result=k))
+        ctx.add_index(f"s{s}",
+                      load_index(os.path.join(folder, f"shard_{s:03d}")))
+        t = _Srv(SearchServer(ctx, batch_window_ms=2.0), f"shard{s}")
+        t.start()
+        shard_srvs.append(t)
+    backends = [t.wait_ready() for t in shard_srvs]
+    agg_ctx = AggregatorContext(search_timeout_s=30.0)
+    agg_ctx.servers = [RemoteServer(h, p) for h, p in backends]
+    agg = _Srv(AggregatorService(agg_ctx), "agg")
+    agg.start()
+    ha, pa = agg.wait_ready()
+    _sample_ids(ha, pa, [0])                 # warm every shard's engine
+    fanout_ids = _sample_ids(ha, pa, sample_rows)
+    out["fanout"] = _measure(ha, pa, seconds)
+    agg.halt()
+    for t in shard_srvs:
+        t.halt()
+
+    # ---- (b) in-mesh: one server, one compiled dispatch -----------------
+    ctx = ServiceContext(ServiceSettings(default_max_result=k,
+                                         mesh_serve=True))
+    ctx.add_index("mesh",
+                  ServingAdapter(mesh_index, feature_dim=d))
+    srv = _Srv(SearchServer(ctx, batch_window_ms=2.0), "inmesh")
+    srv.start()
+    hm, pm = srv.wait_ready()
+    _sample_ids(hm, pm, [0])                 # warm the mesh kernels
+    inmesh_ids = _sample_ids(hm, pm, sample_rows)
+    out["inmesh"] = _measure(hm, pm, seconds)
+    srv.halt()
+
+    # ---- parity + recall ------------------------------------------------
+    out["ids_identical"] = fanout_ids == inmesh_ids
+    truth = l2_truth(data, queries[sample_rows], k)
+    pad = [ids + [-1] * (k - len(ids)) for ids in fanout_ids]
+    r_f = recall_at_k(np.asarray(pad), truth, k)
+    pad = [ids + [-1] * (k - len(ids)) for ids in inmesh_ids]
+    r_m = recall_at_k(np.asarray(pad), truth, k)
+    out["fanout_recall_at_10"] = round(float(r_f), 4)
+    out["recall_at_10"] = round(float(r_m), 4)
+    out["fanout_qps"] = out["fanout"]["qps"]
+    out["inmesh_qps"] = out["inmesh"]["qps"]
+    out["inmesh_p99_ms"] = out["inmesh"]["p99_ms"]
+    out["speedup"] = round(out["inmesh_qps"]
+                           / max(out["fanout_qps"], 1e-9), 2)
+    out["total_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out), flush=True)
 
 
 def _mutate_measure(index, queries, k, budget_s, write_frac=0.05):
@@ -1792,6 +2072,12 @@ def main():
     local) so the round always ends with a measured JSON line — and the
     worst case (probes + TPU child + CPU child + margin) fits inside the
     envelope by construction."""
+    if os.environ.get("BENCH_MESH_CHILD") == "1":
+        # mesh_serve stage child (ISSUE 11): checked BEFORE BENCH_CHILD
+        # — the mesh child is spawned FROM the bench child and must not
+        # recurse into a full run
+        _mesh_serve_child()
+        return
     if os.environ.get("BENCH_CHILD") == "1":
         run_bench()
         return

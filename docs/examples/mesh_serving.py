@@ -47,7 +47,12 @@ def main():
                 "MaxCheck": 1024},
         metadata=MetadataSet(b"doc-%05d" % i for i in range(n)))
 
-    ctx = ServiceContext(ServiceSettings(default_max_result=10))
+    # MeshServe (DESIGN.md §17): the server arms the mesh-wide
+    # continuous-batching spine at start — responses stream from the
+    # shard-spanning slot scheduler in retire order.  Drop the flag for
+    # synchronous whole-batch serving (byte-identical wire responses).
+    ctx = ServiceContext(ServiceSettings(default_max_result=10,
+                                         mesh_serve=True))
     ctx.indexes["mesh"] = ServingAdapter(index, feature_dim=d)
     server = SearchServer(ctx, batch_window_ms=2.0)
 

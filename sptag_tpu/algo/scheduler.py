@@ -153,12 +153,15 @@ class _SlotPool:
 
     def _blank_rows(self, idx) -> None:
         """Reset slots `idx` to the canonical empty-row encoding: t_limit=0
-        (never alive — the segment kernel's no-op row), -1/MAX_DIST pools."""
+        (never alive — the segment kernel's no-op row), -1/MAX_DIST pools.
+        The `...` in the expanded dump-slot write covers both state
+        layouts: (cap, L+1) single-chip and (cap, n_shards, L+1) mesh
+        (parallel/mesh_engine.py — one slot row spans every shard)."""
         s = self.state
         s["cand_ids"][idx] = -1
         s["cand_d"][idx] = MAX_DIST
         s["expanded"][idx] = True
-        s["expanded"][idx, self.L] = False
+        s["expanded"][idx, ..., self.L] = False
         s["visited"][idx] = 0
         s["no_better"][idx] = 0
         s["ptr"][idx] = 0
@@ -483,6 +486,15 @@ class BeamSlotScheduler:
             state, jnp.asarray(pool.t_limit), pool.k_eff, pool.L, pool.B,
             pool.nbp_limit, pool.seg_iters, inject=pool.inject)
         metrics.inc("scheduler.segments")
+        # shard-axis accounting (mesh engines, parallel/mesh_engine.py):
+        # one mesh segment advances the walk on EVERY shard at once, so
+        # the device-work counter scales by the shard count and the
+        # admission controller's occupancy/slot-wait signals — read from
+        # the same scheduler gauges — are mesh-wide by construction
+        shards = int(getattr(engine, "n_shards", 1))
+        if shards > 1:
+            metrics.inc("scheduler.shard_segments", shards)
+            metrics.set_gauge("scheduler.mesh_shards", shards)
         live_now = 0
         for e in pool.entries:
             if e is not None:
@@ -516,8 +528,10 @@ class BeamSlotScheduler:
             # per-query roofline attribution (ISSUE 6 satellite): the
             # row's own iteration count x the one-row ledger cost over
             # its RESIDENT time classifies a slow query as compute-,
-            # bandwidth- or scheduling-bound right in the log line
-            iters_done = [int(pool.state["it"][i]) for i in done]
+            # bandwidth- or scheduling-bound right in the log line.
+            # np.max covers the mesh layout ((cap, n_shards) counters —
+            # device residency tracks the slowest shard's walk)
+            iters_done = [int(np.max(pool.state["it"][i])) for i in done]
             cost1 = pool.iter_cost1()
             cap = getattr(engine, "_capability", None)
             for i in done:
@@ -529,6 +543,10 @@ class BeamSlotScheduler:
             # counter landed after the futures, so completion-triggered
             # dumps undercounted the very query that triggered them
             metrics.inc("scheduler.retired", len(done))
+            if shards > 1:
+                # retire frees one slot row PER SHARD: the per-axis twin
+                # of scheduler.retired for mesh capacity accounting
+                metrics.inc("scheduler.shard_retired", len(done) * shards)
             for j, item in enumerate(items):
                 metrics.observe("scheduler.query_s", t_done - item.t_enq)
                 if rec:

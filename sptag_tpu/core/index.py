@@ -76,6 +76,29 @@ class SearchResult:
         return len(self.ids)
 
 
+def resolved_futures(search_batch, nrows: int) -> List["Future"]:
+    """THE pre-resolved-futures fallback shared by every submit_batch
+    surface (base VectorIndex, the mesh ServingAdapter/ShardedBKTIndex):
+    run `search_batch()` once for the whole block and hand back one
+    already-resolved future per row — a failure resolves EVERY row's
+    future with the exception, so streaming callers see the same error
+    contract as scheduler-backed paths."""
+    futs: List[Future] = []
+    try:
+        dists, ids = search_batch()
+    except Exception as e:                               # noqa: BLE001
+        for _ in range(nrows):
+            f: Future = Future()
+            f.set_exception(e)
+            futs.append(f)
+        return futs
+    for row in range(ids.shape[0]):
+        f = Future()
+        f.set_result((dists[row], ids[row]))
+        futs.append(f)
+    return futs
+
+
 _REGISTRY: Dict[IndexAlgoType, Type["VectorIndex"]] = {}
 
 
@@ -394,21 +417,10 @@ class VectorIndex(abc.ABC):
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
-        futs: List[Future] = []
-        try:
-            dists, ids = self.search_batch(queries, k, max_check=max_check,
-                                           search_mode=search_mode)
-        except Exception as e:                           # noqa: BLE001
-            for _ in range(queries.shape[0]):
-                f: Future = Future()
-                f.set_exception(e)
-                futs.append(f)
-            return futs
-        for row in range(ids.shape[0]):
-            f = Future()
-            f.set_result((dists[row], ids[row]))
-            futs.append(f)
-        return futs
+        return resolved_futures(
+            lambda: self.search_batch(queries, k, max_check=max_check,
+                                      search_mode=search_mode),
+            queries.shape[0])
 
     def _exact_scan(self, queries: np.ndarray, k: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
@@ -1223,7 +1235,21 @@ def load_index(folder: str, lazy_metadata: bool = False) -> VectorIndex:
     manifest checksum verification (a corrupt blob fails the load, never
     deserializes), then — for a WalEnabled index — WAL replay over the
     loaded snapshot and re-arming of the log, so every acked mutation is
-    present and future acks keep appending."""
+    present and future acks keep appending.
+
+    Mesh folders (ISSUE 11): a folder carrying a ``sharded.json``
+    manifest is a persisted mesh index (one reference-format sub-folder
+    per shard, ShardedBKTIndex.build(save_to=...)); it loads as a
+    `ServingAdapter` over the reassembled mesh placement, so a
+    ``[Index_<name>] IndexFolder=<mesh folder>`` ini line deploys
+    in-mesh serving through the same config surface as any index."""
+    if os.path.exists(os.path.join(folder, "sharded.json")):
+        from sptag_tpu.parallel.sharded import ServingAdapter, \
+            ShardedBKTIndex
+
+        sharded = ShardedBKTIndex.load(folder)
+        return ServingAdapter(
+            sharded, feature_dim=int(sharded.data.shape[1]))
     _recover_interrupted_save(folder)
     atomic.verify_manifest(folder)
     reader = IniReader.load(os.path.join(folder, "indexloader.ini"))

@@ -207,6 +207,18 @@ _COMMON_TAIL_SPECS = [
     _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
     _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
     _spec("quality_window", int, 0, "QualityWindow"),
+    # in-mesh sharded serving (parallel/sharded.py, ISSUE 11).  All off
+    # by default — single-chip indexes ignore them; the mesh build/serve
+    # paths read them off the shard params.  MeshServe=1 is the offline
+    # mirror of the [Service] setting (bench / index_searcher arm the
+    # mesh scheduler through it); MeshShardAxis sizes the shard axis to
+    # the first N local devices at build when no explicit mesh is given
+    # (0 = all devices); MeshKLocal caps each shard's contribution to
+    # the ICI top-k merge (0 = exact min(k, n_local) — lowering it
+    # trades all-gather traffic for merge completeness on wide meshes).
+    _spec("mesh_serve", int, 0, "MeshServe"),
+    _spec("mesh_shard_axis", int, 0, "MeshShardAxis"),
+    _spec("mesh_k_local", int, 0, "MeshKLocal"),
 ] + [
     # live-mutation durability + delta-shard knobs (ISSUE 9).  All
     # default OFF: serve bytes and on-disk layout are unchanged until an

@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sptag_tpu.core.index import MAX_DIST
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
-from sptag_tpu.utils import round_up
+from sptag_tpu.parallel._compat import shard_map
+from sptag_tpu.utils import costmodel, devmem, locksan, metrics, round_up
 
 SHARD_AXIS = "shard"
 
@@ -90,7 +91,7 @@ def _sharded_search_kernel(data, sqnorm, invalid, queries, k_local: int,
         gi = jnp.where(gd >= jnp.float32(MAX_DIST), -1, gi)
         return gd, gi
 
-    return jax.shard_map(
+    return shard_map(
         local_search,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS),
@@ -142,10 +143,18 @@ class ShardedFlatIndex:
             # the kernel signature stays uniform without HBM cost
             self.sqnorm = jax.device_put(
                 np.zeros(n_pad, np.float32), vec_sharding)
+        devmem.track("shard_blocks", self,
+                     self.data.nbytes + self.sqnorm.nbytes
+                     + self.invalid.nbytes)
 
     def search(self, queries: np.ndarray,
-               k: int = 10, normalized: bool = False
+               k: int = 10, normalized: bool = False,
+               max_check: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray]:
+        # `max_check` is accepted (and ignored — the scan is exact) so
+        # the flat mesh index serves behind ServingAdapter, whose wire
+        # surface forwards the $maxcheck option to every index type
+        del max_check
         if self.metric == DistCalcMethod.Cosine and not normalized:
             queries = dist_ops.normalize(np.asarray(queries), self.base)
         n_dev = self.mesh.devices.size
@@ -190,7 +199,7 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 
-    return jax.shard_map(
+    return shard_map(
         local_search,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None),
@@ -238,7 +247,7 @@ def _sharded_dense_kernel(data_perm, member_ids, member_sq, centroids,
         gids = jnp.where(out_ids >= 0, out_ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS, None, None, None),
@@ -249,6 +258,58 @@ def _sharded_dense_kernel(data_perm, member_ids, member_sq, centroids,
         check_vma=False,
     )(data_perm, member_ids, member_sq, centroids, cent_sq, cent_valid,
       deleted, queries)
+
+
+# ---------------------------------------------------------------------------
+# cost-ledger entries (utils/costmodel.py; graftlint GL605 covers parallel/)
+# ---------------------------------------------------------------------------
+#
+# Shard-parallel dispatch: every shard runs the per-shard formula at the
+# SHARD shapes simultaneously, so total device work per dispatch is
+# n_dev x the single-chip cost, plus the ICI merge (all-gather of every
+# shard's (dist, gid) top-k_local + the replicated global top-k_final).
+
+def _sharded_merge_cost(Q, k_local, k_final, n_dev):
+    gathered = Q * n_dev * k_local
+    flops = n_dev * (costmodel.topk_flops(Q, gathered)
+                     + 2.0 * Q * k_final)
+    nbytes = n_dev * (2.0 * gathered * 8 + Q * k_final * 8)
+    return flops, nbytes
+
+
+def _sharded_flat_cost(Q, N_local, D, k_local, k_final, n_dev,
+                       itemsize=4, **_):
+    from sptag_tpu.algo.flat import _flat_scan_cost
+
+    f, b = _flat_scan_cost(Q, N_local, D, k_local, itemsize)
+    mf, mb = _sharded_merge_cost(Q, k_local, k_final, n_dev)
+    return n_dev * f + mf, n_dev * b + mb
+
+
+def _sharded_beam_cost(Q, P, X, D, L, W, N_local, k_local, k_final,
+                       n_dev, **_):
+    from sptag_tpu.algo.engine import _walk_full_cost
+
+    f, b = _walk_full_cost(Q, P, X, D, L, W, N_local)
+    mf, mb = _sharded_merge_cost(Q, k_local, k_final, n_dev)
+    return n_dev * f + mf, n_dev * b + mb
+
+
+def _sharded_dense_cost(Q, C, Pb, D, nprobe, k_local, k_final, n_dev,
+                        itemsize=4, **_):
+    from sptag_tpu.algo.dense import _dense_scan_cost
+
+    f, b = _dense_scan_cost(Q, C, Pb, D, nprobe, k_local, itemsize)
+    mf, mb = _sharded_merge_cost(Q, k_local, k_final, n_dev)
+    return n_dev * f + mf, n_dev * b + mb
+
+
+costmodel.register("sharded.flat_scan", _sharded_search_kernel,
+                   _sharded_flat_cost)
+costmodel.register("sharded.beam_walk", _sharded_beam_kernel,
+                   _sharded_beam_cost)
+costmodel.register("sharded.dense_scan", _sharded_dense_kernel,
+                   _sharded_dense_cost)
 
 
 class ServingAdapter:
@@ -296,10 +357,114 @@ class ServingAdapter:
                 raise RuntimeError(
                     "dense layout not packed — build with dense=True")
         self.mode = mode
+        # mesh-serve spine (ISSUE 11): epoch-published placement + the
+        # continuous-batching flag.  Readers pin `impl = self._impl`
+        # once per call (the PR-9 epoch-handoff pattern) so a concurrent
+        # swap_impl can never hand them a half-published placement.
+        self._swap_lock = locksan.make_lock("ServingAdapter._swap_lock")
+        self._epoch = 0
+        self._swap_count = 0
+        self._mesh_serve = False
 
     @property
     def num_samples(self) -> int:
         return self._impl.n
+
+    # ---- MeshServe spine (ISSUE 11) ---------------------------------------
+
+    def enable_mesh_serve(self, slots: int = 1024,
+                          segment_iters: int = 0) -> bool:
+        """Arm the mesh-wide continuous-batching spine ([Service]
+        MeshServe=1): the backing index builds a `MeshGraphEngine` +
+        `BeamSlotScheduler` whose slot pools span the shard axis, and
+        `submit_batch` starts resolving per-query futures in retire
+        order — the serve tier then streams responses while stragglers
+        are still walking.  Returns False (and stays sync) for indexes
+        without the scheduler surface (ShardedFlatIndex, dense-only)."""
+        impl = self._impl
+        enable = getattr(impl, "enable_continuous_batching", None)
+        if enable is None or self.mode == "dense":
+            return False
+        enable(slots=slots, segment_iters=segment_iters)
+        self._mesh_serve = True
+        self._mesh_slots = slots
+        self._mesh_segment_iters = segment_iters
+        return True
+
+    def swap_impl(self, new_impl) -> int:
+        """Atomically publish a NEW sharded index as this adapter's mesh
+        placement (the live-mutation epoch swap of PR 9, mesh-wide): the
+        whole placement — every shard's corpus/graph/pivot arrays —
+        switches in one reference store; in-flight queries finish on the
+        OLD placement (its retired scheduler keeps walking residents,
+        exactly like a superseded single-chip snapshot), new queries see
+        the new one.  Returns the new epoch."""
+        with self._swap_lock:
+            old = self._impl
+            self._impl = new_impl
+            self._epoch += 1
+            self._swap_count += 1
+            epoch = self._epoch
+            # retire + re-arm INSIDE the lock: two concurrent swaps must
+            # serialize end to end, or swap B could retire a scheduler
+            # swap A has not armed yet and A's late re-arm would leave a
+            # live scheduler (worker thread + pools) on a superseded
+            # placement forever.  Both calls are cheap (retire only
+            # flags the drain; enable starts one thread).
+            retire = getattr(old, "retire_scheduler", None)
+            if retire is not None:
+                retire()
+            if self._mesh_serve:
+                # the new placement serves the same MeshServe contract
+                # the old one did — re-arm before traffic lands
+                enable = getattr(new_impl, "enable_continuous_batching",
+                                 None)
+                if enable is not None:
+                    enable(slots=getattr(self, "_mesh_slots", 1024),
+                           segment_iters=getattr(
+                               self, "_mesh_segment_iters", 0))
+        metrics.inc("mesh.swaps")
+        return epoch
+
+    def mutation_state(self) -> dict:
+        """Swap/placement state for /healthz + GET /debug/mutation —
+        the mesh analog of VectorIndex.mutation_state."""
+        impl = self._impl
+        return {
+            "epoch": self._epoch,
+            "swap_count": self._swap_count,
+            "mesh": {
+                "shards": int(impl.mesh.devices.size),
+                "rows": int(impl.n),
+                "mesh_serve": self._mesh_serve,
+                "scheduler": getattr(impl, "_scheduler", None) is not None,
+            },
+        }
+
+    def submit_batch(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None,
+                     search_mode: Optional[str] = None,
+                     rids=None):
+        """Per-query futures over a (Q, D) block — the streaming serve
+        surface (VectorIndex.submit_batch contract).  With MeshServe
+        armed and the mode resolving to beam, futures resolve AS QUERIES
+        RETIRE from the mesh-wide slot scheduler; otherwise the batch
+        executes synchronously and the futures come back resolved (the
+        base-class semantics — identical results, batch granularity)."""
+        from sptag_tpu.core.index import resolved_futures
+
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        impl = self._impl                      # epoch pin
+        mode = self._resolve_mode(search_mode, max_check, impl=impl)
+        sub = getattr(impl, "submit_batch", None)
+        if self._mesh_serve and mode == "beam" and sub is not None:
+            return sub(queries, k, max_check=max_check, rids=rids)
+        return resolved_futures(
+            lambda: self.search_batch(queries, k, max_check=max_check,
+                                      search_mode=search_mode),
+            queries.shape[0])
 
     def search_batch(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
@@ -316,16 +481,30 @@ class ServingAdapter:
         falling back to the configured mode when the dense pack is
         absent — a wire value the protocol accepts must never hard-fail
         a query that the configured mode could serve."""
+        impl = self._impl                      # epoch pin (swap_impl)
+        mode = self._resolve_mode(search_mode, max_check, impl=impl)
+        if mode == "dense":
+            return impl.search_dense(np.asarray(queries), k=k,
+                                     max_check=max_check)
+        return impl.search(np.asarray(queries), k=k,
+                           max_check=max_check)
+
+    def _resolve_mode(self, search_mode: Optional[str],
+                      max_check: Optional[int], impl=None) -> str:
+        """Per-request serving-mode resolution shared by search_batch
+        and submit_batch (see search_batch's docstring for the `auto`
+        crossover + degrade semantics)."""
+        impl = impl if impl is not None else self._impl
         mode = search_mode or self.mode
         if mode == "auto":
             mc = (max_check if max_check is not None
-                  else getattr(self._impl, "max_check", 2048))
+                  else getattr(impl, "max_check", 2048))
             want = ("dense" if mc >= self.auto_mode_threshold else "beam")
             # only resolve to an engine this index can actually serve;
             # otherwise degrade to the configured mode
-            if want == "dense" and not hasattr(self._impl, "dense_perm"):
+            if want == "dense" and not hasattr(impl, "dense_perm"):
                 want = self.mode
-            params = getattr(self._impl, "params", None)
+            params = getattr(impl, "params", None)
             has_graph = (int(getattr(params, "build_graph", 1))
                          if params is not None else 1)
             if want == "beam" and not has_graph:
@@ -333,11 +512,7 @@ class ServingAdapter:
             mode = want
         if mode not in ("beam", "dense"):     # same contract as the ctor
             raise ValueError(f"unknown serving mode: {mode!r}")
-        if mode == "dense":
-            return self._impl.search_dense(np.asarray(queries), k=k,
-                                           max_check=max_check)
-        return self._impl.search(np.asarray(queries), k=k,
-                                 max_check=max_check)
+        return mode
 
     def search(self, query, k: int = 10, with_metadata: bool = False,
                max_check: Optional[int] = None,
@@ -421,6 +596,134 @@ class ShardedBKTIndex:
         self.budget_policy = "full"
         self.budget_guard_overlap = 0.99
         self._guarded_cache: dict = {}
+        # mesh-wide continuous batching (ISSUE 11): built on demand by
+        # enable_continuous_batching(); retired as a unit on swap
+        self._scheduler = None
+        self._mesh_engine = None
+
+    # ---- mesh-wide continuous batching (ISSUE 11) -------------------------
+
+    def enable_continuous_batching(self, slots: int = 1024,
+                                   segment_iters: int = 0):
+        """Build the mesh serving spine: a `MeshGraphEngine` over this
+        index's placed shard arrays plus ONE `BeamSlotScheduler` whose
+        slot pools span the shard axis — every resident query occupies a
+        slot row on every shard, one bucketed refill queue feeds the
+        mesh-wide segment step, and converged queries retire (and
+        resolve their futures) while stragglers keep walking.  Idempotent;
+        returns the scheduler."""
+        if self._scheduler is not None:
+            return self._scheduler
+        from sptag_tpu.algo.scheduler import BeamSlotScheduler
+        from sptag_tpu.parallel.mesh_engine import MeshGraphEngine
+
+        # no devmem entry here: the engine wraps the PLACEMENT's arrays
+        # (tracked as shard_blocks by _place) — re-tracking them under
+        # the engine would double-count the same residency
+        engine = MeshGraphEngine(self)
+        self._mesh_engine = engine
+        self._scheduler = BeamSlotScheduler(
+            engine, slots=slots, segment_iters=segment_iters,
+            name="mesh-sched")
+        return self._scheduler
+
+    def retire_scheduler(self) -> None:
+        """Drop this placement's scheduler WITHOUT dropping in-flight
+        work: residents finish on the old snapshot (scheduler.retire's
+        drain semantics), new submits go to whoever replaced us.  The
+        swap path (ServingAdapter.swap_impl) calls this on the outgoing
+        placement."""
+        sched, self._scheduler = self._scheduler, None
+        self._mesh_engine = None
+        if sched is not None:
+            sched.retire()
+
+    def submit_batch(self, queries: np.ndarray, k: int = 10,
+                     max_check: Optional[int] = None,
+                     search_mode: Optional[str] = None,
+                     rids=None):
+        """Per-query futures (VectorIndex.submit_batch contract): with
+        the mesh scheduler armed and a beam-capable request, each future
+        resolves in retire order from the mesh-wide slot pools —
+        identical ids to `search()` at the same budget (distances may
+        differ in the last ulp across refill-bucket shapes, the PR-4
+        scheduler caveat).  Dense requests, non-"full" budget policies
+        and scheduler-less indexes fall back to one synchronous
+        search_batch with pre-resolved futures."""
+        from concurrent.futures import Future
+
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        sched = self._scheduler
+        mode = search_mode or "beam"
+        if (sched is not None and mode == "beam"
+                and self.budget_policy == "full"
+                and int(getattr(self.params, "build_graph", 1))):
+            from sptag_tpu.algo.scheduler import (SchedulerStopped,
+                                                  pad_result_row)
+
+            if self.metric == DistCalcMethod.Cosine:
+                queries = dist_ops.normalize(queries, self.base)
+            mc = max_check if max_check is not None else self.max_check
+            out = []
+            try:
+                for i in range(queries.shape[0]):
+                    inner = sched.submit(queries[i], k, mc,
+                                         beam_width=self.beam_width,
+                                         nbp_limit=self.nbp_limit,
+                                         rid=rids[i] if rids else "")
+                    # pad k_eff (the global merge width, possibly < k
+                    # under MeshKLocal / small meshes) out to the
+                    # caller's k — the same wire contract every
+                    # synchronous path honors
+                    outer: Future = Future()
+
+                    def _pad(f, outer=outer):
+                        e = f.exception()
+                        if e is not None:
+                            outer.set_exception(e)
+                            return
+                        d, ids = f.result()
+                        outer.set_result(pad_result_row(d, ids, k))
+                    inner.add_done_callback(_pad)
+                    out.append(outer)
+            except SchedulerStopped:
+                # a placement swap retired this scheduler mid-batch:
+                # rows already submitted still resolve (retire drains
+                # pending + residents); the remainder serves
+                # synchronously on whatever placement is live now.
+                # normalized=True — this branch already normalized.
+                from sptag_tpu.core.index import resolved_futures
+
+                done = len(out)
+                rest = queries[done:]
+                out.extend(resolved_futures(
+                    lambda: self.search(rest, k, max_check=max_check,
+                                        normalized=True),
+                    rest.shape[0]))
+            return out
+        from sptag_tpu.core.index import resolved_futures
+
+        return resolved_futures(
+            lambda: (self.search_dense(queries, k, max_check=max_check)
+                     if mode == "dense"
+                     else self.search(queries, k, max_check=max_check)),
+            queries.shape[0])
+
+    def set_deleted(self, deleted: np.ndarray) -> None:
+        """Publish a new GLOBAL tombstone mask (row-aligned with the
+        build corpus; rows beyond `n` — ceil-division padding — stay
+        deleted).  Mutation-path analog of GraphSearchEngine.set_deleted:
+        the next dispatch of every search path (monolithic AND the mesh
+        scheduler's finalize) reads the new mask."""
+        n_dev = self.mesh.devices.size
+        mask = np.ones(n_dev * self.n_local, bool)
+        mask[:self.n] = np.asarray(deleted, bool)[:self.n]
+        vec = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self.deleted = jax.device_put(mask, vec)
+        if self._mesh_engine is not None:
+            self._mesh_engine.deleted = self.deleted
 
     @classmethod
     def load(cls, folder: str,
@@ -436,7 +739,17 @@ class ShardedBKTIndex:
 
         with open(os.path.join(folder, "sharded.json")) as f:
             meta = json.load(f)
-        mesh = mesh if mesh is not None else make_mesh()
+        if mesh is None:
+            # size the default mesh from the manifest: a 2-shard save
+            # loads onto the first 2 local devices of an 8-device host
+            # (an EXPLICIT mesh must still match exactly — placement is
+            # the caller's statement of intent)
+            devs = jax.devices()
+            if len(devs) < meta["n_shards"]:
+                raise ValueError(
+                    f"saved index has {meta['n_shards']} shards but the "
+                    f"host exposes only {len(devs)} devices")
+            mesh = make_mesh(devs[:meta["n_shards"]])
         if mesh.devices.size != meta["n_shards"]:
             raise ValueError(
                 f"mesh has {mesh.devices.size} devices but the saved index "
@@ -511,7 +824,13 @@ class ShardedBKTIndex:
                 f"sharded mesh indexes support BKT or KDT shards, not "
                 f"{algo!r}")
 
-        mesh = mesh if mesh is not None else make_mesh()
+        if mesh is None:
+            # MeshShardAxis (core/params.py): size the shard axis to the
+            # first N local devices instead of all of them (0 = all) —
+            # an operator carving one host's chips between tenants
+            n_axis = int((params or {}).get("MeshShardAxis", 0) or 0)
+            mesh = make_mesh(jax.devices()[:n_axis] if n_axis > 0
+                             else None)
         n_dev = mesh.devices.size
         n = data.shape[0]
         if n < n_dev:
@@ -639,6 +958,11 @@ class ShardedBKTIndex:
                     np.stack(blocks_pmask))
         if dense:
             self._place_dense(shard_indexes)
+        if int(getattr(self.params, "mesh_serve", 0)):
+            # index-level MeshServe=1 (core/params.py): the OFFLINE
+            # mirror of the [Service] setting — bench / CLI runs arm the
+            # mesh scheduler at placement time, no serve tier required
+            self.enable_continuous_batching()
         return self
 
     def _place_dense(self, shard_indexes) -> None:
@@ -688,6 +1012,13 @@ class ShardedBKTIndex:
         self.dense_cent_valid = jax.device_put(cv, r2)
         self.dense_cluster_size = Pb
         self.dense_num_clusters = C
+        # the dense pack is a second mesh-resident corpus copy — its own
+        # ledger component so /debug/memory attributes it separately
+        devmem.track("dense_blocks", self,
+                     self.dense_perm.nbytes + self.dense_ids.nbytes
+                     + self.dense_sq.nbytes + self.dense_cent.nbytes
+                     + self.dense_cent_sq.nbytes
+                     + self.dense_cent_valid.nbytes)
 
     def search_dense(self, queries: np.ndarray, k: int = 10,
                      max_check: Optional[int] = None,
@@ -730,7 +1061,8 @@ class ShardedBKTIndex:
         nprobe = int(np.clip(-(-max_check // self.dense_cluster_size), 1,
                              self.dense_num_clusters))
         n_dev = self.mesh.devices.size
-        k_local = min(k, self.n_local, nprobe * self.dense_cluster_size)
+        k_local = min(self._merge_k_local(k),
+                      nprobe * self.dense_cluster_size)
         k_final = min(k, self.n, k_local * n_dev)
         # dedup=False: shards are packed replica-free (_place_dense forces
         # replicas=1), so no id can appear in two probed blocks
@@ -756,6 +1088,14 @@ class ShardedBKTIndex:
         self.pivot_ids = jax.device_put(pivot_ids, rows)
         self.pivot_vecs = jax.device_put(pivot_vecs, rows3)
         self.pivot_mask = jax.device_put(pivot_mask, rows)
+        # device-memory ledger (ISSUE 11 satellite): the mesh-resident
+        # shard blocks, one aggregate entry per placement — a swap's old
+        # placement drops off the gauge when it is collected
+        devmem.track("shard_blocks", self,
+                     self.data.nbytes + self.sqnorm.nbytes
+                     + self.graph.nbytes + self.deleted.nbytes
+                     + self.pivot_ids.nbytes + self.pivot_vecs.nbytes
+                     + self.pivot_mask.nbytes)
 
     # ---- per-shard budget policy (VERDICT r3 item 8) ---------------------
 
@@ -855,11 +1195,21 @@ class ShardedBKTIndex:
         return self._search_raw(queries, k, mc_shard, beam_width,
                                 pool_size)
 
+    def _merge_k_local(self, k: int) -> int:
+        """Per-shard contribution to the global merge: min(k, n_local)
+        by default; `MeshKLocal` (core/params.py) caps it lower to trade
+        all-gather traffic for merge completeness on wide meshes (a
+        shard holding more than k_local of the true global top-k drops
+        the excess).  0 = off (exact merge)."""
+        cap = int(getattr(self.params, "mesh_k_local", 0) or 0)
+        k_local = min(k, self.n_local)
+        return min(k_local, cap) if cap > 0 else k_local
+
     def _search_raw(self, queries: np.ndarray, k: int, max_check: int,
                     beam_width: int, pool_size: Optional[int]
                     ) -> Tuple[np.ndarray, np.ndarray]:
         n_dev = self.mesh.devices.size
-        k_local = min(k, self.n_local)     # per-shard beam cap
+        k_local = self._merge_k_local(k)   # per-shard beam cap
         k_final = min(k, self.n, k_local * n_dev)   # global merge cap
         from sptag_tpu.algo.engine import beam_pool_size, beam_width_for
         L = beam_pool_size(k_local, max_check, self.n_local, pool_size)

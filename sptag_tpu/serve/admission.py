@@ -137,9 +137,14 @@ class AdmissionController:
 
     def observe(self, queue_frac: float = 0.0,
                 slot_wait_p99_ms: float = 0.0,
-                occupancy: float = 0.0) -> str:
+                occupancy: float = 0.0,
+                mesh_shards: float = 0.0) -> str:
         """Feed one signal sample and recompute the state; returns the
-        (possibly new) state name."""
+        (possibly new) state name.  `mesh_shards` is context, not a
+        trigger: with in-mesh serving (ISSUE 11) the slot pools span the
+        shard axis, so `slot_wait_p99_ms`/`occupancy` are already
+        MESH-WIDE readings — the shard count rides along so
+        /debug/admission shows what scope a degrade decision covered."""
         cfg = self.config
         now = self._clock()
         with self._lock:
@@ -147,6 +152,8 @@ class AdmissionController:
                                   "slot_wait_p99_ms":
                                       round(slot_wait_p99_ms, 3),
                                   "occupancy": round(occupancy, 4)}
+            if mesh_shards:
+                self._last_signals["mesh_shards"] = int(mesh_shards)
             if queue_frac >= cfg.shed_queue_frac or \
                     slot_wait_p99_ms >= cfg.shed_slot_wait_ms:
                 target = 2

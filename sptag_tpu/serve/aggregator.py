@@ -24,8 +24,12 @@ does on-device with `lax.top_k` over the all-gather).  Off by default for
 reference parity.
 
 (The intra-pod TPU equivalent of this whole file is
-sptag_tpu/parallel/sharded.py — one pjit program over ICI.  This module is
-the DCN/external edge for reference-topology deployments.)
+sptag_tpu/parallel/sharded.py — one pjit program over ICI.  Since ISSUE
+11 that path serves end-to-end ([Service] MeshServe=1 over a sharded
+mesh index), which DEMOTES this module to the cross-host tier: same-host
+shards belong in one mesh program, and `start()` logs an advisory when a
+config still fans out to multiple loopback backends.  This module is the
+DCN/external edge for reference-topology and multi-host deployments.)
 """
 
 from __future__ import annotations
@@ -545,6 +549,24 @@ class AggregatorService:
                 host=self.context.metrics_host,
                 admission=self._admission_debug)
             self._metrics_http.start()
+        # cross-host demotion advisory (ISSUE 11): with in-mesh serving
+        # (parallel/sharded.py + [Service] MeshServe) same-host shards
+        # collapse into ONE server process whose scatter + top-k merge is
+        # a single compiled dispatch over ICI — socket fan-out between
+        # processes on one machine pays framing + host merge for nothing.
+        # This tier is the DCN/cross-host edge; flag configs still
+        # fanning out to multiple loopback backends so operators see the
+        # migration target (count only; behavior unchanged).
+        local = sum(1 for s in self.context.servers
+                    if s.address in ("127.0.0.1", "localhost", "::1"))
+        if local > 1:
+            metrics.set_gauge("aggregator.same_host_backends", local)
+            log.warning(
+                "aggregator fans out to %d same-host backends — the "
+                "in-mesh serve path ([Service] MeshServe=1 over a "
+                "sharded mesh index) replaces same-host fan-out with "
+                "one compiled dispatch; keep this tier for cross-host",
+                local)
         await self._connect_all()
         self._reconnect_task = asyncio.create_task(self._reconnect_loop())
         host = host or self.context.listen_addr

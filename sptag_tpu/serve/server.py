@@ -166,7 +166,10 @@ class SearchServer:
         """Live pressure signals for the admission controller: request
         queue fill, the continuous-batching scheduler's slot-wait p99
         and pool occupancy (both zero for dense/FLAT-only serving — the
-        queue fraction then carries the whole signal)."""
+        queue fraction then carries the whole signal).  With MeshServe
+        (ISSUE 11) the slot pools span the shard axis, so these same
+        gauges are MESH-WIDE readings; the shard-count gauge rides along
+        so /debug/admission shows the scope a decision covered."""
         h = metrics.histogram_or_none("scheduler.slot_wait")
         return {
             "queue_frac": (self._queue.qsize()
@@ -174,6 +177,7 @@ class SearchServer:
             "slot_wait_p99_ms": (h.percentile(99) * 1000.0
                                  if h is not None else 0.0),
             "occupancy": metrics.gauge_value("scheduler.occupancy"),
+            "mesh_shards": metrics.gauge_value("scheduler.mesh_shards"),
         }
 
     # ------------------------------------------------------------- lifecycle
@@ -208,6 +212,27 @@ class SearchServer:
                 dump_on_slow_query=self.host_prof_dump_on_slow_query
                 or None)
             hostprof.start()
+        if self.context.settings.mesh_serve:
+            # in-mesh sharded serving (ISSUE 11): arm the mesh-wide
+            # continuous-batching spine on every registered mesh index
+            # (parallel/sharded.py ServingAdapter) — shard-local search
+            # + ICI top-k merge run as one compiled dispatch and
+            # responses stream in retire order.  Default off: mesh
+            # adapters keep the synchronous whole-batch path and serve
+            # bytes stay byte-identical (the ci_check.sh parity pass).
+            for name, index in self.context.indexes.items():
+                enable = getattr(index, "enable_mesh_serve", None)
+                if enable is None:
+                    continue
+                kw = {}
+                if self.context.settings.mesh_serve_slots > 0:
+                    kw["slots"] = self.context.settings.mesh_serve_slots
+                if self.context.settings.mesh_serve_segment_iters > 0:
+                    kw["segment_iters"] = (
+                        self.context.settings.mesh_serve_segment_iters)
+                if enable(**kw):
+                    metrics.inc("server.mesh_serve_indexes")
+                    log.info("MeshServe armed on index %s", name)
         if self.quality_sample_rate > 0:
             qualmon.configure(
                 sample_rate=self.quality_sample_rate,

@@ -162,6 +162,18 @@ class ServiceSettings:
     # wait/hold accounting published as lock_wait_ms{name=} gauges.
     # Enabled at config load, BEFORE the indexes build their locks.
     lock_contention_ledger: bool = False
+    # in-mesh sharded serving (parallel/sharded.py, ISSUE 11): with
+    # MeshServe=1 every registered mesh index (ServingAdapter) arms its
+    # mesh-wide continuous-batching spine at server start — one pjit
+    # program per host with slot pools spanning the shard axis, the
+    # socket aggregator demoted to the cross-host tier.  Off by default:
+    # serve bytes stay byte-identical and mesh adapters keep the
+    # synchronous whole-batch path.  MeshServeSlots sizes the mesh
+    # scheduler's slot pools (0 = the scheduler default, 1024);
+    # MeshServeSegmentIters fixes the segment length (0 = auto ~T/4).
+    mesh_serve: bool = False
+    mesh_serve_slots: int = 0
+    mesh_serve_segment_iters: int = 0
 
 
 class ServiceContext:
@@ -261,6 +273,13 @@ class ServiceContext:
             lock_contention_ledger=reader.get_parameter(
                 "Service", "LockContentionLedger", "0").lower() in
             ("1", "true", "on", "yes"),
+            mesh_serve=reader.get_parameter(
+                "Service", "MeshServe", "0").lower() in
+            ("1", "true", "on", "yes"),
+            mesh_serve_slots=int(reader.get_parameter(
+                "Service", "MeshServeSlots", "0")),
+            mesh_serve_segment_iters=int(reader.get_parameter(
+                "Service", "MeshServeSegmentIters", "0")),
         )
         if s.lock_sanitizer:
             # before the indexes load: their writer locks must be created
@@ -771,9 +790,10 @@ class SearchExecutor:
             if (on_ready is not None and len(sel) == 1
                     and hasattr(self.context.indexes[sel[0]],
                                 "submit_batch")):
-                # duck-typed serving surfaces (parallel/sharded.py's
-                # ServingAdapter) expose only search/search_batch — they
-                # keep the classic whole-batch path below
+                # every serving surface exposes submit_batch — indexes
+                # without a scheduler (and mesh adapters with MeshServe
+                # off) return pre-resolved futures, so streaming
+                # degrades to batch granularity with identical bytes
                 self._run_group_streaming(parsed, results, sel[0], k,
                                           with_meta, max_check,
                                           search_mode, idxs, on_ready,

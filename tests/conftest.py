@@ -40,6 +40,106 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture
+def host_mesh():
+    """N-device host mesh over the forced CPU devices (ISSUE 11
+    satellite): the suite already boots with
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (above), so
+    small in-mesh serve tests run cheaply in tier-1 instead of living
+    behind ``slow`` markers.  Returns ``make(n=None)`` — a mesh over the
+    first `n` virtual devices (all 8 when omitted).  Prefer the
+    SMALLEST mesh that exercises the behavior: shard_map program compile
+    time scales with the device count, and tier-1 is compile-bound
+    (docs/DESIGN.md §12 compile-budget notes).  Processes without the
+    forced device count (bench, standalone children) must set the same
+    XLA_FLAGS in a SUBPROCESS env before jax imports — see bench.py's
+    mesh_serve stage."""
+    from sptag_tpu.parallel.sharded import make_mesh
+
+    def make(n=None):
+        devs = jax.devices()
+        if n is not None:
+            if n > len(devs):
+                pytest.skip(f"host mesh needs {n} devices, "
+                            f"have {len(devs)}")
+            devs = devs[:n]
+        return make_mesh(devs)
+
+    return make
+
+
+import asyncio  # noqa: E402
+import threading  # noqa: E402
+
+
+class ServerThread(threading.Thread):
+    """Run an asyncio server (SearchServer or AggregatorService) in a
+    background thread with its own loop — THE one copy of the
+    boot/halt helper (tests import it as `from conftest import
+    ServerThread`; bench.py keeps a standalone variant because the
+    bench child runs without tests/ on sys.path).
+
+    The stored boot-task reference is LOAD-BEARING: a bare
+    `loop.create_task(boot())` leaves the pending task referenced only
+    through its await-chain cycle, and a gc pass (observed right after
+    heavy XLA compile work) can destroy it mid-await — the
+    long-standing wait_ready flake root-caused in round 10."""
+
+    def __init__(self, server):
+        # named like the production threads: the no-anonymous-threads
+        # contract (tests/test_hostprof.py) enumerates every live thread
+        super().__init__(daemon=True,
+                         name=f"test-loop-{type(server).__name__}")
+        self.server = server
+        self.addr = None
+        self.loop = None
+        self._ready = threading.Event()
+
+    def run(self):
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+
+        async def boot():
+            self.addr = await self.server.start("127.0.0.1", 0)
+            self._ready.set()
+
+        self._boot_task = self.loop.create_task(boot())
+        self.loop.run_forever()
+
+    def wait_ready(self, timeout=10):
+        assert self._ready.wait(timeout)
+        return self.addr
+
+    def stop(self):
+        fut = asyncio.run_coroutine_threadsafe(self.server.stop(),
+                                               self.loop)
+        try:
+            fut.result(timeout=5)
+        except Exception:                                # noqa: BLE001
+            pass
+
+        # cancel leftover tasks and drain transport close callbacks
+        # inside the loop BEFORE stopping it, so no transport is
+        # finalized against a closed loop (the 'Event loop is closed'
+        # teardown warning)
+        async def _shutdown():
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            await asyncio.sleep(0)
+
+        fut2 = asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
+        try:
+            fut2.result(timeout=5)
+        except Exception:                                # noqa: BLE001
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.join(timeout=5)
+        self.loop.close()
+
+
 @pytest.fixture(autouse=True)
 def _reset_telemetry_registries():
     """Start every test with empty trace-span, metrics and flight-recorder

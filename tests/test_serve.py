@@ -123,68 +123,9 @@ def test_executor_batch_groups():
 
 # ------------------------------------------------------- socket end-to-end
 
-class _ServerThread(threading.Thread):
-    """Run an asyncio server (SearchServer or AggregatorService) in a
-    background thread with its own loop."""
-
-    def __init__(self, server):
-        # named like the production threads: the no-anonymous-threads
-        # contract (tests/test_hostprof.py) enumerates every live thread
-        super().__init__(daemon=True,
-                         name=f"test-loop-{type(server).__name__}")
-        self.server = server
-        self.addr = None
-        self.loop = None
-        self._ready = threading.Event()
-
-    def run(self):
-        self.loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(self.loop)
-
-        async def boot():
-            self.addr = await self.server.start("127.0.0.1", 0)
-            self._ready.set()
-
-        # KEEP the reference: a bare create_task() leaves the pending
-        # boot task referenced only through its await-chain cycle, and a
-        # gc pass (likely right after heavy XLA compile work) can
-        # DESTROY it mid-await — the long-standing wait_ready flake
-        # ("Task was destroyed but it is pending!"), root-caused in
-        # round 10 via the roofline e2e
-        self._boot_task = self.loop.create_task(boot())
-        self.loop.run_forever()
-
-    def wait_ready(self, timeout=10):
-        assert self._ready.wait(timeout)
-        return self.addr
-
-    def stop(self):
-        fut = asyncio.run_coroutine_threadsafe(self.server.stop(),
-                                               self.loop)
-        try:
-            fut.result(timeout=5)
-        except Exception:
-            pass
-
-        # cancel leftover tasks and drain transport close callbacks inside
-        # the loop BEFORE stopping it, so no transport is finalized against
-        # a closed loop (the 'Event loop is closed' teardown warning)
-        async def _shutdown():
-            tasks = [t for t in asyncio.all_tasks()
-                     if t is not asyncio.current_task()]
-            for t in tasks:
-                t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
-            await asyncio.sleep(0)
-
-        fut2 = asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
-        try:
-            fut2.result(timeout=5)
-        except Exception:
-            pass
-        self.loop.call_soon_threadsafe(self.loop.stop)
-        self.join(timeout=5)
-        self.loop.close()
+from conftest import ServerThread as _ServerThread  # noqa: E402
+# (hoisted to conftest.py in round 15 — test_mesh_serve.py shares it;
+# the boot-task-reference subtlety is documented there)
 
 
 def test_server_client_end_to_end():

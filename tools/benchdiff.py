@@ -98,6 +98,16 @@ METRICS: List[Metric] = [
     # mutation-under-load stage (ISSUE 9)
     Metric("mutate.read_qps", HIGHER, 0.20, 25.0),
     Metric("mutate.p99_steady_ms", LOWER, 0.25, 10.0),
+    # in-mesh sharded serving stage (ISSUE 11): the one-dispatch mesh
+    # path's throughput/tail, its margin over the socket fan-out
+    # baseline, and the merged-path recall (platform-independent).  The
+    # speedup ratio is the stage's reason to exist — hold that line.
+    Metric("mesh_serve.inmesh_qps", HIGHER, 0.20, 8.0),
+    Metric("mesh_serve.fanout_qps", HIGHER, 0.25, 5.0),
+    Metric("mesh_serve.inmesh_p99_ms", LOWER, 0.25, 20.0),
+    Metric("mesh_serve.speedup", HIGHER, 0.20, 0.15),
+    Metric("mesh_serve.recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
     # roofline %-of-peak per kernel family (ISSUE 6's ledger rows):
     # regressing the fraction of peak is the canary that a "faster in
     # QPS" change actually left device efficiency on the floor

@@ -120,6 +120,15 @@ PYEOF
 echo "== GL607 hostprof-stage lint (standalone) =="
 python -m tools.graftlint sptag_tpu/ --select GL607
 
+# the ISSUE 11 serving gate, standalone: with MeshServe at its default
+# (off) a server over a mesh adapter produces byte-identical wire
+# responses and never builds a mesh scheduler; the same module holds
+# the merge-contract parity (in-mesh ids == socket fan-out + host
+# merge over identical shard contents)
+echo "== mesh serve off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serve.py -q \
+    -p no:cacheprovider -k "off_parity"
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

@@ -39,7 +39,9 @@ RULES = {
 _COSTMODEL_MODULE = "sptag_tpu.utils.costmodel"
 
 #: path fragments that scope the rule: the device-kernel packages
-_SCOPED = ("algo/", "ops/")
+#: (parallel/ joined in ISSUE 11 — the sharded/mesh kernels must feed
+#: the roofline ledger like every single-chip kernel)
+_SCOPED = ("algo/", "ops/", "parallel/")
 
 
 def _is_register_call(call: ast.Call, mod: ModuleInfo) -> bool:
