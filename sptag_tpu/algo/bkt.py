@@ -1171,10 +1171,11 @@ class BKTIndex(VectorIndex):
             if old_sched is not None:
                 old_sched.retire()    # non-blocking; residents finish
             t1 = time.monotonic()
-            # copy-on-write publish (single background writer; readers
-            # snapshot the attribute — core/index.py __init__ note)
-            self._swap_windows = tuple(self._swap_windows[-15:]) + (
-                (t0 * 1000.0, t1 * 1000.0),)
+            with self._lock:      # GL802: the append is a read-modify-
+                # write racing a concurrent swap/reset; the tuple copy
+                # is tiny, so the lock hold is trivial
+                self._swap_windows = tuple(self._swap_windows[-15:]) + (
+                    (t0 * 1000.0, t1 * 1000.0),)
             metrics.inc("mutation.swaps")
             metrics.observe("mutation.swap_s", t1 - t0)
             if flightrec.enabled():

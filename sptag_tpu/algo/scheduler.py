@@ -210,6 +210,7 @@ class _SlotPool:
         return query_bucket(min(need, self.max_slots), self.max_slots)
 
 
+@locksan.race_track
 class BeamSlotScheduler:
     """Continuous-batching front end over one GraphSearchEngine snapshot.
 

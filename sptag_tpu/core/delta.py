@@ -34,7 +34,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from sptag_tpu.utils import devmem, round_up
+from sptag_tpu.utils import devmem, locksan, round_up
 
 #: sentinel distance (core/index.py MAX_DIST; kept a local scalar so the
 #: module imports backend-free)
@@ -43,6 +43,7 @@ _MAX_DIST = np.float32(3.4e38)
 _ROW_PAD = 128      # TPU lane width, same ladder as algo/flat.py
 
 
+@locksan.race_track
 class DeltaShard:
     """Bounded side index for rows appended after the engine snapshot.
 
@@ -64,6 +65,12 @@ class DeltaShard:
         self.count = 0
         # (count, data_d, sqnorm_d) republished atomically
         self._device: Optional[tuple] = None
+        # serializes the lazy snapshot rebuild below: searchers race to
+        # fill the cache (the owner lock is deliberately NOT held on
+        # the search path), and without this two threads upload the
+        # same buffer twice and publish with no common lock (GL801/
+        # racesan).  Leaf lock — never nested.
+        self._cache_lock = locksan.make_lock("DeltaShard._cache_lock")
 
     def append(self, data: np.ndarray, begin: int) -> None:
         """Append prepared rows whose global ids start at `begin`
@@ -82,19 +89,24 @@ class DeltaShard:
         compiles once; a full-buffer re-upload per append batch is a
         few MB at most (bounded by capacity)."""
         snap = self._device
-        count = self.count
-        if snap is not None and snap[0] == count:
+        if snap is not None and snap[0] == self.count:
             return snap
-        import jax.numpy as jnp
+        with self._cache_lock:
+            snap = self._device            # double-checked: a racing
+            count = self.count             # filler may have finished
+            if snap is not None and snap[0] == count:
+                return snap
+            import jax.numpy as jnp
 
-        from sptag_tpu.ops import distance as dist_ops
+            from sptag_tpu.ops import distance as dist_ops
 
-        data_d = jnp.asarray(self._rows)
-        sqnorm_d = dist_ops.row_sqnorms(data_d)
-        snap = (count, data_d, sqnorm_d)
-        devmem.track("delta_shard", self, data_d.nbytes + sqnorm_d.nbytes)
-        self._device = snap
-        return snap
+            data_d = jnp.asarray(self._rows)
+            sqnorm_d = dist_ops.row_sqnorms(data_d)
+            snap = (count, data_d, sqnorm_d)
+            devmem.track("delta_shard", self,
+                         data_d.nbytes + sqnorm_d.nbytes)
+            self._device = snap
+            return snap
 
     def search(self, queries: np.ndarray, k: int,
                deleted: Optional[np.ndarray]

@@ -120,6 +120,7 @@ def create_instance(algo: Union[IndexAlgoType, str],
     return cls(value_type)
 
 
+@locksan.race_track
 class VectorIndex(abc.ABC):
     algo: IndexAlgoType = IndexAlgoType.Undefined
 
@@ -1159,44 +1160,54 @@ class VectorIndex(abc.ABC):
         import io as _io
 
         reader = IniReader.loads(config)
-        self.params.load_config(reader.section_items("Index"))
-        pos = 0
-        for _name, loader, optional in self._blob_loaders():
-            if pos >= len(blobs):
-                if optional:
-                    continue
-                raise ValueError(f"missing index blob #{pos} ({_name})")
-            loader(_io.BytesIO(blobs[pos]))
-            pos += 1
-        if reader.does_section_exist("MetaData") and pos + 1 < len(blobs):
-            self.metadata = MetadataSet.load(_io.BytesIO(blobs[pos]),
-                                             _io.BytesIO(blobs[pos + 1]))
-            if reader.get_parameter("MetaData", "MetaDataToVectorIndex",
-                                    "") == "true":
-                self.build_meta_mapping()
+        # the whole swap runs under the writer lock (GL801): both load
+        # surfaces are public and callable on a LIVE index, and the blob
+        # loaders replace corpus/tree/graph/delta state that concurrent
+        # searches and the background rebuild otherwise read mid-swap
+        with self._lock:
+            self.params.load_config(reader.section_items("Index"))
+            pos = 0
+            for _name, loader, optional in self._blob_loaders():
+                if pos >= len(blobs):
+                    if optional:
+                        continue
+                    raise ValueError(
+                        f"missing index blob #{pos} ({_name})")
+                loader(_io.BytesIO(blobs[pos]))
+                pos += 1
+            if reader.does_section_exist("MetaData") and \
+                    pos + 1 < len(blobs):
+                self.metadata = MetadataSet.load(
+                    _io.BytesIO(blobs[pos]), _io.BytesIO(blobs[pos + 1]))
+                if reader.get_parameter(
+                        "MetaData", "MetaDataToVectorIndex",
+                        "") == "true":
+                    self.build_meta_mapping()
 
     def load_index_data(self, folder: str, reader: IniReader,
                         lazy_metadata: bool = False) -> None:
-        self.params.load_config(reader.section_items("Index"))
-        self._load_index_data(folder)
-        self._reset_delta()
-        if reader.does_section_exist("MetaData"):
-            self._meta_file = reader.get_parameter(
-                "MetaData", "MetaDataFilePath", self._meta_file)
-            self._meta_index_file = reader.get_parameter(
-                "MetaData", "MetaDataIndexPath", self._meta_index_file)
-            meta_path = os.path.join(folder, self._meta_file)
-            index_path = os.path.join(folder, self._meta_index_file)
-            if lazy_metadata:
-                # FileMetadataSet: offsets resident, payload read on demand
-                # (reference inc/Core/MetadataSet.h:46)
-                from sptag_tpu.core.vectorset import FileMetadataSet
-                self.metadata = FileMetadataSet(meta_path, index_path)
-            else:
-                self.metadata = MetadataSet.load(meta_path, index_path)
-            if reader.get_parameter("MetaData", "MetaDataToVectorIndex",
-                                    "") == "true":
-                self.build_meta_mapping()
+        with self._lock:                       # see load_index_blobs_data
+            self.params.load_config(reader.section_items("Index"))
+            self._load_index_data(folder)
+            self._reset_delta()
+            if reader.does_section_exist("MetaData"):
+                self._meta_file = reader.get_parameter(
+                    "MetaData", "MetaDataFilePath", self._meta_file)
+                self._meta_index_file = reader.get_parameter(
+                    "MetaData", "MetaDataIndexPath", self._meta_index_file)
+                meta_path = os.path.join(folder, self._meta_file)
+                index_path = os.path.join(folder, self._meta_index_file)
+                if lazy_metadata:
+                    # FileMetadataSet: offsets resident, payload read on
+                    # demand (reference inc/Core/MetadataSet.h:46)
+                    from sptag_tpu.core.vectorset import FileMetadataSet
+                    self.metadata = FileMetadataSet(meta_path, index_path)
+                else:
+                    self.metadata = MetadataSet.load(meta_path, index_path)
+                if reader.get_parameter(
+                        "MetaData", "MetaDataToVectorIndex",
+                        "") == "true":
+                    self.build_meta_mapping()
 
 
 #: kept as a module name for callers/tests; the implementation moved to

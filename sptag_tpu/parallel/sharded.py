@@ -312,6 +312,7 @@ costmodel.register("sharded.dense_scan", _sharded_dense_kernel,
                    _sharded_dense_cost)
 
 
+@locksan.race_track
 class ServingAdapter:
     """Presents a sharded mesh index through the VectorIndex serving
     surface (value_type / feature_dim / search / search_batch) so it can be

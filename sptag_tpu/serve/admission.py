@@ -53,7 +53,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from sptag_tpu.utils import metrics
+from sptag_tpu.utils import locksan, metrics
 
 #: admit() decisions
 ADMIT = "admit"
@@ -96,6 +96,7 @@ class AdmissionConfig:
     max_clients: int = 1024
 
 
+@locksan.race_track
 class AdmissionController:
     """State machine + fair-queueing bookkeeping.
 
@@ -110,7 +111,7 @@ class AdmissionController:
         self.config = config or AdmissionConfig()
         self._signals = signals
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("AdmissionController._lock")
         self._state = 0                       # index into STATES
         self._calm_since: Optional[float] = None
         self._last_eval = float("-inf")

@@ -270,7 +270,9 @@ class AggregatorContext:
                  host_prof_hz: float = 0.0,
                  host_prof_events: int = 0,
                  host_prof_dump_on_slow_query: bool = False,
-                 lock_contention_ledger: bool = False):
+                 lock_contention_ledger: bool = False,
+                 race_sanitizer: bool = False,
+                 racesan_sample_rate: float = 1.0):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -345,6 +347,9 @@ class AggregatorContext:
         self.host_prof_events = host_prof_events
         self.host_prof_dump_on_slow_query = host_prof_dump_on_slow_query
         self.lock_contention_ledger = lock_contention_ledger
+        # race sanitizer (ISSUE 12): [Service] parity with the shard tier
+        self.race_sanitizer = race_sanitizer
+        self.racesan_sample_rate = racesan_sample_rate
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -428,12 +433,23 @@ class AggregatorContext:
             lock_contention_ledger=reader.get_parameter(
                 "Service", "LockContentionLedger", "0").lower() in
             ("1", "true", "on", "yes"),
+            race_sanitizer=reader.get_parameter(
+                "Service", "RaceSanitizer", "0").lower() in
+            ("1", "true", "on", "yes", "strict"),
+            racesan_sample_rate=float(reader.get_parameter(
+                "Service", "RaceSanSampleRate", "1")),
         )
         if ctx.lock_contention_ledger:
             # arm before any client/connection locks are created (the
             # ServiceContext.from_ini timing contract)
             from sptag_tpu.utils import locksan
             locksan.enable_contention()
+        if ctx.race_sanitizer:
+            from sptag_tpu.utils import locksan
+            locksan.enable_racesan(
+                strict=(reader.get_parameter(
+                    "Service", "RaceSanitizer", "0").lower() == "strict"),
+                sample_rate=ctx.racesan_sample_rate)
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
             section = f"Server_{i}"
@@ -446,6 +462,7 @@ class AggregatorContext:
         return ctx
 
 
+@locksan.race_track
 class AggregatorService:
     def __init__(self, context: AggregatorContext,
                  admission: Optional[
@@ -525,6 +542,9 @@ class AggregatorService:
                 dump_dir=self.context.flight_dump_on_slow_query or None)
         if self.context.lock_contention_ledger:
             locksan.enable_contention()
+        if self.context.race_sanitizer:
+            locksan.enable_racesan(
+                sample_rate=self.context.racesan_sample_rate)
         if self.context.host_prof_hz > 0:
             # host sampler (utils/hostprof.py, ISSUE 10): process-wide;
             # never started at the default HostProfHz=0

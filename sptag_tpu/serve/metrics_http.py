@@ -78,8 +78,27 @@ _PROM = "text/plain; version=0.0.4; charset=utf-8"
 #: never wedge a scrape thread on an unbounded profiling session
 DEVICE_TRACE_MAX_MS = 10_000.0
 
-#: one device trace at a time, process-wide (jax.profiler is global)
-_device_trace_lock = threading.Lock()
+#: one device trace at a time, process-wide (jax.profiler is global).
+#: Created LAZILY at the first trace request, not at import — make_lock
+#: decides wrapping at creation time, and ini-based arming ([Service]
+#: RaceSanitizer / LockContentionLedger) runs long after this module is
+#: imported; an import-time lock would stay plain and invisible.  The
+#: bootstrap lock guarding the one-time creation is itself plain on
+#: purpose (it serializes ~nothing and exists before arming can).
+_device_trace_lock = None
+_device_trace_boot = threading.Lock()
+
+
+def _get_device_trace_lock():
+    global _device_trace_lock
+    lk = _device_trace_lock
+    if lk is None:
+        with _device_trace_boot:
+            if _device_trace_lock is None:
+                _device_trace_lock = locksan.make_lock(
+                    "metrics_http._device_trace_lock")
+            lk = _device_trace_lock
+    return lk
 
 
 def publish_flight_gauges() -> None:
@@ -264,7 +283,8 @@ class MetricsHttpServer:
             return (b'{"error": "duration_ms must be a number"}\n',
                     _JSON, 400)
         duration_ms = max(1.0, min(duration_ms, DEVICE_TRACE_MAX_MS))
-        if not _device_trace_lock.acquire(blocking=False):
+        trace_lock = _get_device_trace_lock()
+        if not trace_lock.acquire(blocking=False):
             return (b'{"error": "a device trace is already running"}\n',
                     _JSON, 409)
         try:
@@ -282,7 +302,7 @@ class MetricsHttpServer:
                                 "duration_ms": duration_ms}).encode(),
                     _JSON, 200)
         finally:
-            _device_trace_lock.release()
+            trace_lock.release()
 
     # ---------------------------------------------------------- lifecycle
 

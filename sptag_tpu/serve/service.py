@@ -162,6 +162,20 @@ class ServiceSettings:
     # wait/hold accounting published as lock_wait_ms{name=} gauges.
     # Enabled at config load, BEFORE the indexes build their locks.
     lock_contention_ledger: bool = False
+    # Eraser-style race sanitizer (utils/locksan.py, ISSUE 12): when on,
+    # every @race_track hot class (VectorIndex, BeamSlotScheduler,
+    # DeltaShard, ServingAdapter, AdmissionController, aggregator state)
+    # records sampled attribute writes with the writer's held-lockset;
+    # an attribute whose lockset intersection across writing threads
+    # goes empty bumps racesan.races with both stacks logged ("strict"
+    # raises DataRaceError).  Armed at config load, BEFORE index load —
+    # the lockset feed is SanLock's per-thread stacks, so arming also
+    # wraps locks created from here on.  Off (default): tracked classes
+    # are completely untouched and serve bytes stay byte-identical.
+    race_sanitizer: bool = False
+    # fraction of tracked attribute writes the sanitizer records
+    # (deterministic per-thread 1-in-round(1/rate)); 1.0 = every write
+    racesan_sample_rate: float = 1.0
     # in-mesh sharded serving (parallel/sharded.py, ISSUE 11): with
     # MeshServe=1 every registered mesh index (ServingAdapter) arms its
     # mesh-wide continuous-batching spine at server start — one pjit
@@ -273,6 +287,11 @@ class ServiceContext:
             lock_contention_ledger=reader.get_parameter(
                 "Service", "LockContentionLedger", "0").lower() in
             ("1", "true", "on", "yes"),
+            race_sanitizer=reader.get_parameter(
+                "Service", "RaceSanitizer", "0").lower() in
+            ("1", "true", "on", "yes", "strict"),
+            racesan_sample_rate=float(reader.get_parameter(
+                "Service", "RaceSanSampleRate", "1")),
             mesh_serve=reader.get_parameter(
                 "Service", "MeshServe", "0").lower() in
             ("1", "true", "on", "yes"),
@@ -295,6 +314,15 @@ class ServiceContext:
             # ledger even with the order sanitizer off
             from sptag_tpu.utils import locksan
             locksan.enable_contention()
+        if s.race_sanitizer:
+            # arm BEFORE index load: the shim must be installed before
+            # the hot classes instantiate, and arming wraps the locks
+            # whose per-thread held-stacks feed the locksets
+            from sptag_tpu.utils import locksan
+            locksan.enable_racesan(
+                strict=(reader.get_parameter(
+                    "Service", "RaceSanitizer", "0").lower() == "strict"),
+                sample_rate=s.racesan_sample_rate)
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
         for name in (t.strip() for t in index_list.split(",")):

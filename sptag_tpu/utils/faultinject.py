@@ -75,7 +75,7 @@ import random
 import threading
 from typing import Dict, List, Optional
 
-from sptag_tpu.utils import metrics
+from sptag_tpu.utils import locksan, metrics
 
 log = logging.getLogger(__name__)
 
@@ -159,7 +159,7 @@ class Injector:
         self._seed = int(seed)
         self._rules = _parse_spec(self._spec)
         self._rng = random.Random(self._seed)
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("Injector._lock")
         #: plain bool so the hot-path off test is one attribute read
         self.enabled = bool(self._rules)
         if self.enabled:

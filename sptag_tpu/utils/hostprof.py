@@ -288,7 +288,8 @@ def _run(evt: threading.Event) -> None:
         elapsed = time.perf_counter() - t0
         if elapsed > period:
             global _overruns
-            _overruns += 1
+            with _lock:           # reset() zeroes it under _lock (GL802)
+                _overruns += 1
         # Event.wait, not sleep: stop() interrupts a slow period.  The
         # event is THIS thread's own — a racing start() hands the next
         # sampler a fresh one, so two samplers can never co-exist

@@ -45,6 +45,29 @@ self-renders as ``lock_wait_ms{name=}`` / ``lock_hold_ms{name=}`` /
 (serve/metrics_http.py), the per-lock complement to the host profiler's
 stack samples (utils/hostprof.py): hostprof shows WHICH waits dominate,
 the ledger shows WHOSE lock they are.
+
+Race sanitizer (ISSUE 12): the Eraser-style lockset algorithm, the
+runtime complement of graftlint's GL80x guarded-by inference.  Opt-in —
+env ``SPTAG_RACESAN=1`` (``strict`` to raise), ini ``[Service]
+RaceSanitizer``, sampled via ``RaceSanSampleRate``.  Hot classes carry
+the ``@locksan.race_track`` decorator; ARMING installs a ``__setattr__``
+shim on them (off = class completely untouched, zero overhead).  Every
+sampled attribute write records (attr, writing thread, the held-lockset
+from SanLock's per-thread stacks) per INSTANCE.  The first writer owns
+the attribute exclusively (the init/publish handoff never fires — the
+static side polices that as GL805); when a SECOND thread writes, the
+candidate lockset starts at that write's held set and every later write
+intersects into it.  An attribute whose intersection is empty while
+writes from DIFFERENT threads interleave is a data race:
+``racesan.races`` bumps, BOTH stacks (the previous write's and this
+one's) are logged, and in strict mode `DataRaceError` is raised.  (The
+interleaving requirement is the classic Eraser ownership-transfer
+refinement: built on one thread then mutated by exactly one other
+forever after is synchronized by the spawn edge, which no lockset can
+see — the transition write and same-thread runs stay quiet.)
+``observed_locksets()`` aggregates the
+surviving per-(class, attr) intersections so tests/test_racesan.py can
+cross-check them against the statically inferred guards.
 """
 
 from __future__ import annotations
@@ -68,6 +91,13 @@ class LockOrderError(RuntimeError):
     lock order.  The offending lock is released before raising."""
 
 
+class DataRaceError(RuntimeError):
+    """Raised (racesan strict mode only) when a tracked attribute's
+    lockset intersection across writing threads goes empty.  The write
+    itself has already landed — the raise is the bug report, not a
+    rollback."""
+
+
 # --------------------------------------------------------------------------
 # configuration
 # --------------------------------------------------------------------------
@@ -77,6 +107,9 @@ _enabled_override: Optional[bool] = None
 _strict_override: Optional[bool] = None
 _watchdog_ms_override: Optional[float] = None
 _contention_override: Optional[bool] = None
+_racesan_override: Optional[bool] = None
+_racesan_strict_override: Optional[bool] = None
+_racesan_rate_override: Optional[float] = None
 
 
 def _env_mode() -> str:
@@ -100,11 +133,43 @@ def contention_enabled() -> bool:
         in ("1", "true", "on", "yes")
 
 
+def _racesan_env() -> str:
+    return os.environ.get("SPTAG_RACESAN", "").strip().lower()
+
+
+def racesan_enabled() -> bool:
+    """The opt-in Eraser-style race sanitizer (ISSUE 12).  Env
+    ``SPTAG_RACESAN=1`` (``strict``/``raise`` to make races raise) or
+    ini ``[Service] RaceSanitizer``."""
+    if _racesan_override is not None:
+        return _racesan_override
+    return _racesan_env() in ("1", "true", "on", "log", "strict", "raise")
+
+
+def racesan_strict() -> bool:
+    if _racesan_strict_override is not None:
+        return _racesan_strict_override
+    return _racesan_env() in ("strict", "raise")
+
+
+def racesan_sample_rate() -> float:
+    """Fraction of tracked attribute writes the sanitizer records
+    (deterministic per-thread 1-in-round(1/rate) gate, the qualmon
+    pattern).  1.0 records everything; 0 records nothing."""
+    if _racesan_rate_override is not None:
+        return _racesan_rate_override
+    try:
+        return float(os.environ.get("SPTAG_RACESAN_SAMPLE", "1"))
+    except ValueError:
+        return 1.0
+
+
 def enabled() -> bool:
-    """Wrap locks at creation?  True when either the order sanitizer or
-    the contention ledger wants them — the ledger rides the same SanLock
-    wrappers."""
-    return _san_enabled() or contention_enabled()
+    """Wrap locks at creation?  True when ANY locksan feature wants
+    them — the contention ledger rides the same SanLock wrappers, and
+    the race sanitizer reads the per-thread held-stacks only SanLocks
+    maintain (racesan over plain locks would see every lockset empty)."""
+    return _san_enabled() or contention_enabled() or racesan_enabled()
 
 
 def strict() -> bool:
@@ -389,6 +454,270 @@ def reset_contention() -> None:
         lk._c_registered = False
     with _cfg_lock:
         _ledger_locks.clear()
+
+
+# --------------------------------------------------------------------------
+# race sanitizer (ISSUE 12) — Eraser-style lockset intersection
+# --------------------------------------------------------------------------
+
+#: classes that opted in via @race_track (strong refs: these are
+#: long-lived type objects, a handful of them)
+_race_classes: List[type] = []
+#: class -> original __setattr__ from its OWN __dict__ (None = inherited)
+_race_installed: Dict[type, Optional[object]] = {}
+#: serializes per-instance record updates + the aggregates below
+_race_lock = threading.Lock()
+#: (class name, attr) -> {"threads": set, "lockset": set|None} — folded
+#: from instance records once they turn multi-writer; the cross-check
+#: surface for tests/test_racesan.py
+_race_observed: Dict[tuple, dict] = {}
+_race_records: List[dict] = []
+_race_seen: Set[tuple] = set()            # (class, attr) log dedup
+_race_writes_recorded = 0
+#: per-write sampling stride, derived from racesan_sample_rate() at
+#: arm time (0 = record nothing)
+_race_every = 1
+
+_MAX_RACE_RECORDS = 200
+
+
+def _race_stride() -> int:
+    rate = racesan_sample_rate()
+    if rate <= 0.0:
+        return 0
+    if rate >= 1.0:
+        return 1
+    return max(1, round(1.0 / rate))
+
+
+def _racesan_setattr(self, name, value):      # installed on tracked classes
+    orig = None
+    for k in type(self).__mro__:
+        if k in _race_installed:
+            orig = _race_installed[k]         # the class's own, pre-shim
+            break
+    if orig is not None:
+        orig(self, name, value)
+    else:
+        object.__setattr__(self, name, value)
+    if name.startswith("_racesan"):
+        return
+    _note_attr_write(self, name)
+
+
+def _note_attr_write(obj, name: str) -> None:
+    every = _race_every
+    if every <= 0:
+        return
+    tick = getattr(_tls, "race_tick", 0) + 1
+    _tls.race_tick = tick
+    if tick % every:
+        return
+    held = frozenset(getattr(_tls, "stack", ()) or ())
+    tid = threading.get_ident()
+    tname = threading.current_thread().name
+    # stack formatting OUTSIDE _race_lock (the _record_edges discipline);
+    # trim only the shim frames (_racesan_setattr + this function) so
+    # the writing statement itself stays on the record
+    here = "".join(traceback.format_stack()[:-2])
+    race: Optional[dict] = None
+    cls_name = type(obj).__name__
+    with _race_lock:
+        global _race_writes_recorded
+        _race_writes_recorded += 1
+        state = obj.__dict__.get("_racesan_state")
+        if state is None:
+            state = {}
+            object.__setattr__(obj, "_racesan_state", state)
+        rec = state.get(name)
+        if rec is None:
+            # virgin -> exclusive: first writer owns the attribute; the
+            # lockset is NOT refined until a second thread appears, so
+            # the construct-then-publish handoff cannot false-positive
+            # (escape DURING __init__ is the static side's GL805)
+            state[name] = {"writers": {tid}, "lockset": set(held),
+                           "last": (tid, tname, here), "raced": False}
+            return
+        shared_before = len(rec["writers"]) >= 2
+        transition = False
+        if tid not in rec["writers"]:
+            rec["writers"].add(tid)
+            if not shared_before:
+                # exclusive -> shared-modified: candidate set restarts
+                # at THIS write's held locks, then only intersects.
+                # The transition itself is NOT checked — a one-way
+                # ownership handoff (build on main, mutate on the loop/
+                # worker thread forever after) is synchronized by the
+                # spawn edge, which no lockset can see.
+                rec["lockset"] = set(held)
+                transition = True
+            else:
+                rec["lockset"] &= held
+        elif shared_before:
+            rec["lockset"] &= held
+        else:
+            rec["lockset"] = set(held)        # still exclusive: track
+        prev = rec["last"]
+        rec["last"] = (tid, tname, here)
+        if len(rec["writers"]) >= 2:
+            key = (cls_name, name)
+            agg = _race_observed.setdefault(
+                key, {"threads": set(), "lockset": None})
+            agg["threads"] |= rec["writers"]
+            agg["lockset"] = (set(rec["lockset"])
+                              if agg["lockset"] is None
+                              else agg["lockset"] & rec["lockset"])
+            # a race needs INTERLEAVING: this write and the previous one
+            # from different threads with an empty candidate set.  Same-
+            # thread runs keep quiet, so post-handoff single-writer
+            # phases never fire.
+            if not rec["lockset"] and not rec["raced"] and \
+                    not transition and prev[0] != tid:
+                rec["raced"] = True
+                race = {
+                    "class": cls_name,
+                    "attr": name,
+                    "threads": sorted(rec["writers"]),
+                    "prev_thread": prev[1],
+                    "prev_stack": prev[2],
+                    "thread": tname,
+                    "stack": here,
+                }
+                if len(_race_records) < _MAX_RACE_RECORDS:
+                    _race_records.append(race)
+    if race is not None:
+        metrics.inc("racesan.races")
+        key = (race["class"], race["attr"])
+        if key not in _race_seen:
+            _race_seen.add(key)
+            log.error(
+                "data race: `%s.%s` written by thread %r and thread %r "
+                "with an EMPTY lockset intersection — no lock protects "
+                "it.\n--- previous write (thread %s) ---\n%s"
+                "--- this write (thread %s) ---\n%s",
+                race["class"], race["attr"], race["prev_thread"],
+                race["thread"], race["prev_thread"], race["prev_stack"],
+                race["thread"], race["stack"])
+        if racesan_strict():
+            raise DataRaceError(
+                f"unguarded write to `{race['class']}.{race['attr']}`: "
+                f"thread {race['thread']!r} and thread "
+                f"{race['prev_thread']!r} share no lock")
+
+
+def _install_racesan(cls: type) -> None:
+    if cls in _race_installed:
+        return
+    _race_installed[cls] = cls.__dict__.get("__setattr__")
+    cls.__setattr__ = _racesan_setattr
+
+
+def _uninstall_racesan(cls: type) -> None:
+    orig = _race_installed.pop(cls, None)
+    if orig is not None:
+        cls.__setattr__ = orig
+    elif "__setattr__" in cls.__dict__:
+        del cls.__setattr__
+
+
+def race_track(cls: type) -> type:
+    """Class decorator registering `cls` with the race sanitizer.  When
+    the sanitizer is OFF (the default) the class is returned completely
+    untouched — zero overhead, byte-identical behavior.  Arming (env /
+    ini / enable_racesan) installs the ``__setattr__`` shim on every
+    registered class; disarming removes it."""
+    _race_classes.append(cls)
+    if racesan_enabled():
+        _install_racesan(cls)
+    return cls
+
+
+def enable_racesan(strict: Optional[bool] = None,
+                   sample_rate: Optional[float] = None) -> None:
+    """Arm the race sanitizer on every @race_track class (and those
+    registered from now on).  Like enable(): arm BEFORE building the
+    structures to cover — and note the lockset feed is SanLock's
+    per-thread stacks, so locks created while EVERY locksan feature was
+    off stay invisible."""
+    global _racesan_override, _racesan_strict_override
+    global _racesan_rate_override, _race_every
+    with _cfg_lock:
+        _racesan_override = True
+        if strict is not None:
+            _racesan_strict_override = strict
+        if sample_rate is not None:
+            _racesan_rate_override = float(sample_rate)
+        _race_every = _race_stride()
+    for cls in list(_race_classes):
+        _install_racesan(cls)
+
+
+def disable_racesan() -> None:
+    global _racesan_override, _racesan_strict_override
+    global _racesan_rate_override
+    with _cfg_lock:
+        _racesan_override = False
+        _racesan_strict_override = None
+        _racesan_rate_override = None
+    for cls in list(_race_classes):
+        _uninstall_racesan(cls)
+
+
+def reset_racesan() -> None:
+    """Observations dropped, overrides dropped — the environment decides
+    again, and the shim install state is re-synced to it (test
+    isolation; wired into conftest's autouse telemetry reset)."""
+    global _racesan_override, _racesan_strict_override
+    global _racesan_rate_override, _race_writes_recorded, _race_every
+    with _cfg_lock:
+        _racesan_override = None
+        _racesan_strict_override = None
+        _racesan_rate_override = None
+    with _race_lock:
+        _race_observed.clear()
+        _race_records.clear()
+        _race_seen.clear()
+        _race_writes_recorded = 0
+    on = racesan_enabled()
+    with _cfg_lock:
+        _race_every = _race_stride() if on else 1
+    for cls in list(_race_classes):
+        if on:
+            _install_racesan(cls)
+        else:
+            _uninstall_racesan(cls)
+
+
+def races() -> List[dict]:
+    with _race_lock:
+        return list(_race_records)
+
+
+def race_count() -> int:
+    with _race_lock:
+        return len(_race_records)
+
+
+def racesan_counters() -> Dict[str, int]:
+    with _race_lock:
+        return {
+            "enabled": int(racesan_enabled()),
+            "writes_recorded": _race_writes_recorded,
+            "races": len(_race_records),
+            "tracked_classes": len(_race_classes),
+        }
+
+
+def observed_locksets() -> Dict[tuple, dict]:
+    """{(class name, attr): {"threads": set, "lockset": set}} for every
+    tracked attribute that turned MULTI-WRITER — the lockset is the
+    intersection the Eraser pass maintained, i.e. the locks every
+    post-exclusive write held.  tests/test_racesan.py cross-checks these
+    against guardedby.infer_guards()."""
+    with _race_lock:
+        return {k: {"threads": set(v["threads"]),
+                    "lockset": set(v["lockset"] or ())}
+                for k, v in _race_observed.items()}
 
 
 # --------------------------------------------------------------------------

@@ -167,8 +167,10 @@ def reset() -> None:
     global _queue_drops, _budget_drops, _shadow_errors, _low_recall
     global _shadow_flops, _bucket_flops, _bucket_stamp, _active_jobs
     _stop_worker()
-    _active_jobs = 0
     with _lock:
+        # the worker bumps _active_jobs under _lock; zeroing it outside
+        # raced a late job's decrement (GL801)
+        _active_jobs = 0
         _sample_rate = 0.0
         _recall_floor = 0.0
         _shadow_budget_gflops = 0.0
@@ -399,8 +401,9 @@ def _stop_worker() -> None:
     if _worker is None:
         return
     _worker_stop.set()
-    _worker.join(timeout=5.0)
-    _worker = None
+    _worker.join(timeout=5.0)     # outside _lock: the worker takes it
+    with _lock:                   # _ensure_worker publishes under _lock
+        _worker = None            # (GL801)
 
 
 def drain(timeout_s: float = 10.0) -> bool:

@@ -157,6 +157,7 @@ def _reset_telemetry_registries():
     faultinject.reset()
     hostprof.reset()
     locksan.reset_contention()
+    locksan.reset_racesan()
     yield
 
 
@@ -178,6 +179,30 @@ def _locksan_no_inversions(request):
         + "; ".join(f"{r['acquiring']} acquired under {r['held']} "
                     f"(established order {r['established_order']})"
                     for r in new))
+
+
+@pytest.fixture(autouse=True)
+def _racesan_no_races(request):
+    """When the race sanitizer is armed (SPTAG_RACESAN=1 — the ci_check
+    racesan smoke subset runs mutation/scheduler tests this way), fail
+    any test during which it observed a data race: racesan.races == 0
+    is the acceptance for the armed suite.  Tests that plant races ON
+    PURPOSE opt out with @pytest.mark.racesan_ok."""
+    from sptag_tpu.utils import locksan
+
+    if not locksan.racesan_enabled():
+        yield
+        return
+    before = locksan.race_count()
+    yield
+    if request.node.get_closest_marker("racesan_ok"):
+        return
+    new = locksan.races()[before:]
+    assert not new, (
+        "data race(s) observed during this test: "
+        + "; ".join(f"{r['class']}.{r['attr']} written by "
+                    f"{r['prev_thread']} and {r['thread']} with no "
+                    "shared lock" for r in new))
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -1,0 +1,421 @@
+"""Runtime race sanitizer (utils/locksan.py, ISSUE 12) + static/runtime
+guard cross-check.
+
+Key proofs:
+
+* a PLANTED unguarded-write race (two threads interleaving writes with
+  no common lock) is detected: ``racesan.races`` bumps, the record
+  carries BOTH stacks, the log names both threads, strict mode raises
+  `DataRaceError`;
+* a lock-protected write hammer stays clean, and its observed lockset
+  is exactly the protecting lock;
+* the one-way ownership handoff (build on main, mutate on one worker
+  forever after) is NOT a race — the Eraser transfer refinement;
+* sampling is a deterministic per-thread 1-in-round(1/rate) gate;
+* with RaceSanitizer off (the default) every tracked class is
+  completely untouched (no ``__setattr__`` in the class dict), zero
+  writes are recorded, and the serve tier's wire bytes are
+  byte-identical to the reference layout (ci_check.sh parity pass);
+* the static guard inference (tools/graftlint/guardedby.infer_guards)
+  AGREES with the locksets a live BKT mutate-under-load workload
+  actually held — the ISSUE 12 acceptance, mirroring how ISSUE 3
+  cross-checked lockgraph vs locksan.
+"""
+
+import os
+import socket
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.serve import wire
+from sptag_tpu.serve.aggregator import AggregatorContext
+from sptag_tpu.serve.server import SearchServer
+from sptag_tpu.serve.service import (SearchExecutor, ServiceContext,
+                                     ServiceSettings)
+from sptag_tpu.utils import locksan, metrics
+
+from tests.test_serve import _ServerThread
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_racesan():
+    locksan.reset_racesan()
+    yield
+    locksan.reset_racesan()
+    locksan.reset_config()
+    locksan.reset_observations()
+
+
+@locksan.race_track
+class _Victim:
+    """Tracked test class — registered once at module import, shimmed
+    only while a test arms the sanitizer."""
+
+    def __init__(self):
+        self.guarded = 0
+
+
+# ---------------------------------------------------------------------------
+# detection semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.racesan_ok
+def test_planted_unguarded_race_detected_with_both_stacks(caplog):
+    locksan.enable()
+    locksan.enable_racesan()
+    v = _Victim()
+    a_wrote = threading.Event()
+    b_wrote = threading.Event()
+
+    def first_writer():
+        v.racy = 1                      # virgin -> exclusive (thread A)
+        a_wrote.set()
+        assert b_wrote.wait(5)
+        v.racy = 3                      # interleaves after B -> RACE
+
+    def second_writer():
+        assert a_wrote.wait(5)
+        v.racy = 2                      # handoff transition: not checked
+        b_wrote.set()
+
+    before = metrics.counter_value("racesan.races")
+    ta = threading.Thread(target=first_writer, name="racer-A")
+    tb = threading.Thread(target=second_writer, name="racer-B")
+    with caplog.at_level("ERROR", logger="sptag_tpu.utils.locksan"):
+        ta.start()
+        tb.start()
+        ta.join(10)
+        tb.join(10)
+    assert locksan.race_count() == 1
+    assert metrics.counter_value("racesan.races") == before + 1
+    rec = locksan.races()[0]
+    assert rec["class"] == "_Victim" and rec["attr"] == "racy"
+    # BOTH stacks ride on the record: the previous conflicting write
+    # and the one that closed the race
+    assert "second_writer" in rec["prev_stack"]
+    assert "first_writer" in rec["stack"]
+    assert rec["prev_thread"] == "racer-B" and rec["thread"] == "racer-A"
+    msgs = [r.getMessage() for r in caplog.records
+            if "data race" in r.getMessage()]
+    assert msgs and "previous write" in msgs[0] and \
+        "this write" in msgs[0]
+
+
+def test_lock_protected_hammer_stays_clean_and_lockset_observed():
+    locksan.enable()
+    locksan.enable_racesan()
+    v = _Victim()
+    lk = locksan.make_lock("VictimGuard")
+
+    def hammer():
+        for _ in range(200):
+            with lk:
+                v.guarded += 1
+
+    ts = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert locksan.race_count() == 0
+    obs = locksan.observed_locksets()
+    rec = obs[("_Victim", "guarded")]
+    assert len(rec["threads"]) >= 2
+    assert rec["lockset"] == {"VictimGuard"}
+
+
+@pytest.mark.racesan_ok
+def test_strict_mode_raises_data_race_error():
+    locksan.enable()
+    locksan.enable_racesan(strict=True)
+    v = _Victim()
+    step1 = threading.Event()
+    step2 = threading.Event()
+    raised = []
+
+    def a():
+        v.racy = 1
+        step1.set()
+        assert step2.wait(5)
+        try:
+            v.racy = 3
+        except locksan.DataRaceError as e:
+            raised.append(e)
+
+    def b():
+        assert step1.wait(5)
+        v.racy = 2
+        step2.set()
+
+    ta, tb = threading.Thread(target=a), threading.Thread(target=b)
+    ta.start(); tb.start()
+    ta.join(10); tb.join(10)
+    assert raised and "racy" in str(raised[0])
+    # the write itself landed — the raise is the report, not a rollback
+    assert v.racy == 3
+
+
+def test_ownership_handoff_is_not_a_race():
+    """Built on main, mutated by exactly one worker forever after: the
+    spawn edge synchronizes the transfer and no race fires even though
+    neither side holds a lock."""
+    locksan.enable()
+    locksan.enable_racesan()
+    v = _Victim()
+    v.state = "built"                     # main thread
+
+    def worker():
+        for i in range(50):
+            v.state = i                   # sole writer from now on
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(10)
+    assert locksan.race_count() == 0
+
+
+def test_sampling_rate_is_deterministic_per_thread():
+    locksan.enable()
+    locksan.enable_racesan(sample_rate=0.25)      # record every 4th
+    v = _Victim()
+    before = locksan.racesan_counters()["writes_recorded"]
+
+    def writer():                          # fresh thread: tick starts 0
+        for i in range(16):
+            v.ticked = i
+
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join(10)
+    assert locksan.racesan_counters()["writes_recorded"] == before + 4
+    # rate 0 records nothing
+    locksan.reset_racesan()
+    locksan.enable_racesan(sample_rate=0.0)
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join(10)
+    assert locksan.racesan_counters()["writes_recorded"] == 0
+
+
+@pytest.mark.skipif(bool(os.environ.get("SPTAG_RACESAN")),
+                    reason="install-state assertions need the default "
+                           "(unarmed) environment")
+def test_enable_disable_install_semantics():
+    assert "__setattr__" not in _Victim.__dict__
+    locksan.enable_racesan()
+    assert "__setattr__" in _Victim.__dict__
+
+    # classes registered AFTER arming are shimmed on the spot
+    @locksan.race_track
+    class Late:
+        pass
+    assert "__setattr__" in Late.__dict__
+    locksan.disable_racesan()
+    assert "__setattr__" not in _Victim.__dict__
+    assert "__setattr__" not in Late.__dict__
+    # a subclass of a tracked class inherits the shim, and instance
+    # behavior is unchanged either way
+    locksan.enable_racesan()
+
+    class Sub(_Victim):
+        pass
+    s = Sub()
+    s.extra = 1
+    assert s.extra == 1
+    assert locksan.racesan_counters()["writes_recorded"] >= 1
+
+
+def test_ini_knobs_arm_both_tiers(tmp_path):
+    ini = tmp_path / "svc.ini"
+    ini.write_text(
+        "[Service]\n"
+        "RaceSanitizer=1\n"
+        "RaceSanSampleRate=0.25\n")
+    ctx = ServiceContext.from_ini(str(ini))
+    assert ctx.settings.race_sanitizer
+    assert ctx.settings.racesan_sample_rate == 0.25
+    assert locksan.racesan_enabled()
+    assert "__setattr__" in _Victim.__dict__
+    locksan.reset_racesan()
+    agg_ini = tmp_path / "agg.ini"
+    agg_ini.write_text("[Service]\nRaceSanitizer=strict\n")
+    actx = AggregatorContext.from_ini(str(agg_ini))
+    assert actx.race_sanitizer
+    assert locksan.racesan_enabled() and locksan.racesan_strict()
+    # defaults stay off
+    locksan.reset_racesan()
+    assert ServiceSettings().race_sanitizer is False
+    assert AggregatorContext().race_sanitizer is False
+
+
+# ---------------------------------------------------------------------------
+# off-path: zero work, byte parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(bool(os.environ.get("SPTAG_RACESAN")),
+                    reason="off-path parity needs the default (unarmed) "
+                           "environment")
+def test_racesan_off_parity_serve_bytes_and_untouched_classes():
+    """With RaceSanitizer at its default (off), every registered hot
+    class is completely untouched — not even a flag test on the write
+    path — zero writes are recorded, and the serve tier's wire bytes
+    are byte-identical to the reference layout (the ci_check.sh
+    standalone parity pass)."""
+    from sptag_tpu.algo.scheduler import BeamSlotScheduler
+    from sptag_tpu.core.delta import DeltaShard
+    from sptag_tpu.core.index import VectorIndex
+    from sptag_tpu.parallel.sharded import ServingAdapter
+    from sptag_tpu.serve.admission import AdmissionController
+    from sptag_tpu.serve.aggregator import AggregatorService
+
+    assert not locksan.racesan_enabled()
+    for cls in (VectorIndex, BeamSlotScheduler, DeltaShard,
+                ServingAdapter, AdmissionController, AggregatorService):
+        assert "__setattr__" not in cls.__dict__, cls
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((50, 8)).astype(np.float32)
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("main", index)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        qtext = "|".join(str(x) for x in data[7])
+        expected_result = SearchExecutor(ctx).execute(qtext)
+        expected_result.request_id = ""
+        expected_body = expected_result.pack()
+        expected = wire.PacketHeader(
+            wire.PacketType.SearchResponse, wire.PacketProcessStatus.Ok,
+            len(expected_body), 1, 77).pack() + expected_body
+        body = wire.RemoteQuery(qtext).pack()
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, 77).pack() + body)
+        s.settimeout(10)
+        got = b""
+        while len(got) < len(expected):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        s.close()
+        assert got == expected
+        c = locksan.racesan_counters()
+        assert c["enabled"] == 0 and c["writes_recorded"] == 0 and \
+            c["races"] == 0
+    finally:
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# static/runtime guard cross-check (the ISSUE 12 acceptance)
+# ---------------------------------------------------------------------------
+
+def _suffix_match(canonical: str, runtime_name: str) -> bool:
+    return canonical == runtime_name or \
+        canonical.endswith("." + runtime_name)
+
+
+def test_static_guard_inference_agrees_with_runtime_locksets(tmp_path):
+    """Drive a BKT mutate-under-load workload (delta-shard adds + a
+    background refine/swap + concurrent searchers) with the race
+    sanitizer armed, then check BOTH directions of the contract:
+
+    * the workload is race-free (racesan.races == 0 — the armed-smoke
+      acceptance);
+    * every attribute the sanitizer saw written by MULTIPLE threads
+      under a surviving lockset has a statically inferred guard that
+      names one of those locks — i.e. guardedby.infer_guards() and the
+      runtime agree on WHO protects the index's shared state.
+    """
+    from tools.graftlint import guardedby
+    from tools.graftlint.core import Project
+
+    locksan.enable(strict=True)
+    locksan.enable_racesan()
+    locksan.reset_observations()
+
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((256, 16)).astype(np.float32)
+    index = sp.create_instance("BKT", "Float")
+    for name, value in [("DistCalcMethod", "L2"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "64"),
+                        ("NeighborhoodSize", "8"), ("CEF", "32"),
+                        ("MaxCheck", "256"), ("RefineIterations", "1"),
+                        ("Samples", "64"), ("AddCountForRebuild", "32"),
+                        ("DeltaShardCapacity", "128"),
+                        ("AutoRefineThreshold", "64")]:
+        index.set_parameter(name, value)
+    assert index.build(data) == sp.ErrorCode.Success
+
+    stop = threading.Event()
+    errors = []
+
+    def searcher():
+        q = rng.standard_normal((4, 16)).astype(np.float32)
+        while not stop.is_set():
+            try:
+                index.search_batch(q, 5)
+            except Exception as e:            # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=searcher, name=f"xchk-s{i}")
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(0, 128, 32):
+            extra = rng.standard_normal((32, 16)).astype(np.float32)
+            assert index.add(extra) == sp.ErrorCode.Success
+        index.wait_for_rebuild(30)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    index.close()
+    assert not errors, errors
+    assert locksan.race_count() == 0, locksan.races()
+
+    observed = locksan.observed_locksets()
+    multi = {k: v for k, v in observed.items()
+             if len(v["threads"]) >= 2 and v["lockset"]}
+    # the workload really produced cross-thread guarded writes
+    assert any("VectorIndex._lock" in v["lockset"]
+               for v in multi.values()), observed
+
+    guards = guardedby.infer_guards(
+        Project.from_tree(os.path.join(REPO, "sptag_tpu")))
+    by_attr = {}
+    for (dotted_cls, attr), g in guards.items():
+        by_attr.setdefault(attr, []).append((dotted_cls, g))
+
+    checked = 0
+    for (cls, attr), rec in multi.items():
+        cands = by_attr.get(attr)
+        if not cands:
+            continue                   # attr invisible statically
+        agree = any(
+            any(_suffix_match(c, name)
+                for c in g for name in rec["lockset"])
+            for _dc, g in cands if g)
+        assert agree, (
+            f"runtime saw `{cls}.{attr}` consistently written under "
+            f"{sorted(rec['lockset'])} but the static inference has "
+            f"guards {cands}")
+        checked += 1
+    assert checked >= 1, (multi, "nothing cross-checked")

@@ -9,9 +9,9 @@ set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
-# the full suite includes the GL7xx pass (lock-order / blocking-under-
-# lock / async hazards / handle leaks); `--select GL7` scopes a rerun
-echo "== graftlint (GL1xx-GL7xx) =="
+# the full suite includes the GL7xx lock-order pass and the GL8xx
+# guarded-by pass; `--select GL7` / `--select GL8` scope a rerun
+echo "== graftlint (GL1xx-GL8xx) =="
 python -m tools.graftlint sptag_tpu/
 
 if [[ "${1:-}" == "--lint-only" ]]; then
@@ -128,6 +128,30 @@ python -m tools.graftlint sptag_tpu/ --select GL607
 echo "== mesh serve off: serve byte parity (standalone) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_serve.py -q \
     -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 12 lint gate, standalone: guarded-by inference (GL801-805
+# fixed or justified, GL806 plain-lock migration) — an unguarded write
+# to epoch-swapped serving state is the bug class every later roadmap
+# item (autotuner, tiered pipeline) would otherwise ship
+echo "== GL8 guarded-by / race lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL8
+
+# the ISSUE 12 runtime gate, standalone: with RaceSanitizer off (the
+# default) the tracked hot classes are completely untouched and the
+# serve tier's wire bytes stay byte-identical
+echo "== race sanitizer off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_racesan.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 12 armed smoke: mutation + epoch-swap + scheduler tests
+# under SPTAG_RACESAN=1 — the conftest per-test probe fails any test
+# that observes a data race, so a green run IS racesan.races == 0; the
+# static/runtime guard cross-check rides in test_racesan.py
+echo "== racesan-armed smoke (mutate/swap/scheduler, races must be 0) =="
+env JAX_PLATFORMS=cpu SPTAG_RACESAN=1 python -m pytest \
+    tests/test_mutation.py tests/test_concurrent.py \
+    tests/test_beam_segmented.py tests/test_racesan.py -q \
+    -p no:cacheprovider -m 'not slow'
 
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment

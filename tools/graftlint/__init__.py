@@ -1,17 +1,27 @@
 """graftlint — TPU/JAX static-analysis suite for sptag_tpu.
 
-Five checker families, each its own module with documented rule ids:
+Checker families, each its own module with documented rule ids:
 
 * GL1xx  hostsync       host<->device syncs on the jitted paths
 * GL2xx  retrace        recompile-per-value / per-shape hazards
 * GL3xx  concurrency    unlocked shared mutation, late-binding captures
 * GL4xx  errorpath      swallowed exceptions at the ErrorCode boundaries
 * GL5xx  dtype_parity   integer distance paths upcasting before the dot
+* GL6xx  obsnames/cost  literal metric/span/stage names, cost-ledger
+                        registration for jitted kernels
+* GL7xx  lockgraph      lock-order cycles, blocking under a held lock,
+                        leaked thread/task handles (+ GL41x persistence
+                        writes outside the atomic/WAL funnel)
+* GL8xx  guardedby      guarded-by inference: unguarded/inconsistent
+                        writes to shared state, epoch-repin,
+                        escape-before-publish, plain locks invisible
+                        to the locksan runtime
 
 Run `python -m tools.graftlint sptag_tpu/` from the repo root; accepted
 findings live in `baseline.toml` (every entry justified).  The runtime
-complement — asserting ZERO recompiles after warmup — is
-`sptag_tpu/utils/recompile_guard.py`.
+complements are `sptag_tpu/utils/recompile_guard.py` (zero recompiles
+after warmup) and `sptag_tpu/utils/locksan.py` (lock-order sanitizer,
+contention ledger, Eraser-style race sanitizer).
 """
 
 from tools.graftlint.core import Finding, Project  # noqa: F401

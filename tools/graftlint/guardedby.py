@@ -1,0 +1,921 @@
+"""GL80x — static guarded-by inference (lockset race detection).
+
+PR 3's GL7xx pass proves locks are acquired in a safe ORDER; nothing
+checked that shared state is accessed under any lock at all.  PRs 9 and
+11 made that the scariest surface in the codebase: epoch-swapped engines
+and schedulers, delta-shard tails, WAL handles and mesh placements are
+mutated by background refine/swap threads while reader threads pin them
+lock-free.  This checker infers, per class attribute (and per module
+global), WHICH lock guards it — from the locks actually held at its
+write sites — and then reports writes that break the inferred contract.
+
+The pass reuses lockgraph's project-wide LockModel (lock inventory
+canonicalized through class ancestry, `self.<attr> = Class()` attr
+types, call resolution) and adds:
+
+* a THREAD-ENTRY set: every callable handed to ``threading.Thread(
+  target=)`` / ``Timer``, a ``ThreadPool.add``/``submit`` job,
+  ``run_in_executor``, ``asyncio.create_task``/``ensure_future``/
+  ``call_soon*`` or an ``asyncio.start_server`` handler — plus
+  everything reachable from those through the call graph.  An attribute
+  is SHARED when a thread-reachable function touches it; attributes only
+  the constructing thread sees are never reported;
+* an interprocedural HELD-ON-ENTRY fixpoint: a helper called only while
+  ``self._lock`` is held counts its writes as guarded (must-hold:
+  intersection over all call sites; a thread entry point holds nothing).
+
+Rules:
+
+* GL801 — unguarded write to a shared attribute: a guard exists (the
+  intersection of locks held at the attribute's locked write sites is
+  non-empty) but THIS write holds it on no interprocedural path.
+* GL802 — unguarded read-modify-write of a shared attribute: ``x += 1``,
+  ``self.d[k] = v``, ``self.seen.add(k)`` and friends with no lock held
+  — lost updates even when every individual write is atomic in CPython.
+* GL803 — inconsistent guards: the attribute's locked write sites hold
+  DISJOINT locks (two writers each think their lock protects it).
+* GL804 — epoch-pin violation: a swappable attribute (re-published at
+  runtime by a background thread, e.g. ``self._engine``/``self._impl``)
+  is re-read lock-free more than once in a single call instead of being
+  pinned to a local — the reader can observe two different epochs
+  mid-call, the exact bug class PR 9's ``_get_engine`` fix closed.
+* GL805 — escaping before publish: ``self`` (or a bound method) is
+  handed to a thread/task/callback inside ``__init__`` while later
+  statements still assign attributes — the spawned code can observe a
+  partially-built object.
+* GL806 — a plain ``threading.Lock()``/``RLock()``/argless
+  ``Condition()`` in sptag_tpu code: invisible to locksan's order
+  sanitizer, contention ledger AND race sanitizer — use
+  ``locksan.make_lock(name)``.  (``Condition(self._lock)`` wrapping a
+  named lock is fine and is canonicalized to the wrapped lock.)
+
+The runtime complement is the Eraser-style race sanitizer in
+sptag_tpu/utils/locksan.py (``SPTAG_RACESAN=1``); tests/test_racesan.py
+cross-checks this module's ``infer_guards()`` against the locksets a
+live mutate-under-load workload actually held.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (
+    Finding,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+    _dotted,
+)
+from tools.graftlint.lockgraph import (
+    LockModel,
+    _resolve_target,
+    get_model,
+)
+
+RULES = {
+    "GL801": "unguarded write to a shared attribute whose inferred "
+             "guard is held at its other write sites",
+    "GL802": "unguarded read-modify-write of a shared attribute "
+             "(compound update with no lock held)",
+    "GL803": "inconsistent guards: attribute written under two "
+             "disjoint locks",
+    "GL804": "swappable attribute re-read mid-call instead of pinned "
+             "to a local (epoch-pin violation)",
+    "GL805": "self escapes to a thread/task/callback before __init__ "
+             "completes",
+    "GL806": "plain threading lock invisible to the locksan runtime "
+             "(use locksan.make_lock)",
+}
+
+#: call leaves that hand a callable to ANOTHER OS THREAD — writes
+#: reachable from these can race with everything
+_THREAD_LEAVES = {"Thread", "Timer", "add", "submit", "apply_async",
+                  "run_in_executor"}
+#: call leaves that schedule a callable on an asyncio EVENT LOOP — one
+#: logical thread: coroutines interleave only at `await`, so their
+#: writes race with thread-side writes but not with each other (the
+#: cross-await hazards are GL7xx/asyncrules territory)
+_ASYNC_LEAVES = {"create_task", "ensure_future", "call_soon",
+                 "call_soon_threadsafe", "call_later", "start_server"}
+_SPAWN_LEAVES = _THREAD_LEAVES | _ASYNC_LEAVES
+#: keyword names that carry the callable at those call sites
+_SPAWN_KWARGS = ("target", "func", "fn", "callback", "job")
+
+#: method leaves that mutate their receiver in place
+_MUTATOR_LEAVES = {"append", "appendleft", "extend", "extendleft",
+                   "insert", "add", "update", "setdefault", "pop",
+                   "popitem", "remove", "discard", "clear"}
+
+#: attributes everyone may write lock-free: per-instance constants
+#: assigned once.  (Heuristic escape hatch is the baseline, not this.)
+_INIT_ONLY = "__init__"
+
+
+# ---------------------------------------------------------------------------
+# per-function scan
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    line: int
+    fn: FunctionInfo
+    held: FrozenSet[str]          # syntactic only; H(fn) added later
+    compound: bool                # RMW / container mutation
+    is_init: bool
+
+
+@dataclasses.dataclass
+class _Scan:
+    fn: FunctionInfo
+    writes: List[_Write] = dataclasses.field(default_factory=list)
+    #: attr -> [(line, held)]
+    reads: Dict[str, List[Tuple[int, FrozenSet[str]]]] = \
+        dataclasses.field(default_factory=dict)
+    #: (call_node, held, line)
+    calls: List[Tuple[ast.Call, FrozenSet[str], int]] = \
+        dataclasses.field(default_factory=list)
+    #: module-global writes: (name, line, held, compound)
+    gwrites: List[Tuple[str, int, FrozenSet[str], bool]] = \
+        dataclasses.field(default_factory=list)
+
+
+class _Pass:
+    def __init__(self, project: Project):
+        self.project = project
+        self.model: LockModel = get_model(project)
+        #: class key -> {cond_attr: wrapped_lock_attr} from
+        #: `self.A = threading.Condition(self.B)`
+        self.cond_alias: Dict[Tuple[str, str], Dict[str, str]] = {}
+        #: modpath -> {cond_name: wrapped_lock_name}
+        self.mod_cond_alias: Dict[str, Dict[str, str]] = {}
+        self.scans: Dict[int, _Scan] = {}
+        self.entries: Set[int] = set()          # thread + async entries
+        self.thread_entries: Set[int] = set()
+        self.reachable: Set[int] = set()        # thread-reachable
+        self.async_reachable: Set[int] = set()
+        self.held_entry: Dict[int, Optional[Set[str]]] = {}
+        #: class key -> direct subclasses (reverse of class_bases)
+        self.subclasses: Dict[Tuple[str, str],
+                              List[Tuple[str, str]]] = {}
+        for key, bases in self.model.class_bases.items():
+            for b in bases:
+                self.subclasses.setdefault(b, []).append(key)
+        self._build_aliases()
+        self._scan_all()
+        self._find_entries()
+        self._fixpoint_held_entry()
+
+    # ------------------------------------------------------------ aliases
+
+    def _build_aliases(self) -> None:
+        for mp, mod in self.project.by_modpath.items():
+            maliases: Dict[str, str] = {}
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and node.value.args:
+                    if _resolve_target(node.value.func, mod) == \
+                            "threading.Condition":
+                        src = _dotted(node.value.args[0])
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name) and src:
+                                maliases[tgt.id] = src
+            self.mod_cond_alias[mp] = maliases
+            for key, nodes in self.model.method_nodes.items():
+                if key[0] != mp:
+                    continue
+                aliases: Dict[str, str] = {}
+                for m in nodes:
+                    for node in ast.walk(m):
+                        if not (isinstance(node, ast.Assign)
+                                and isinstance(node.value, ast.Call)
+                                and node.value.args):
+                            continue
+                        if _resolve_target(node.value.func, mod) != \
+                                "threading.Condition":
+                            continue
+                        src = _dotted(node.value.args[0])
+                        if not (src and src.startswith("self.")):
+                            continue
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Attribute) and \
+                                    isinstance(tgt.value, ast.Name) and \
+                                    tgt.value.id == "self":
+                                aliases[tgt.attr] = src.split(".", 1)[1]
+                self.cond_alias[key] = aliases
+
+    def _held_name(self, fn: FunctionInfo, expr: ast.AST) -> Optional[str]:
+        """Canonical lock id for a `with` context expr, resolving
+        Condition wrappers to the lock they wrap."""
+        d = _dotted(expr)
+        if d is not None:
+            key = self.model.class_of_fn.get(id(fn))
+            parts = d.split(".")
+            if parts[0] == "self" and len(parts) == 2 and key is not None:
+                alias = self.cond_alias.get(key, {}).get(parts[1])
+                if alias:
+                    expr = ast.Attribute(
+                        value=ast.Name(id="self", ctx=ast.Load()),
+                        attr=alias, ctx=ast.Load())
+                    ast.copy_location(expr, ast.Name(id="self"))
+            elif len(parts) == 1:
+                mp = self.model.modpath_of.get(id(fn.module))
+                alias = self.mod_cond_alias.get(mp or "", {}).get(parts[0])
+                if alias:
+                    expr = ast.Name(id=alias, ctx=ast.Load())
+        lock = self.model.resolve_lock_expr(fn, expr)
+        return lock.canonical if lock is not None else None
+
+    # --------------------------------------------------------------- scan
+
+    def _scan_all(self) -> None:
+        for mod in self.project.modules.values():
+            for fn in mod.functions:
+                self.scans[id(fn)] = self._scan_fn(fn)
+
+    def _scan_fn(self, fn: FunctionInfo) -> _Scan:
+        scan = _Scan(fn)
+        nested = {f.node for f in fn.module.functions if f.parent is fn}
+        is_init = fn.name == _INIT_ONLY
+        gnames: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Global):
+                gnames.update(node.names)
+
+        def self_attr(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            return None
+
+        def note_write(attr: str, line: int, held: List[str],
+                       compound: bool) -> None:
+            scan.writes.append(_Write(attr, line, fn,
+                                      frozenset(held), compound, is_init))
+
+        def visit(node: ast.AST, held: List[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if child in nested:
+                    continue
+                now = held
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    acquired: List[str] = []
+                    for item in child.items:
+                        c = self._held_name(fn, item.context_expr)
+                        if c is not None and c not in held + acquired:
+                            acquired.append(c)
+                    if acquired:
+                        now = held + acquired
+                # ---- writes -------------------------------------------
+                if isinstance(child, ast.Assign):
+                    # `self.x = f(self.x)` is a check-then-set RMW, not
+                    # an atomic publish
+                    rhs_reads = {self_attr(n)
+                                 for n in ast.walk(child.value)
+                                 if isinstance(n, ast.Attribute)
+                                 and isinstance(n.ctx, ast.Load)}
+                    for tgt in child.targets:
+                        tgts = tgt.elts if isinstance(
+                            tgt, (ast.Tuple, ast.List)) else [tgt]
+                        for t in tgts:
+                            a = self_attr(t)
+                            if a is not None:
+                                note_write(a, child.lineno, now,
+                                           a in rhs_reads)
+                            elif isinstance(t, ast.Subscript):
+                                a = self_attr(t.value)
+                                if a is not None:
+                                    note_write(a, child.lineno, now, True)
+                            elif isinstance(t, ast.Name) and \
+                                    t.id in gnames:
+                                scan.gwrites.append(
+                                    (t.id, child.lineno,
+                                     frozenset(now), False))
+                elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                    t = child.target
+                    a = self_attr(t)
+                    compound = isinstance(child, ast.AugAssign)
+                    if a is not None and child.value is not None:
+                        note_write(a, child.lineno, now, compound)
+                    elif isinstance(t, ast.Subscript):
+                        a = self_attr(t.value)
+                        if a is not None:
+                            note_write(a, child.lineno, now, True)
+                    elif isinstance(t, ast.Name) and t.id in gnames and \
+                            child.value is not None:
+                        scan.gwrites.append((t.id, child.lineno,
+                                             frozenset(now), compound))
+                elif isinstance(child, ast.Delete):
+                    for t in child.targets:
+                        if isinstance(t, ast.Subscript):
+                            a = self_attr(t.value)
+                            if a is not None:
+                                note_write(a, child.lineno, now, True)
+                # ---- container-mutating method calls ------------------
+                if isinstance(child, ast.Call) and \
+                        isinstance(child.func, ast.Attribute) and \
+                        child.func.attr in _MUTATOR_LEAVES:
+                    a = self_attr(child.func.value)
+                    if a is not None:
+                        note_write(a, child.lineno, now, True)
+                # ---- reads --------------------------------------------
+                if isinstance(child, ast.Attribute) and \
+                        isinstance(child.ctx, ast.Load):
+                    a = self_attr(child)
+                    if a is not None:
+                        scan.reads.setdefault(a, []).append(
+                            (child.lineno, frozenset(now)))
+                # ---- calls --------------------------------------------
+                if isinstance(child, ast.Call):
+                    scan.calls.append((child, frozenset(now),
+                                       child.lineno))
+                visit(child, now)
+
+        visit(fn.node, [])
+        return scan
+
+    # --------------------------------------------------- call resolution
+
+    def _class_family(self, key: Tuple[str, str]) -> List[Tuple[str, str]]:
+        """Ancestors + descendants of `key` (the dynamic-dispatch set a
+        `self.m()` call can land in)."""
+        fam = list(self.model.ancestry(key))
+        todo = [key]
+        seen = set(fam)
+        while todo:
+            k = todo.pop()
+            for sub in self.subclasses.get(k, ()):
+                if sub not in seen:
+                    seen.add(sub)
+                    fam.append(sub)
+                    todo.append(sub)
+        return fam
+
+    def _methods_in_hierarchy(self, key: Tuple[str, str],
+                              name: str) -> List[FunctionInfo]:
+        out: List[FunctionInfo] = []
+        for k in self._class_family(key):
+            tmod = self.project.by_modpath.get(k[0])
+            nodes = self.model.method_nodes.get(k, set())
+            if tmod is not None:
+                out.extend(g for g in tmod.functions_named(name)
+                           if g.node in nodes)
+        return out
+
+    def resolve_calls(self, call: ast.Call,
+                      fn: FunctionInfo) -> List[FunctionInfo]:
+        """lockgraph's resolution plus cross-MODULE `self.m()` dispatch:
+        `VectorIndex.build` (core/index.py) calling `self._build` must
+        resolve to the BKTIndex/KDTIndex overrides in algo/ — otherwise
+        every template-method `_impl` looks caller-less and its
+        held-on-entry locks are lost."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self":
+            key = self.model.class_of_fn.get(id(fn))
+            if key is not None:
+                out = self._methods_in_hierarchy(key, f.attr)
+                if out:
+                    return out
+        return self.model.resolve_calls(call, fn)
+
+    # ------------------------------------------------------ thread entries
+
+    def _entry_candidates(self, call: ast.Call,
+                          fn: FunctionInfo) -> List[ast.AST]:
+        f = call.func
+        d = _dotted(f)
+        leaf = d.split(".")[-1] if d else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if leaf not in _SPAWN_LEAVES:
+            return []
+        cands: List[ast.AST] = [kw.value for kw in call.keywords
+                                if kw.arg in _SPAWN_KWARGS]
+        if leaf in ("add", "submit", "apply_async", "create_task",
+                    "ensure_future", "call_soon", "call_soon_threadsafe",
+                    "start_server") and call.args:
+            cands.append(call.args[0])
+        elif leaf in ("Timer", "call_later") and len(call.args) >= 2:
+            cands.append(call.args[1])
+        elif leaf == "run_in_executor" and len(call.args) >= 2:
+            cands.append(call.args[1])
+        elif leaf == "Thread" and len(call.args) >= 2:
+            cands.append(call.args[1])
+        return cands
+
+    def _resolve_callable(self, expr: ast.AST,
+                          fn: FunctionInfo) -> List[FunctionInfo]:
+        if isinstance(expr, ast.Call):
+            # create_task(self._loop()) — the coroutine function
+            return self._resolve_callable(expr.func, fn)
+        d = _dotted(expr)
+        mod = fn.module
+        if d is None:
+            # functools.partial(self._job, x) — unwrap arg 0
+            if isinstance(expr, ast.Lambda):
+                return []          # lambda bodies run inline; skip
+            return []
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            key = self.model.class_of_fn.get(id(fn))
+            if key is not None:
+                out: List[FunctionInfo] = []
+                for k in self.model.ancestry(key):
+                    tmod = self.project.by_modpath.get(k[0])
+                    nodes = self.model.method_nodes.get(k, set())
+                    if tmod is not None:
+                        out.extend(g for g in tmod.functions_named(parts[1])
+                                   if g.node in nodes)
+                if out:
+                    return out
+            return mod.functions_named(parts[1])
+        if len(parts) == 1:
+            local = mod.functions_named(d)
+            if local:
+                # prefer a nested def in the spawning function
+                mine = [g for g in local if g.parent is fn]
+                return mine or local
+            target = mod.from_imports.get(d)
+            if target and target.startswith(self.project.package_root):
+                modpath, _, sym = target.rpartition(".")
+                tmod = self.project.by_modpath.get(modpath)
+                if tmod:
+                    return tmod.functions_named(sym)
+        if len(parts) == 2:
+            full = mod.resolve_head(parts[0])
+            if full and full in self.project.by_modpath:
+                return self.project.by_modpath[full].functions_named(
+                    parts[1])
+        return []
+
+    def _closure(self, seeds: Set[int]) -> Set[int]:
+        todo = list(seeds)
+        out = set(seeds)
+        while todo:
+            k = todo.pop()
+            scan = self.scans.get(k)
+            if scan is None:
+                continue
+            for call, _h, _l in scan.calls:
+                for callee in self.resolve_calls(call, scan.fn):
+                    ck = id(callee)
+                    if ck in self.scans and ck not in out:
+                        out.add(ck)
+                        todo.append(ck)
+        return out
+
+    def _find_entries(self) -> None:
+        async_entries: Set[int] = set()
+        for scan in self.scans.values():
+            for call, _held, _line in scan.calls:
+                f = call.func
+                d = _dotted(f)
+                leaf = d.split(".")[-1] if d else (
+                    f.attr if isinstance(f, ast.Attribute) else "")
+                for cand in self._entry_candidates(call, scan.fn):
+                    # functools.partial(self._job, ...): unwrap
+                    if isinstance(cand, ast.Call):
+                        cd = _dotted(cand.func) or ""
+                        if cd.split(".")[-1] == "partial" and cand.args:
+                            cand = cand.args[0]
+                    for g in self._resolve_callable(cand, scan.fn):
+                        self.entries.add(id(g))
+                        if leaf in _THREAD_LEAVES:
+                            self.thread_entries.add(id(g))
+                        else:
+                            async_entries.add(id(g))
+        self.reachable = self._closure(self.thread_entries)
+        self.async_reachable = self._closure(async_entries)
+
+    # --------------------------------------------- held-on-entry fixpoint
+
+    def _fixpoint_held_entry(self) -> None:
+        callers: Dict[int, List[Tuple[int, FrozenSet[str]]]] = {}
+        for scan in self.scans.values():
+            for call, held, _line in scan.calls:
+                for callee in self.resolve_calls(call, scan.fn):
+                    ck = id(callee)
+                    if ck in self.scans and ck != id(scan.fn):
+                        callers.setdefault(ck, []).append(
+                            (id(scan.fn), held))
+            # a BOUND-METHOD REFERENCE (`self.m` read without a call —
+            # the _blob_loaders() dispatch-table idiom) is treated as a
+            # potential call from the referencing context.  Spawn
+            # targets are unaffected: they are entries, and entries are
+            # pinned to an empty held-set below.
+            key = self.model.class_of_fn.get(id(scan.fn))
+            if key is None:
+                continue
+            for attr, sites in scan.reads.items():
+                methods = self._methods_in_hierarchy(key, attr)
+                for m in methods:
+                    mk = id(m)
+                    if mk in self.scans and mk != id(scan.fn):
+                        for _line, held in sites:
+                            callers.setdefault(mk, []).append(
+                                (id(scan.fn), held))
+        H: Dict[int, Optional[Set[str]]] = {k: None for k in self.scans}
+        for k in self.scans:
+            # thread entries hold nothing on entry; so do functions with
+            # no resolvable caller (the public-API / unknown case)
+            if k in self.entries or k not in callers:
+                H[k] = set()
+        changed = True
+        while changed:
+            changed = False
+            for k, sites in callers.items():
+                if k in self.entries:
+                    continue
+                acc: Optional[Set[str]] = None
+                for caller_id, held in sites:
+                    hc = H.get(caller_id)
+                    if hc is None:
+                        continue          # TOP caller: no constraint yet
+                    eff = set(held) | hc
+                    acc = eff if acc is None else (acc & eff)
+                if acc is not None and acc != H[k]:
+                    if H[k] is None or acc < H[k]:
+                        H[k] = acc
+                        changed = True
+        self.held_entry = H
+
+    def effective_held(self, w_fn: FunctionInfo,
+                       held: FrozenSet[str]) -> FrozenSet[str]:
+        h = self.held_entry.get(id(w_fn))
+        return held if not h else frozenset(held | h)
+
+    # ----------------------------------------------------------- grouping
+
+    def grouped_attrs(self) -> Dict[Tuple[Tuple[str, str], str],
+                                    List[_Write]]:
+        """Write sites grouped by (owner class key, attr), where owner is
+        the most ancestral class in the writer's ancestry that touches
+        the attribute — so `BKTIndex` and `VectorIndex` writes to one
+        attribute form ONE group."""
+        per_class: Dict[Tuple[str, str], Dict[str, List[_Write]]] = {}
+        for scan in self.scans.values():
+            key = self.model.class_of_fn.get(id(scan.fn))
+            if key is None:
+                continue
+            slot = per_class.setdefault(key, {})
+            for w in scan.writes:
+                slot.setdefault(w.attr, []).append(w)
+        grouped: Dict[Tuple[Tuple[str, str], str], List[_Write]] = {}
+        seen: Set[Tuple[int, int]] = set()
+        for key, attrs in per_class.items():
+            for attr, writes in attrs.items():
+                owner = key
+                for k in self.model.ancestry(key):
+                    if attr in per_class.get(k, {}):
+                        owner = k
+                group = grouped.setdefault((owner, attr), [])
+                for w in writes:
+                    wid = (id(w.fn), w.line)
+                    if (wid + (hash(attr),)) not in seen:
+                        seen.add(wid + (hash(attr),))
+                        group.append(w)
+        return grouped
+
+    def grouped_reads(self) -> Dict[Tuple[Tuple[str, str], str],
+                                    Dict[int, List[Tuple[int,
+                                                         FrozenSet[str]]]]]:
+        """(owner, attr) -> {fn_id: [(line, held)]} using the same owner
+        resolution as grouped_attrs."""
+        per_class_w: Dict[Tuple[str, str], Set[str]] = {}
+        for scan in self.scans.values():
+            key = self.model.class_of_fn.get(id(scan.fn))
+            if key is None:
+                continue
+            per_class_w.setdefault(key, set()).update(
+                w.attr for w in scan.writes)
+        out: Dict[Tuple[Tuple[str, str], str],
+                  Dict[int, List[Tuple[int, FrozenSet[str]]]]] = {}
+        for scan in self.scans.values():
+            key = self.model.class_of_fn.get(id(scan.fn))
+            if key is None:
+                continue
+            for attr, sites in scan.reads.items():
+                owner = key
+                for k in self.model.ancestry(key):
+                    if attr in per_class_w.get(k, set()):
+                        owner = k
+                out.setdefault((owner, attr), {}).setdefault(
+                    id(scan.fn), []).extend(sites)
+        return out
+
+    def thread_reachable(self, fn: FunctionInfo) -> bool:
+        return id(fn) in self.reachable
+
+
+# ---------------------------------------------------------------------------
+# guard inference (public: the runtime cross-check consumes this)
+# ---------------------------------------------------------------------------
+
+def _get_pass(project: Project) -> _Pass:
+    p = getattr(project, "_gl8_pass", None)
+    if p is None or p.project is not project:
+        p = _Pass(project)
+        project._gl8_pass = p
+    return p
+
+
+def infer_guards(project: Project) -> Dict[Tuple[str, str], Set[str]]:
+    """{(dotted class name, attr): inferred guard lock canonicals}.
+
+    The guard of an attribute is the intersection of the locks held at
+    its locked non-``__init__`` write sites (interprocedural held-on-
+    entry included); attributes with no locked write site map to an
+    empty set.  tests/test_racesan.py cross-checks this against the
+    locksets the runtime race sanitizer observed on a live workload.
+    """
+    p = _get_pass(project)
+    out: Dict[Tuple[str, str], Set[str]] = {}
+    for (owner, attr), writes in p.grouped_attrs().items():
+        locked = [p.effective_held(w.fn, w.held)
+                  for w in writes if not w.is_init]
+        locked = [h for h in locked if h]
+        guards: Set[str] = set()
+        if locked:
+            guards = set(locked[0])
+            for h in locked[1:]:
+                guards &= h
+        out[(f"{owner[0]}.{owner[1]}", attr)] = guards
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the checks
+# ---------------------------------------------------------------------------
+
+def _fmt_guard(guards: Set[str]) -> str:
+    return "/".join(sorted(guards))
+
+
+def _check_attr_rules(p: _Pass) -> List[Finding]:
+    out: List[Finding] = []
+    for (owner, attr), writes in sorted(
+            p.grouped_attrs().items(),
+            key=lambda kv: (kv[0][0][0], kv[0][0][1], kv[0][1])):
+        non_init = [w for w in writes if not w.is_init]
+        if not non_init:
+            continue
+        shared = any(p.thread_reachable(w.fn) for w in non_init)
+        if not shared:
+            continue
+        effective = [(w, p.effective_held(w.fn, w.held)) for w in non_init]
+        locked = [(w, h) for w, h in effective if h]
+        unlocked = [(w, h) for w, h in effective if not h]
+        guards: Set[str] = set()
+        if locked:
+            guards = set(locked[0][1])
+            for _w, h in locked[1:]:
+                guards &= h
+        cls = owner[1]
+        # GL803: locked writers disagree about the guard entirely
+        if locked and not guards and len(locked) > 1:
+            seen_locks = sorted({_fmt_guard(set(h)) for _w, h in locked})
+            w0 = min(locked, key=lambda wh: (wh[0].fn.module.relpath,
+                                             wh[0].line))[0]
+            out.append(Finding(
+                "GL803", w0.fn.module.relpath, w0.line,
+                f"`self.{attr}` ({cls}) is written under disjoint locks "
+                f"({'; '.join(seen_locks)}) — the writers do not agree "
+                "on a guard, so neither lock protects it", w0.fn.qualname))
+        # GL801 / GL802 on the unlocked sites
+        for w, _h in unlocked:
+            if w.compound:
+                out.append(Finding(
+                    "GL802", w.fn.module.relpath, w.line,
+                    f"unguarded read-modify-write of shared "
+                    f"`self.{attr}` ({cls}) — a concurrent writer "
+                    "interleaves between the read and the write "
+                    "(lost update)"
+                    + (f"; inferred guard: `{_fmt_guard(guards)}`"
+                       if guards else ""),
+                    w.fn.qualname))
+            elif guards:
+                out.append(Finding(
+                    "GL801", w.fn.module.relpath, w.line,
+                    f"unguarded write to shared `self.{attr}` ({cls}) — "
+                    f"the inferred guard `{_fmt_guard(guards)}` is held "
+                    f"at {len(locked)} other write site(s) but on no "
+                    "interprocedural path here", w.fn.qualname))
+    return out
+
+
+def _check_global_rules(p: _Pass) -> List[Finding]:
+    out: List[Finding] = []
+    groups: Dict[Tuple[str, str],
+                 List[Tuple[FunctionInfo, int, FrozenSet[str], bool]]] = {}
+    for scan in p.scans.values():
+        mp = p.model.modpath_of.get(id(scan.fn.module))
+        if mp is None:
+            continue
+        for name, line, held, compound in scan.gwrites:
+            groups.setdefault((mp, name), []).append(
+                (scan.fn, line, held, compound))
+    for (mp, name), sites in sorted(groups.items()):
+        shared = any(p.thread_reachable(fn) for fn, _l, _h, _c in sites)
+        if not shared:
+            continue
+        effective = [(fn, line, p.effective_held(fn, held), compound)
+                     for fn, line, held, compound in sites]
+        locked = [e for e in effective if e[2]]
+        unlocked = [e for e in effective if not e[2]]
+        guards: Set[str] = set()
+        if locked:
+            guards = set(locked[0][2])
+            for e in locked[1:]:
+                guards &= e[2]
+        for fn, line, _h, compound in unlocked:
+            if compound:
+                out.append(Finding(
+                    "GL802", fn.module.relpath, line,
+                    f"unguarded read-modify-write of module global "
+                    f"`{name}` shared with a thread"
+                    + (f"; inferred guard: `{_fmt_guard(guards)}`"
+                       if guards else ""), fn.qualname))
+            elif guards:
+                out.append(Finding(
+                    "GL801", fn.module.relpath, line,
+                    f"unguarded write to module global `{name}` — the "
+                    f"inferred guard `{_fmt_guard(guards)}` is held at "
+                    f"{len(locked)} other write site(s) but not here",
+                    fn.qualname))
+    return out
+
+
+def _check_epoch_pin(p: _Pass) -> List[Finding]:
+    out: List[Finding] = []
+    reads = p.grouped_reads()
+    for (owner, attr), writes in sorted(
+            p.grouped_attrs().items(),
+            key=lambda kv: (kv[0][0][0], kv[0][0][1], kv[0][1])):
+        init_writes = [w for w in writes if w.is_init]
+        swaps = [w for w in writes
+                 if not w.is_init and not w.compound
+                 and (p.thread_reachable(w.fn)
+                      or p.effective_held(w.fn, w.held))]
+        if not init_writes or not swaps:
+            continue
+        # the attribute must actually be swapped off-thread — a main-
+        # thread-only reassign can't change under a reader's feet
+        if not any(p.thread_reachable(w.fn) for w in swaps):
+            continue
+        guard: Set[str] = set()
+        for w in swaps:
+            guard |= set(p.effective_held(w.fn, w.held))
+        writer_fns = {id(w.fn) for w in writes}
+        for fn_id, sites in sorted(reads.get((owner, attr), {}).items()):
+            scan = p.scans.get(fn_id)
+            if scan is None or fn_id in writer_fns or \
+                    scan.fn.name == _INIT_ONLY:
+                continue
+            free_lines = sorted({line for line, held in sites
+                                 if not (set(p.effective_held(scan.fn,
+                                                              held))
+                                         & guard)})
+            if len(free_lines) >= 2:
+                out.append(Finding(
+                    "GL804", scan.fn.module.relpath, free_lines[1],
+                    f"`self.{attr}` ({owner[1]}) is swapped by a "
+                    "background thread but re-read lock-free here "
+                    f"(also at line {free_lines[0]}) — pin it to a "
+                    "local once per call or the epochs can change "
+                    "mid-call", scan.fn.qualname))
+    return out
+
+
+def _check_escape(p: _Pass) -> List[Finding]:
+    out: List[Finding] = []
+    for scan in p.scans.values():
+        fn = scan.fn
+        if fn.name != _INIT_ONLY or fn.parent is not None:
+            continue
+        key = p.model.class_of_fn.get(id(fn))
+        if key is None:
+            continue
+        mod = fn.module
+        nested = {f.node for f in mod.functions if f.parent is fn}
+        # names/attrs assigned from a Thread/Timer ctor inside __init__
+        handles: Set[str] = set()
+
+        def refs_self(node: ast.AST) -> bool:
+            return any(isinstance(n, ast.Name) and n.id == "self"
+                       for n in ast.walk(node))
+
+        escape: Optional[Tuple[int, str]] = None
+        later_attr_writes: List[int] = []
+        for node in ast.walk(fn.node):
+            if node in nested:
+                continue
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                t = _resolve_target(node.value.func, mod)
+                leaf = (t or "").split(".")[-1]
+                if leaf in ("Thread", "Timer") and refs_self(node.value):
+                    for tgt in node.targets:
+                        d = _dotted(tgt)
+                        if d:
+                            handles.add(d.split(".")[-1])
+            if not isinstance(node, ast.Call):
+                continue
+            cands = p._entry_candidates(node, fn)
+            handed = [c for c in cands if refs_self(c)] + \
+                     [a for a in node.args
+                      if isinstance(a, ast.Name) and a.id == "self"
+                      and (_dotted(node.func) or "").split(".")[-1]
+                      in _SPAWN_LEAVES]
+            started = False
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "start":
+                base = node.func.value
+                d = _dotted(base)
+                if d and d.split(".")[-1] in handles:
+                    started = True
+                elif isinstance(base, ast.Call):
+                    t = _resolve_target(base.func, mod)
+                    if (t or "").split(".")[-1] in ("Thread", "Timer") \
+                            and refs_self(base):
+                        started = True
+            leaf = (_dotted(node.func) or "").split(".")[-1]
+            if (handed and leaf in _SPAWN_LEAVES and leaf not in
+                    ("Thread", "Timer")) or started:
+                line = node.lineno
+                if escape is None or line < escape[0]:
+                    escape = (line, "thread started" if started
+                              else f"callable handed to `{leaf}`")
+        if escape is None:
+            continue
+        for node in ast.walk(fn.node):
+            if node in nested:
+                continue
+            if isinstance(node, ast.Assign) and \
+                    node.lineno > escape[0]:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        later_attr_writes.append(node.lineno)
+        if later_attr_writes:
+            out.append(Finding(
+                "GL805", mod.relpath, escape[0],
+                f"`self` escapes `{key[1]}.__init__` here ({escape[1]}) "
+                "while attributes are still assigned at line(s) "
+                f"{sorted(set(later_attr_writes))[:4]} — the spawned "
+                "code can observe a partially-built object; publish "
+                "last", fn.qualname))
+    return out
+
+
+#: threading ctors GL806 flags (argful Condition wraps an existing lock
+#: and is canonicalized by the alias pass; semaphores have no locksan
+#: wrapper and guard counting semantics, not mutual exclusion)
+_PLAIN_LOCK_CTORS = {"threading.Lock", "threading.RLock"}
+
+
+def _check_plain_locks(p: _Pass) -> List[Finding]:
+    out: List[Finding] = []
+    for mp, mod in sorted(p.project.by_modpath.items()):
+        rel = mod.relpath
+        if not rel.replace("\\", "/").startswith("sptag_tpu/"):
+            continue
+        if rel.endswith("utils/locksan.py"):
+            continue              # the sanitizer cannot sanitize itself
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            t = _resolve_target(node.value.func, mod)
+            flag = t in _PLAIN_LOCK_CTORS or (
+                t == "threading.Condition" and not node.value.args)
+            if not flag:
+                continue
+            tgt = node.targets[0]
+            d = _dotted(tgt) or "?"
+            fn = None
+            for f in mod.functions:
+                end = getattr(f.node, "end_lineno", f.node.lineno)
+                if f.node.lineno <= node.lineno <= end:
+                    fn = f
+            out.append(Finding(
+                "GL806", rel, node.lineno,
+                f"plain `{t}()` assigned to `{d}` is invisible to the "
+                "locksan runtime (order sanitizer, contention ledger, "
+                "race sanitizer) — use locksan.make_lock/make_rlock "
+                "with a stable name", fn.qualname if fn else ""))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    p = _get_pass(project)
+    out: List[Finding] = []
+    out.extend(_check_attr_rules(p))
+    out.extend(_check_global_rules(p))
+    out.extend(_check_epoch_pin(p))
+    out.extend(_check_escape(p))
+    out.extend(_check_plain_locks(p))
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule))

@@ -14,14 +14,15 @@ import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from tools.graftlint import (asyncrules, concurrency, costrules,
-                             dtype_parity, errorpath, hostsync, lockgraph,
-                             obsnames, persistrules, retrace)
+                             dtype_parity, errorpath, guardedby, hostsync,
+                             lockgraph, obsnames, persistrules, retrace)
 from tools.graftlint.baseline import (BaselineError, Suppression,
                                       apply_baseline, load_baseline)
 from tools.graftlint.core import Finding, Project
 
 CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity,
-            obsnames, lockgraph, asyncrules, costrules, persistrules)
+            obsnames, lockgraph, asyncrules, costrules, persistrules,
+            guardedby)
 
 #: rule id -> one-line description, collected from every checker module
 ALL_RULES: Dict[str, str] = {}
