@@ -900,6 +900,7 @@ def run_bench():
             # search budget than it entered with (ADVICE r5)
             saved_mode = index.params.search_mode
             saved_max_check = index.params.max_check
+            saved_binned = str(getattr(index.params, "binned_topk", "off"))
             try:
                 beam_index.set_parameter("SearchMode", "beam")
                 # pin the walk budget to 2048: the default 8192 quadruples
@@ -919,34 +920,85 @@ def run_bench():
                 if qcount < len(queries):
                     # no silent caps: the subsample is recorded
                     result["beam_queries_dropped"] = len(queries) - qcount
+                # the beam headline runs the BIN-REDUCTION walk (ISSUE
+                # 13, BinnedTopK=on): the binned frontier merge is the
+                # serving configuration the peak-FLOP/s work exists for,
+                # and the exact-walk reference pass below anchors its
+                # recall inside a Wilson CI
+                beam_index.set_parameter("BinnedTopK", "on")
                 with trace.span("bench.beam_sweep"):
                     ids_b, qps_b, _ = timed_sweep(
                         beam_index, queries[:qcount], k,
                         min(batch, qcount), sb_beam, repeats=1)
+                rec_b = recall_at_k(ids_b, truth[:qcount], k)
                 result.update({
                     "beam_qps": round(qps_b, 1),
-                    "beam_recall_at_10": round(
-                        recall_at_k(ids_b, truth[:qcount], k), 4),
+                    "beam_recall_at_10": round(rec_b, 4),
                     "beam_vs_baseline": round(qps_b / cpu_qps, 2),
                     "beam_graph": beam_graph,
                     "beam_queries": qcount,
+                    "beam_binned": "on",
                 })
+                checkpoint()
+                # exact-top-k reference pass (recall anchor): one timed
+                # full-batch search with the binned merge off.  The
+                # acceptance contract: the binned headline's recall sits
+                # INSIDE the exact run's Wilson CI (utils/qualmon.py).
+                # Runs AFTER the headline (an expiring budget can only
+                # cost the anchor, never the measurement) under its OWN
+                # stage cap — the beam sweep's latency-sampling loop
+                # deliberately consumes sb_beam down to its floor, so
+                # gating on sb_beam's remainder would always skip this
+                sb_bex = _stage_budget(result, "beam_exact", budget_s,
+                                       240.0, 45.0)
+                if sb_bex is not None:
+                    from sptag_tpu.utils import qualmon as _qm
+
+                    beam_index.set_parameter("BinnedTopK", "off")
+                    with trace.span("bench.beam_exact_ref"):
+                        beam_index.search_batch(queries[:qcount], k)
+                        t0 = time.perf_counter()
+                        _, ids_e = beam_index.search_batch(
+                            queries[:qcount], k)
+                        dt_e = time.perf_counter() - t0
+                    rec_e = recall_at_k(ids_e, truth[:qcount], k)
+                    lo_e, hi_e = _qm.wilson(rec_e * qcount * k,
+                                            qcount * k)
+                    result.update({
+                        "beam_exact_qps": round(qcount / dt_e, 1),
+                        "beam_exact_recall_at_10": round(rec_e, 4),
+                        "beam_exact_ci": [round(lo_e, 4),
+                                          round(hi_e, 4)],
+                        "beam_binned_speedup": round(
+                            qps_b / (qcount / dt_e), 2),
+                        "beam_recall_within_exact_ci":
+                            bool(lo_e <= rec_b <= hi_e),
+                    })
+                    beam_index.set_parameter("BinnedTopK", "on")
                 try:
                     # per-query work = budget iterations x the one-row
                     # walk-body cost (the beam.segment ledger family) —
                     # a budget-bound upper estimate: nbp early exits do
                     # less, so %-of-peak is a floor on headroom
                     eng_b = beam_index._get_engine()
-                    _, _, B_b, T_b, _ = eng_b.walk_plan(
+                    _, L_b, B_b, T_b, _ = eng_b.walk_plan(
                         k, 2048,
                         getattr(beam_index.params, "beam_width", 16))
-                    est1 = eng_b.walk_iter_cost(1, B_b)
+                    # L_b prices the BINNED body when the stage ran with
+                    # BinnedTopK on (the headline configuration).
+                    # Estimate at the sweep's REAL batch size and divide
+                    # by it (_roofline_add's batch_q): the binned byte
+                    # formula carries a per-DISPATCH corpus-operand term
+                    # (N*D), which a Q=1 estimate would absurdly charge
+                    # to every query
+                    rows_b = min(batch, qcount)
+                    est_b = eng_b.walk_iter_cost(rows_b, B_b, L_b)
                     from sptag_tpu.utils.costmodel import CostEstimate
                     _roofline_add(
                         result, "beam", qps_b,
-                        CostEstimate("beam.segment", est1.flops * T_b,
-                                     est1.hbm_bytes * T_b),
-                        1, dtype=eng_b.score_dtype_name())
+                        CostEstimate("beam.segment", est_b.flops * T_b,
+                                     est_b.hbm_bytes * T_b),
+                        rows_b, dtype=eng_b.score_dtype_name())
                 except Exception:                        # noqa: BLE001
                     pass
                 checkpoint()
@@ -972,6 +1024,7 @@ def run_bench():
                 if beam_index is index:
                     index.set_parameter("SearchMode", str(saved_mode))
                     index.set_parameter("MaxCheck", str(saved_max_check))
+                    index.set_parameter("BinnedTopK", saved_binned)
                 else:
                     del beam_index          # free the second corpus copy
             checkpoint()
@@ -1018,6 +1071,44 @@ def run_bench():
                             "%s@%d" % (label, mc)] = repr(e)[:200]
                 if rows:
                     pareto[label] = rows
+            # ApproxRecallTarget sweep (ISSUE 13 satellite): the FLAT
+            # binned/approx select's recall-vs-QPS curve on the headline
+            # corpus — the knob that was a hard-coded 0.99 until now.
+            # Each target resolves a different bin count (a static
+            # kernel shape), so each point is one compile; Wilson CIs
+            # ride every row like the MaxCheck sweeps above.
+            try:
+                rt_rows = []
+                flat_a = sp.create_instance("FLAT", "Float")
+                flat_a.set_parameter("DistCalcMethod", "L2")
+                flat_a.set_parameter("BinnedTopK", "on")
+                flat_a.build(data)
+                qn = min(len(queries), 512)
+                for rt in (0.8, 0.9, 0.95, 0.99):
+                    if _remaining(sb_par) < 15:
+                        result.setdefault("pareto_dropped", []).append(
+                            "flat_approx@%.2f" % rt)
+                        continue
+                    flat_a.set_parameter("ApproxRecallTarget", str(rt))
+                    flat_a.search_batch(queries[:qn], k)       # warm
+                    t0 = time.perf_counter()
+                    _, idsr = flat_a.search_batch(queries[:qn], k)
+                    dt = time.perf_counter() - t0
+                    rec = recall_at_k(idsr, truth[:qn], k)
+                    lo, hi = qualmon.wilson(rec * qn * k, qn * k)
+                    rt_rows.append({
+                        "recall_target": rt,
+                        "qps": round(qn / dt, 1),
+                        "recall_at_10": round(rec, 4),
+                        "ci": [round(lo, 4), round(hi, 4)],
+                        "queries": qn,
+                    })
+                if rt_rows:
+                    pareto["flat_approx"] = rt_rows
+                del flat_a
+            except Exception as e:                   # noqa: BLE001
+                result.setdefault("pareto_errors", {})[
+                    "flat_approx"] = repr(e)[:200]
             result["quality_pareto"] = pareto
             checkpoint()
 

@@ -192,7 +192,11 @@ class BKTIndex(VectorIndex):
                                 "flightdevicesamplerate",
                                 # capability (incl. probe permission) is
                                 # resolved at engine materialization
-                                "rooflineprobe"})
+                                "rooflineprobe",
+                                # bin-reduction top-k mode + its recall
+                                # target are baked into the engine's
+                                # compiled walk programs (ISSUE 13)
+                                "binnedtopk", "approxrecalltarget"})
     # process-wide recorder knobs: applied DIRECTLY to flightrec at
     # set_parameter time (each maps to its own configure field, so
     # setting one never clobbers the others) — they are not baked into
@@ -272,7 +276,12 @@ class BKTIndex(VectorIndex):
                                      self.params,
                                      "flight_device_sample_rate", 0.0)),
                                  roofline_probe=bool(int(getattr(
-                                     self.params, "roofline_probe", 0))))
+                                     self.params, "roofline_probe", 0))),
+                                 binned_topk=str(getattr(
+                                     self.params, "binned_topk", "off")),
+                                 recall_target=float(getattr(
+                                     self.params, "approx_recall_target",
+                                     0.99)))
 
     def _get_engine(self) -> GraphSearchEngine:
         """Pin the current engine snapshot (epoch-based handoff,
@@ -606,7 +615,10 @@ class BKTIndex(VectorIndex):
             d, ids = self._get_dense().search(
                 queries, min(k, self._n), max_check=mc,
                 group=getattr(p, "dense_query_group", 0),
-                union_factor=getattr(p, "dense_union_factor", 2))
+                union_factor=getattr(p, "dense_union_factor", 2),
+                binned=str(getattr(p, "binned_topk", "off")),
+                recall_target=float(
+                    getattr(p, "approx_recall_target", 0.99)))
         else:
             if not getattr(p, "build_graph", 1):
                 raise RuntimeError(

@@ -36,6 +36,7 @@ import numpy as np
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.ops import pallas_kernels
+from sptag_tpu.ops import topk_bins
 from sptag_tpu.utils import costmodel, devmem, query_bucket, round_up
 
 MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
@@ -242,9 +243,15 @@ def partition_from_kdtree(tree, n: int, target_size: int
     return _pack_clusters(clusters, centers, target_size)
 
 
-def _finalize_topk(nd, ids, deleted, dedup: bool, k: int, extra_dead=None):
+def _finalize_topk(nd, ids, deleted, dedup: bool, k: int, extra_dead=None,
+                   binned_bins: int = 0):
     """Shared epilogue of the dense kernels: tombstone/sentinel masking,
-    optional replica de-duplication, masked top-k, -1 id sentinel."""
+    optional replica de-duplication, masked top-k, -1 id sentinel.
+    `binned_bins` > 0 replaces the full (Q, nprobe*P)-wide `lax.top_k`
+    with the bin-reduction select (ops/topk_bins.py) — the peak-FLOP/s
+    recipe's answer to the scan's sort bottleneck; callers size bins via
+    the recall-target math so returned-set recall meets the configured
+    ApproxRecallTarget."""
     dead = deleted[jnp.maximum(ids, 0)] | (ids < 0)
     if extra_dead is not None:
         dead = dead | extra_dead
@@ -257,8 +264,11 @@ def _finalize_topk(nd, ids, deleted, dedup: bool, k: int, extra_dead=None):
         nd = jnp.where(_sorted_dup_mask(jnp.where(ids >= 0, ids, -1)) &
                        (ids >= 0), MAX_DIST, nd)
     k_eff = min(k, nd.shape[1])
-    neg, pos = jax.lax.top_k(-nd, k_eff)
-    out_d = -neg
+    if binned_bins:
+        out_d, pos = topk_bins.binned_topk(nd, k_eff, binned_bins)
+    else:
+        neg, pos = jax.lax.top_k(-nd, k_eff)
+        out_d = -neg
     out_ids = jnp.take_along_axis(ids, pos, axis=1)
     out_ids = jnp.where(out_d < MAX_DIST, out_ids, -1)
     return out_d, out_ids.astype(jnp.int32)
@@ -266,11 +276,13 @@ def _finalize_topk(nd, ids, deleted, dedup: bool, k: int, extra_dead=None):
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "metric", "base",
-                                    "use_pallas", "interpret", "dedup"))
+                                    "use_pallas", "interpret", "dedup",
+                                    "binned_bins"))
 def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
                         cent_sq, deleted, queries, k: int, nprobe: int,
                         metric: int, base: int, use_pallas: bool = False,
-                        interpret: bool = False, dedup: bool = False):
+                        interpret: bool = False, dedup: bool = False,
+                        binned_bins: int = 0):
     """One program: (Q,C) center scores -> top-nprobe block gather ->
     (Q, nprobe*P) candidate scores -> masked top-k.
 
@@ -309,7 +321,8 @@ def _dense_search_kernel(data_perm, member_ids, member_sq, centroids,
         vecs = data_perm[topc].reshape(Q, nprobe * P, D)
         nd = dist_ops.batched_gathered_distance(
             queries, vecs, DistCalcMethod(metric), base, sq)
-    return _finalize_topk(nd, ids, deleted, dedup, k)
+    return _finalize_topk(nd, ids, deleted, dedup, k,
+                          binned_bins=binned_bins)
 
 
 def _segmented_min(vals, first):
@@ -326,14 +339,15 @@ def _segmented_min(vals, first):
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "U", "G", "metric",
                                     "base", "use_pallas", "interpret",
-                                    "dedup"))
+                                    "dedup", "binned_bins"))
 def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
                                  cent_sq, deleted, queries, nq_valid,
                                  k: int, nprobe: int, U: int, G: int,
                                  metric: int, base: int,
                                  use_pallas: bool = False,
                                  interpret: bool = False,
-                                 dedup: bool = False):
+                                 dedup: bool = False,
+                                 binned_bins: int = 0):
     """Query-grouped probing: sort the batch by nearest centroid, split into
     groups of G neighbors, probe each group's UNION of blocks (top-U by best
     center distance), and score group x block as real (G, D) x (D, P)
@@ -362,9 +376,14 @@ def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
     valid = jnp.arange(Q, dtype=jnp.int32) < nq_valid        # (Q,)
 
     # sort queries by their best block id so groups share probed blocks;
-    # padding sorts to the back (key C) so it doesn't split real groups
+    # padding sorts to the back (key C) so it doesn't split real groups.
+    # The inverse permutation comes from a SCATTER of the forward one —
+    # the same trick as engine._sorted_dedup; the old back-to-back
+    # argsort+argsort paid a second full sort for what one O(Q) scatter
+    # computes
     order = jnp.argsort(jnp.where(valid, topc[:, 0], C))
-    inv = jnp.argsort(order)
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype))
     qs = queries[order]
     qsf = qf[order]
     topc_s = topc[order].reshape(NG, G * nprobe)
@@ -439,7 +458,8 @@ def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
     pad_blocks = jnp.broadcast_to((union < 0)[:, None, :, None],
                                   (NG, G, U, P)).reshape(Q, U * P)
     out_d, out_ids = _finalize_topk(nd, ids, deleted, dedup, k,
-                                    extra_dead=pad_blocks)
+                                    extra_dead=pad_blocks,
+                                    binned_bins=binned_bins)
     # un-sort back to the caller's query order
     return out_d[inv], out_ids[inv]
 
@@ -447,30 +467,33 @@ def _dense_search_grouped_kernel(data_perm, member_ids, member_sq, centroids,
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "U", "G", "metric",
                                     "base", "use_pallas", "interpret",
-                                    "dedup"))
+                                    "dedup", "binned_bins"))
 def _dense_search_grouped_chunked(data_perm, member_ids, member_sq,
                                   centroids, cent_sq, deleted, queries3,
                                   valid3, k: int, nprobe: int, U: int,
                                   G: int, metric: int, base: int,
                                   use_pallas: bool = False,
                                   interpret: bool = False,
-                                  dedup: bool = False):
+                                  dedup: bool = False,
+                                  binned_bins: int = 0):
     def body(args):
         q, nv = args
         return _dense_search_grouped_kernel(
             data_perm, member_ids, member_sq, centroids, cent_sq, deleted,
             q, nv, k, nprobe, U, G, metric, base, use_pallas, interpret,
-            dedup)
+            dedup, binned_bins)
     return jax.lax.map(body, (queries3, valid3))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "metric", "base",
-                                    "use_pallas", "interpret", "dedup"))
+                                    "use_pallas", "interpret", "dedup",
+                                    "binned_bins"))
 def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
                           cent_sq, deleted, queries3, k: int, nprobe: int,
                           metric: int, base: int, use_pallas: bool = False,
-                          interpret: bool = False, dedup: bool = False):
+                          interpret: bool = False, dedup: bool = False,
+                          binned_bins: int = 0):
     """(M, chunk, D) query chunks -> ((M, chunk, k), (M, chunk, k)).
 
     `lax.map` over the chunk axis keeps the WHOLE multi-chunk search one
@@ -482,7 +505,8 @@ def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
     def body(q):
         return _dense_search_kernel(
             data_perm, member_ids, member_sq, centroids, cent_sq, deleted,
-            q, k, nprobe, metric, base, use_pallas, interpret, dedup)
+            q, k, nprobe, metric, base, use_pallas, interpret, dedup,
+            binned_bins)
     return jax.lax.map(body, queries3)
 
 
@@ -490,48 +514,70 @@ def _dense_search_chunked(data_perm, member_ids, member_sq, centroids,
 # cost-ledger entries (utils/costmodel.py; graftlint GL605)
 # ---------------------------------------------------------------------------
 
-def _dense_scan_cost(Q, C, P, D, nprobe, k, itemsize=4, **_):
+def _dense_scan_cost(Q, C, P, D, nprobe, k, itemsize=4, binned_bins=0,
+                     **_):
     """Per-query kernel: (Q, C) center matmul, top-nprobe cut, block
     gather, (Q, nprobe*P) candidate contraction, masked top-k.  Bytes:
     the gathered (Q, nprobe, P, D) candidate tensor is written then
     re-read by the scoring einsum (2x), plus the full block-layout
-    operand of the gather and the (Q, nprobe*P) score-matrix traffic."""
+    operand of the gather and the (Q, nprobe*P) score-matrix traffic.
+    With `binned_bins` the final select is the bin reduction: the
+    top-k ensemble term is replaced by the O(M) reduction + the
+    bins-wide shortlist sort (ops/topk_bins.binned_select_cost)."""
     M = Q * nprobe * P
+    if binned_bins:
+        sel_f, sel_b = topk_bins.binned_select_cost(Q, nprobe * P, k, binned_bins)
+        sel_f += 6.0 * M                          # mask/where epilogue
+        sel_b += 4.0 * M * 4
+    else:
+        sel_f, sel_b = 10.0 * M, 8.0 * M * 4      # mask/top-k ensemble
     flops = (costmodel.matmul_flops(Q, C, D)      # center scoring
              + 2.0 * M * D                        # candidate scoring
-             + 10.0 * M                           # mask/dedup/top-k ensemble
+             + sel_f
              + 2.0 * D * (Q + C))                 # norms
     nbytes = (2.0 * M * D * itemsize              # gather out + einsum read
               + C * P * D * itemsize              # gather operand
               + C * D * 4 + C * 4                 # centroids
               + Q * D * itemsize
-              + 8.0 * M * 4                       # ids/sq/mask/top-k traffic
+              + sel_b                             # ids/sq/mask/select traffic
               + Q * k * 8)
     return flops, nbytes
 
 
-def _dense_chunked_cost(M_chunks, Q, C, P, D, nprobe, k, itemsize=4, **_):
-    f, b = _dense_scan_cost(Q, C, P, D, nprobe, k, itemsize)
+def _dense_chunked_cost(M_chunks, Q, C, P, D, nprobe, k, itemsize=4,
+                        binned_bins=0, **_):
+    f, b = _dense_scan_cost(Q, C, P, D, nprobe, k, itemsize,
+                            binned_bins=binned_bins)
     return M_chunks * f, M_chunks * b
 
 
-def _dense_grouped_cost(Q, C, P, D, nprobe, U, G, k, itemsize=4, **_):
+def _dense_grouped_cost(Q, C, P, D, nprobe, U, G, k, itemsize=4,
+                        binned_bins=0, **_):
     """Grouped kernel: every query scores its group's U-block union —
-    (Q/G)*U grid steps of (G, D) x (D, P) contractions."""
+    (Q/G)*U grid steps of (G, D) x (D, P) contractions.  With
+    `binned_bins` the final (Q, U*P)-wide select is the bin reduction
+    (same substitution as _dense_scan_cost)."""
     NG = max(1, Q // max(G, 1))
     M = NG * U * P * G                            # scored candidates
+    if binned_bins:
+        sel_f, sel_b = topk_bins.binned_select_cost(Q, U * P, k, binned_bins)
+        sel_f += 8.0 * M                          # union rank/scan/mask
+        sel_b += 4.0 * M * 4
+    else:
+        sel_f, sel_b = 12.0 * M, 8.0 * M * 4      # union rank/scan/top-k
     flops = (costmodel.matmul_flops(Q, C, D)
              + 2.0 * M * D
-             + 12.0 * M                           # union rank/scan/top-k
+             + sel_f
              + 2.0 * D * (Q + C))
     nbytes = (2.0 * NG * U * P * D * itemsize + C * P * D * itemsize
-              + C * D * 4 + Q * D * itemsize + 8.0 * M * 4 + Q * k * 8)
+              + C * D * 4 + Q * D * itemsize + sel_b + Q * k * 8)
     return flops, nbytes
 
 
 def _dense_grouped_chunked_cost(M_chunks, Q, C, P, D, nprobe, U, G, k,
-                                itemsize=4, **_):
-    f, b = _dense_grouped_cost(Q, C, P, D, nprobe, U, G, k, itemsize)
+                                itemsize=4, binned_bins=0, **_):
+    f, b = _dense_grouped_cost(Q, C, P, D, nprobe, U, G, k, itemsize,
+                               binned_bins=binned_bins)
     return M_chunks * f, M_chunks * b
 
 
@@ -770,14 +816,21 @@ class DenseTreeSearcher:
         return 32 if self.data_perm.dtype == jnp.dtype(jnp.int8) else 8
 
     def search(self, queries: np.ndarray, k: int, max_check: int = 2048,
-               group: int = 0, union_factor: int = 2
+               group: int = 0, union_factor: int = 2,
+               binned: str = "off",
+               recall_target: float = topk_bins.DEFAULT_RECALL_TARGET
                ) -> Tuple[np.ndarray, np.ndarray]:
         """`group` > 1 enables query-grouped probing (DenseQueryGroup):
         the batch is sorted by nearest centroid, split into groups of
         `group` queries, and each group probes the top
         ``union_factor * nprobe`` blocks of its probe UNION — fewer, fatter
         MXU contractions and more candidates per query than the per-query
-        kernel.  `group` must be a power of two (padding buckets are)."""
+        kernel.  `group` must be a power of two (padding buckets are).
+
+        `binned` (BinnedTopK: off/on/auto) routes the final candidate
+        select through the bin reduction (ops/topk_bins.py) at the bin
+        count the `recall_target` math demands over the
+        (nprobe*P)-or-(U*P)-wide score row."""
         queries = np.asarray(queries)
         if queries.ndim == 1:
             queries = queries[None, :]
@@ -839,6 +892,13 @@ class DenseTreeSearcher:
                     group, G or "off", nq, self.num_clusters, nprobe,
                     U or "-")
         k_eff = min(k, (U if G else nprobe) * P, self.n)
+        # bin-reduction final select (BinnedTopK): bins sized by the
+        # recall-target formula over the scored row width; 0 = exact.
+        # Resolved per (G, U, nprobe) shape — a static kernel parameter
+        # like k_eff, so it mints no extra compiles beyond the mode flip
+        bins = topk_bins.resolve_bins(binned, k_eff,
+                                      (U if G else nprobe) * P,
+                                      recall_target)
 
         bytes_q = ((U * P * D * 4 + G - 1) // G if G
                    else nprobe * P * D * 4)
@@ -852,7 +912,7 @@ class DenseTreeSearcher:
             or queries.dtype == np.dtype(np.int8))
         try:
             return self._search_impl(queries, nq, k, k_eff, nprobe, chunk,
-                                     D, use_pallas, G, U)
+                                     D, use_pallas, G, U, bins)
         except Exception as e:                         # noqa: BLE001
             # a pallas_call that fails to COMPILE on this backend (Mosaic
             # lowering gap) must degrade gracefully, not take search
@@ -869,7 +929,7 @@ class DenseTreeSearcher:
                 try:
                     out = self._search_impl(queries, nq, k, k_eff, nprobe,
                                             chunk, D, use_pallas=False,
-                                            G=G, U=U)
+                                            G=G, U=U, bins=bins)
                     pallas_kernels.disable_grouped(repr(e)[:200])
                     return out
                 except Exception:                      # noqa: BLE001
@@ -877,7 +937,10 @@ class DenseTreeSearcher:
             self.last_effective_group = 0
             out = self._search_impl(queries, nq, k,
                                     min(k_eff, nprobe * P), nprobe, chunk,
-                                    D, use_pallas=False, G=0, U=0)
+                                    D, use_pallas=False, G=0, U=0,
+                                    bins=topk_bins.resolve_bins(
+                                        binned, min(k_eff, nprobe * P),
+                                        nprobe * P, recall_target))
             # the ungrouped XLA retry SUCCEEDED, so the failure was not
             # transient.  Scope the disablement to what actually failed:
             # with grouping active, BOTH grouped paths failed but the
@@ -890,7 +953,7 @@ class DenseTreeSearcher:
             return out
 
     def _search_impl(self, queries, nq, k, k_eff, nprobe, chunk, D,
-                     use_pallas, G=0, U=0):
+                     use_pallas, G=0, U=0, bins=0):
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
         interp = pallas_kernels.interpret()
@@ -916,14 +979,14 @@ class DenseTreeSearcher:
                     # searches to XLA; the per-query kernel keeps Pallas
                     use_pallas=use_pallas
                     and not pallas_kernels.grouped_disabled(),
-                    interpret=interp, dedup=dedup)
+                    interpret=interp, dedup=dedup, binned_bins=bins)
             else:
                 d, ids = _dense_search_kernel(
                     self.data_perm, self.member_ids, self.member_sq,
                     self.centroids, self.cent_sq, self.deleted,
                     jnp.asarray(q), k_eff, nprobe, int(self.metric),
                     self.base, use_pallas=use_pallas, interpret=interp,
-                    dedup=dedup)
+                    dedup=dedup, binned_bins=bins)
             out_d[:, :d.shape[1]] = np.asarray(d)[:nq]
             out_i[:, :ids.shape[1]] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -948,14 +1011,15 @@ class DenseTreeSearcher:
                 self.base,
                 use_pallas=use_pallas
                 and not pallas_kernels.grouped_disabled(),
-                interpret=interp, dedup=dedup)
+                interpret=interp, dedup=dedup, binned_bins=bins)
         else:
             d, ids = _dense_search_chunked(
                 self.data_perm, self.member_ids, self.member_sq,
                 self.centroids, self.cent_sq, self.deleted,
                 jnp.asarray(q.reshape(m, chunk, D)),
                 k_eff, nprobe, int(self.metric), self.base,
-                use_pallas=use_pallas, interpret=interp, dedup=dedup)
+                use_pallas=use_pallas, interpret=interp, dedup=dedup,
+                binned_bins=bins)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :d.shape[1]] = d[:nq]

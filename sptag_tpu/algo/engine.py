@@ -67,6 +67,7 @@ import numpy as np
 
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.ops import topk_bins
 from sptag_tpu.utils import (costmodel, devmem, flightrec, metrics,
                              query_bucket, roofline)
 
@@ -188,7 +189,7 @@ def _sorted_dup_mask(ids: jax.Array):
 
 
 def _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
-                      metric: int):
+                      metric: int, seed_keep: int = 0):
     """Shared-pivot seeding (BKT): one dense (Q, P) matmul scores the whole
     pivot set; the top-L pivots initialize every query's beam.  `pivot_mask`
     (W,) int32 is the precomputed packed bitset of the pivot ids.
@@ -198,6 +199,13 @@ def _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
     the best unvisited pivot, mirroring the reference's mid-walk
     `SearchTrees` refill (`NGQueue.top > SPTQueue.top`, BKTIndex.cpp:153-155;
     `NumberOfOtherDynamicPivots` is the refill size).
+
+    `seed_keep` > 0 (BinnedTopK; topk_bins.seed_spare_keep) replaces the
+    (Q, P)-wide argsort — the single biggest sort left in the binned
+    walk — with a bin reduction + exact top-(L + seed_keep): the beam
+    gets its top-L (approximately; bin collisions can swap tail
+    entries) and the spare queue is TRUNCATED to `seed_keep` sorted
+    pivots, far beyond any real injection budget.
 
     Returns (cand_ids, cand_d, visited, spare_ids, spare_d)."""
     Q = queries.shape[0]
@@ -212,9 +220,16 @@ def _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
             [pivot_ids, jnp.full((L - P,), -1, jnp.int32)])
     else:
         seed_ids = pivot_ids
-    order = jnp.argsort(d0, axis=1)                             # ascending
-    sorted_d = jnp.take_along_axis(d0, order, axis=1)
-    sorted_ids = jnp.where(sorted_d < MAX_DIST, seed_ids[order], -1)
+    if seed_keep > 0:
+        K = min(L + seed_keep, d0.shape[1])
+        sorted_d, sorted_cols = topk_bins.binned_topk(
+            d0, K, topk_bins.pow2ceil(K))
+        sorted_ids = jnp.where(sorted_d < MAX_DIST,
+                               seed_ids[sorted_cols], -1)
+    else:
+        order = jnp.argsort(d0, axis=1)                         # ascending
+        sorted_d = jnp.take_along_axis(d0, order, axis=1)
+        sorted_ids = jnp.where(sorted_d < MAX_DIST, seed_ids[order], -1)
     cand_d = sorted_d[:, :L]
     cand_ids = sorted_ids[:, :L]
     spare_ids = sorted_ids[:, L:]
@@ -259,13 +274,13 @@ def _seed_from_seeds(data, sqnorm, seed_ids, queries, L: int, metric: int,
     return cand_ids, cand_d, visited
 
 
-@functools.partial(jax.jit, static_argnames=("L", "metric"))
+@functools.partial(jax.jit, static_argnames=("L", "metric", "seed_keep"))
 def _beam_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
-                      metric: int):
+                      metric: int, seed_keep: int = 0):
     """Standalone jit of the pivot seeding — the scheduler seeds refill
     buckets with it, then walks them under `_beam_segment_kernel`."""
     return _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L,
-                             metric)
+                             metric, seed_keep=seed_keep)
 
 
 @functools.partial(jax.jit, static_argnames=("L", "metric", "base"))
@@ -278,48 +293,55 @@ def _beam_seed_seeded_kernel(data, sqnorm, seed_ids, queries, L: int,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
-                     "inject"))
+                     "inject", "merge_bins", "finalize_bins", "seed_keep"))
 def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                         pivot_mask, queries, t_limit, k: int, L: int,
                         B: int, metric: int, base: int, nbp_limit: int,
                         inject: int = 4, data_score=None, nbr_vecs=None,
-                        nbr_sq=None):
+                        nbr_sq=None, merge_bins: int = 0,
+                        finalize_bins: int = 0, seed_keep: int = 0):
     """Pivot-seeded monolithic walk: seed + walk + finalize fused in one
     program.  `t_limit` (Q,) carries the per-row iteration budget as a
     TRACED array, so distinct MaxCheck values that map to the same (L, B)
     reuse one compiled program."""
     cand_ids, cand_d, visited, spare_ids, spare_d = _seed_from_pivots(
-        pivot_ids, pivot_vecs, pivot_mask, queries, L, metric)
+        pivot_ids, pivot_vecs, pivot_mask, queries, L, metric,
+        seed_keep=seed_keep)
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
                  visited, k, L, B, t_limit, metric, base, nbp_limit,
                  spare_ids=spare_ids, spare_d=spare_d, inject=inject,
-                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit"))
-def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
-                               queries, t_limit, k: int, L: int, B: int,
-                               metric: int, base: int, nbp_limit: int,
-                               data_score=None, nbr_vecs=None,
-                               nbr_sq=None):
-    cand_ids, cand_d, visited = _seed_from_seeds(data, sqnorm, seed_ids,
-                                                 queries, L, metric, base)
-    return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
-                 visited, k, L, B, t_limit, metric, base, nbp_limit,
-                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
+                 merge_bins=merge_bins, finalize_bins=finalize_bins)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
-                     "inject"))
+                     "merge_bins", "finalize_bins"))
+def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
+                               queries, t_limit, k: int, L: int, B: int,
+                               metric: int, base: int, nbp_limit: int,
+                               data_score=None, nbr_vecs=None,
+                               nbr_sq=None, merge_bins: int = 0,
+                               finalize_bins: int = 0):
+    cand_ids, cand_d, visited = _seed_from_seeds(data, sqnorm, seed_ids,
+                                                 queries, L, metric, base)
+    return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
+                 visited, k, L, B, t_limit, metric, base, nbp_limit,
+                 data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
+                 merge_bins=merge_bins, finalize_bins=finalize_bins)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
+                     "inject", "merge_bins", "finalize_bins", "seed_keep"))
 def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                          pivot_mask, queries3, t_limit, k: int, L: int,
                          B: int, metric: int, base: int, nbp_limit: int,
                          inject: int = 4, data_score=None, nbr_vecs=None,
-                         nbr_sq=None):
+                         nbr_sq=None, merge_bins: int = 0,
+                         finalize_bins: int = 0, seed_keep: int = 0):
     """(M, chunk, D) query chunks under one `lax.map` — a single device
     program for any batch size (one upload, one dispatch, one read; the
     tunneled backend costs ~60 ms per host round trip).  The per-chunk
@@ -331,25 +353,32 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                                    pivot_vecs, pivot_mask, q, t_limit, k,
                                    L, B, metric, base, nbp_limit, inject,
                                    data_score=data_score,
-                                   nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+                                   nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
+                                   merge_bins=merge_bins,
+                                   finalize_bins=finalize_bins,
+                                   seed_keep=seed_keep)
     return jax.lax.map(body, queries3)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit"))
+    static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
+                     "merge_bins", "finalize_bins"))
 def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
                                 queries3, t_limit, k: int, L: int, B: int,
                                 metric: int, base: int, nbp_limit: int,
                                 data_score=None, nbr_vecs=None,
-                                nbr_sq=None):
+                                nbr_sq=None, merge_bins: int = 0,
+                                finalize_bins: int = 0):
     def body(args):
         s, q = args
         return _beam_search_seeded_kernel(data, sqnorm, graph, deleted, s,
                                           q, t_limit, k, L, B, metric,
                                           base, nbp_limit,
                                           data_score=data_score,
-                                          nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+                                          nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
+                                          merge_bins=merge_bins,
+                                          finalize_bins=finalize_bins)
     return jax.lax.map(body, (seeds3, queries3))
 
 
@@ -373,12 +402,43 @@ def _init_walk_state(cand_ids, cand_d, visited):
 def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
                   B: int, metric: int, base: int, nbp_limit: int,
                   spare_ids=None, spare_d=None, inject: int = 0,
-                  data_score=None, nbr_vecs=None, nbr_sq=None):
+                  data_score=None, nbr_vecs=None, nbr_sq=None,
+                  merge_bins: int = 0):
     """One beam iteration as a reusable (body, row_alive) pair over the
     walk's constants — shared verbatim by the monolithic `lax.while_loop`
     walk and the segmented kernel, so the two execute IDENTICAL per-row
     trajectories (the bit-parity contract the scheduler's retire decision
     rests on).
+
+    `merge_bins` > 0 switches the body to the BIN-REDUCTION frontier
+    maintenance (ops/topk_bins.py, the TPU-KNN recipe; BinnedTopK
+    param).  Three sort-ensemble replacements, exploiting the pool's
+    sortedness invariant (every merge ends in an exact top-L, so
+    `cand_d` is always ascending with MAX_DIST voids):
+
+    * **pop** — the best-B unexpanded select becomes an exact
+      rank-select (cumsum + one scatter) over the sorted pool instead of
+      an L-wide `lax.top_k`;
+    * **merge** — beam + candidates are strided-binned into
+      `merge_bins` bins (>= L, so the sorted beam prefix maps onto
+      distinct bins and can never self-collide), each bin keeps its
+      best element, and the exact top-L runs over the bins-wide winner
+      row instead of the (L + B*m)-wide concat.  A candidate is lost
+      only when a better element shares its bin — and because marking
+      is lazy (below), a lost candidate stays rediscoverable;
+    * **lazy visited marking** — only ids that ENTER the beam are
+      marked (one L-wide mark instead of the X-wide
+      argsort+scan+scatter ensemble).  Same-iteration multi-parent
+      copies carry bit-identical distances, land adjacent after the
+      exact top-L, and collapse there; cross-iteration duplicates are
+      excluded by the `seen` test because beam membership is always a
+      subset of `visited` (seeds are pre-marked, every entrant is
+      marked on entry).
+
+    Per-row termination (t_limit / nbp / spare injection) is untouched,
+    so the absorbing-state contract — and with it segmented/scheduler
+    bit-parity AGAINST THE SAME merge_bins — holds exactly as in the
+    exact body.  merge_bins=0 is the byte-identical legacy path.
 
     `row_alive(state)` is the per-row continuation predicate: True while
     the next body application could still change the row's pool.  A row
@@ -404,6 +464,12 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
     `nbr_vecs` (N, m, D) / `nbr_sq` (N, m): optional packed per-node
     neighbor vectors (BeamPackedNeighbors) — the in-loop gather becomes B
     block reads per query instead of B*m scattered row reads."""
+    if merge_bins:
+        # the strided binning maps the sorted beam prefix (cols 0..L-1)
+        # onto distinct bins ONLY when bins >= L — a narrower reduction
+        # would self-collide the beam; engines size bins via
+        # merge_bins_for, this guards direct kernel callers
+        assert merge_bins >= L, (merge_bins, L)
     Q = queries.shape[0]
     N = data.shape[0]
     score_src = data_score if data_score is not None else data
@@ -449,18 +515,46 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
         # one compiled program (mixed-MaxCheck slot pools)
         active = _active(no_better, ptr) & (it < t_limit)        # (Q,)
 
-        # ---- pop best B unexpanded entries --------------------------------
-        sel_score = jnp.where(expanded[:, :L], MAX_DIST, cand_d)
-        sneg, spos = jax.lax.top_k(-sel_score, B)                # (Q, B)
-        sel_ok = ((-sneg) < MAX_DIST) & active[:, None]
-        sel_ids = jnp.where(
-            sel_ok, jnp.take_along_axis(cand_ids, spos, axis=1), -1)
-        expanded = _scatter_true(expanded, jnp.where(sel_ok, spos, L))
-        # "no better propagation": the best popped frontier node is already
-        # farther than the current worst result (reference increments per
-        # such pop, BKTIndex.cpp:139-144; an iteration here aggregates B
-        # pops, so the caller scales the limit by 1/B)
-        frontier_worse = (-sneg[:, 0]) > cand_d[:, k_eff - 1]
+        if merge_bins:
+            # ---- pop best B unexpanded entries: exact RANK-SELECT over
+            # the sorted pool (eligible entries stay ascending around the
+            # MAX_DIST voids, so the first B eligible positions ARE the
+            # best B — same selection, same tie order as the top_k below,
+            # without the L-wide sort)
+            elig = (~expanded[:, :L]) & (cand_d < MAX_DIST)
+            rank = jnp.where(elig,
+                             jnp.cumsum(elig.astype(jnp.int32), axis=1) - 1,
+                             B)                                  # B = drop
+            spos = jax.vmap(
+                lambda r: jnp.full((B,), L, jnp.int32).at[r].set(
+                    jnp.arange(L, dtype=jnp.int32), mode="drop"))(rank)
+            sel_ok = (spos < L) & active[:, None]
+            spos_safe = jnp.minimum(spos, L - 1)
+            sel_d = jnp.where(
+                sel_ok, jnp.take_along_axis(cand_d, spos_safe, axis=1),
+                MAX_DIST)
+            sel_ids = jnp.where(
+                sel_ok, jnp.take_along_axis(cand_ids, spos_safe, axis=1),
+                -1)
+            expanded = _scatter_true(expanded,
+                                     jnp.where(sel_ok, spos_safe, L))
+            best_pop_d = sel_d[:, 0]
+            frontier_worse = best_pop_d > cand_d[:, k_eff - 1]
+        else:
+            # ---- pop best B unexpanded entries ----------------------------
+            sel_score = jnp.where(expanded[:, :L], MAX_DIST, cand_d)
+            sneg, spos = jax.lax.top_k(-sel_score, B)            # (Q, B)
+            sel_ok = ((-sneg) < MAX_DIST) & active[:, None]
+            sel_ids = jnp.where(
+                sel_ok, jnp.take_along_axis(cand_ids, spos, axis=1), -1)
+            expanded = _scatter_true(expanded, jnp.where(sel_ok, spos, L))
+            # "no better propagation": the best popped frontier node is
+            # already farther than the current worst result (reference
+            # increments per such pop, BKTIndex.cpp:139-144; an iteration
+            # here aggregates B pops, so the caller scales the limit by
+            # 1/B)
+            best_pop_d = -sneg[:, 0]
+            frontier_worse = best_pop_d > cand_d[:, k_eff - 1]
 
         # ---- gather neighbors, dedupe against visited ---------------------
         nbrs = graph[jnp.maximum(sel_ids, 0)]                    # (Q, B, m)
@@ -468,20 +562,30 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
         flat = nbrs.reshape(Q, -1)                               # (Q, B*m)
         flat_safe = jnp.where(flat >= 0, flat, N)
         seen = _test_bits(visited, flat_safe)
-        # ONE argsort serves both the intra-batch duplicate mask and the
-        # bit marking (the loop previously paid three sorts per iteration:
-        # dup-mask argsort + inverse argsort + mark sort).  Sorting
-        # flat_safe keeps invalid ids (-> N) at the END so the array stays
-        # ascending for the segmented-OR marker; the inverse permutation
-        # comes from a scatter, not a second sort.
-        sorted_safe, dup = _sorted_dedup(flat_safe)
-        # a node reached from two popped parents in the SAME iteration is
-        # not yet in `visited` for either copy — dedupe within the batch or
-        # the beam accumulates duplicate entries
-        fresh = (flat >= 0) & ~seen & ~dup
-        # mark ALL valid candidates (OR is idempotent — re-marking seen
-        # ids changes nothing), so the pre-sorted array is reusable as-is
-        visited = _mark_bits_sorted(visited, sorted_safe)
+        if merge_bins:
+            # binned body: NO X-wide sort.  Same-iteration duplicates are
+            # collapsed after the merge's exact top-L (identical ids carry
+            # bit-identical distances and land adjacent there), and the
+            # visited marking is LAZY — only beam entrants are marked, in
+            # the merge below.  `seen` still excludes everything already
+            # in the beam or ever admitted to it (beam ⊆ visited).
+            fresh = (flat >= 0) & ~seen
+        else:
+            # ONE argsort serves both the intra-batch duplicate mask and
+            # the bit marking (the loop previously paid three sorts per
+            # iteration: dup-mask argsort + inverse argsort + mark sort).
+            # Sorting flat_safe keeps invalid ids (-> N) at the END so the
+            # array stays ascending for the segmented-OR marker; the
+            # inverse permutation comes from a scatter, not a second sort.
+            sorted_safe, dup = _sorted_dedup(flat_safe)
+            # a node reached from two popped parents in the SAME iteration
+            # is not yet in `visited` for either copy — dedupe within the
+            # batch or the beam accumulates duplicate entries
+            fresh = (flat >= 0) & ~seen & ~dup
+            # mark ALL valid candidates (OR is idempotent — re-marking
+            # seen ids changes nothing), so the pre-sorted array is
+            # reusable as-is
+            visited = _mark_bits_sorted(visited, sorted_safe)
 
         # ---- score fresh candidates (one batched contraction) -------------
         if nbr_vecs is not None:
@@ -512,7 +616,7 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
                 spare_d, jnp.minimum(ptr, Ps - 1)[:, None], axis=1)[:, 0]
             stalled = no_better + 1 >= nbp_limit     # would trip this iter
             trigger = active & (ptr < n_spare) & (
-                ((-sneg[:, 0]) > next_d) | stalled)
+                (best_pop_d > next_d) | stalled)
             idxs = ptr[:, None] + jnp.arange(inject, dtype=jnp.int32)
             ok = trigger[:, None] & (idxs < Ps)
             safe = jnp.minimum(idxs, Ps - 1)
@@ -534,13 +638,52 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
         all_exp = jnp.concatenate(
             [expanded[:, :L],
              jnp.zeros((Q, all_d.shape[1] - L), bool)], axis=1)
-        mneg, mpos = jax.lax.top_k(-all_d, L)
-        cand_d = -mneg
-        cand_ids = jnp.take_along_axis(all_ids, mpos, axis=1)
-        cand_ids = jnp.where(cand_d < MAX_DIST, cand_ids, -1)
-        expanded = jnp.concatenate(
-            [jnp.take_along_axis(all_exp, mpos, axis=1),
-             jnp.zeros((Q, 1), bool)], axis=1)
+        if merge_bins:
+            # bin-reduction merge: strided binning keeps the sorted beam
+            # prefix collision-free (cols 0..L-1 -> distinct bins because
+            # merge_bins >= L); each bin's best survives, then the exact
+            # top-L runs over the bins-wide winner row
+            vals, cols = topk_bins.bin_shortlist(all_d, merge_bins)
+            sh_ids = jnp.take_along_axis(all_ids, cols, axis=1)
+            sh_exp = jnp.take_along_axis(all_exp, cols, axis=1)
+            mneg, mpos = jax.lax.top_k(-vals, L)
+            cand_d = -mneg
+            cand_ids = jnp.take_along_axis(sh_ids, mpos, axis=1)
+            cand_ids = jnp.where(cand_d < MAX_DIST, cand_ids, -1)
+            new_exp = jnp.take_along_axis(sh_exp, mpos, axis=1)
+            # same-iteration multi-parent copies: collapse duplicates
+            # with the exact body's L-wide _sorted_dedup (an
+            # adjacency-only mask would miss copies separated by an
+            # unrelated bit-identical tie — common for integer
+            # distances).  The kept copy is the lowest original
+            # position = the better-ranked one, and the voids (-1 /
+            # MAX_DIST / expanded) keep the pool's eligible subsequence
+            # sorted, which the rank-select pop depends on.  ONE
+            # argsort serves both the dup mask and the lazy visited
+            # marking below.
+            safe_ids = jnp.where(cand_ids >= 0, cand_ids, N)
+            sorted_beam, dup = _sorted_dedup(safe_ids)
+            dup = dup & (cand_ids >= 0)
+            cand_ids = jnp.where(dup, -1, cand_ids)
+            cand_d = jnp.where(dup, MAX_DIST, cand_d)
+            expanded = jnp.concatenate(
+                [new_exp | dup, jnp.zeros((Q, 1), bool)], axis=1)
+            # lazy visited marking: beam ENTRANTS only (an L-wide mark
+            # instead of the exact body's X-wide ensemble; re-marking
+            # resident ids is an idempotent OR, so marking the voided
+            # dup copies too is harmless).  Shortlist-dropped
+            # candidates stay unmarked — rediscoverable via another
+            # parent, which is what keeps the binned walk's recall close
+            # to exact.
+            visited = _mark_bits_sorted(visited, sorted_beam)
+        else:
+            mneg, mpos = jax.lax.top_k(-all_d, L)
+            cand_d = -mneg
+            cand_ids = jnp.take_along_axis(all_ids, mpos, axis=1)
+            cand_ids = jnp.where(cand_d < MAX_DIST, cand_ids, -1)
+            expanded = jnp.concatenate(
+                [jnp.take_along_axis(all_exp, mpos, axis=1),
+                 jnp.zeros((Q, 1), bool)], axis=1)
 
         # non-live rows FREEZE their counter (see _walk_machine docstring:
         # resetting it on a non-worse frontier made a tripped row's fate
@@ -560,7 +703,8 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
 def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
           k: int, L: int, B: int, t_limit, metric: int, base: int,
           nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0,
-          data_score=None, nbr_vecs=None, nbr_sq=None):
+          data_score=None, nbr_vecs=None, nbr_sq=None, merge_bins: int = 0,
+          finalize_bins: int = 0):
     """Monolithic walk: run the shared body under one `lax.while_loop`
     until no row is alive, then finalize.  `t_limit` is a (Q,) traced
     budget vector (iterations per row) — budgets no longer mint compiles,
@@ -568,7 +712,8 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
     body, row_alive = _walk_machine(
         data, sqnorm, graph, queries, t_limit, k, L, B, metric, base,
         nbp_limit, spare_ids=spare_ids, spare_d=spare_d, inject=inject,
-        data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+        data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
+        merge_bins=merge_bins)
 
     def cond(state):
         return jnp.any(row_alive(state))
@@ -577,14 +722,17 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
     cand_ids, cand_d, *_ = jax.lax.while_loop(cond, body, state)
     rerank = data_score is not None and data_score.dtype != data.dtype
     return _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d,
-                     min(k, L), metric, base, rerank)
+                     min(k, L), metric, base, rerank,
+                     binned_bins=finalize_bins)
 
 
 def _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d, k_eff: int,
-              metric: int, base: int, rerank: bool):
+              metric: int, base: int, rerank: bool, binned_bins: int = 0):
     """Walk epilogue shared by the monolithic kernels and the scheduler's
     retire path: optional exact f32 re-rank of the L-pool, tombstone
-    filter, final top-k."""
+    filter, final top-k.  `binned_bins` > 0 routes the final selection
+    through the bin reduction (ops/topk_bins.py) — worthwhile only for
+    wide pools (engines gate it on the recall-target bin math)."""
     if rerank:
         # exact f32 re-rank of the final L-pool: one (Q, L, D) gather —
         # about the cost of a single loop iteration's candidate gather
@@ -596,8 +744,11 @@ def _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d, k_eff: int,
     # ---- final top-k with tombstones filtered -----------------------------
     dead = deleted[jnp.maximum(cand_ids, 0)] | (cand_ids < 0)
     out_d = jnp.where(dead, MAX_DIST, cand_d)
-    fneg, fpos = jax.lax.top_k(-out_d, k_eff)
-    final_d = -fneg
+    if binned_bins:
+        final_d, fpos = topk_bins.binned_topk(out_d, k_eff, binned_bins)
+    else:
+        fneg, fpos = jax.lax.top_k(-out_d, k_eff)
+        final_d = -fneg
     final_ids = jnp.take_along_axis(cand_ids, fpos, axis=1)
     final_ids = jnp.where(final_d < MAX_DIST, final_ids, -1)
     return final_d, final_ids.astype(jnp.int32)
@@ -606,13 +757,13 @@ def _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d, k_eff: int,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "S", "metric", "base", "nbp_limit",
-                     "inject"))
+                     "inject", "merge_bins"))
 def _beam_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
                          cand_d, expanded, visited, no_better, ptr, it,
                          k: int, L: int, B: int, S: int, metric: int,
                          base: int, nbp_limit: int, inject: int = 0,
                          spare_ids=None, spare_d=None, data_score=None,
-                         nbr_vecs=None, nbr_sq=None):
+                         nbr_vecs=None, nbr_sq=None, merge_bins: int = 0):
     """Segmented walk: at most S iterations of the SAME body the
     monolithic walk runs, over loop-carried state passed in and returned
     intact — the device half of the continuous-batching walk
@@ -623,7 +774,8 @@ def _beam_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
     body, row_alive = _walk_machine(
         data, sqnorm, graph, queries, t_limit, k, L, B, metric, base,
         nbp_limit, spare_ids=spare_ids, spare_d=spare_d, inject=inject,
-        data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq)
+        data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
+        merge_bins=merge_bins)
 
     def cond(carry):
         seg, state = carry
@@ -639,11 +791,13 @@ def _beam_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k_eff", "metric", "base", "rerank"))
+    jax.jit, static_argnames=("k_eff", "metric", "base", "rerank",
+                              "binned_bins"))
 def _beam_finalize_kernel(data, sqnorm, deleted, queries, cand_ids, cand_d,
-                          k_eff: int, metric: int, base: int, rerank: bool):
+                          k_eff: int, metric: int, base: int, rerank: bool,
+                          binned_bins: int = 0):
     return _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d,
-                     k_eff, metric, base, rerank)
+                     k_eff, metric, base, rerank, binned_bins=binned_bins)
 
 
 # ---------------------------------------------------------------------------
@@ -656,11 +810,29 @@ def _beam_finalize_kernel(data, sqnorm, deleted, queries, cand_ids, cand_d,
 # sampled roofline gauges, the scheduler's per-query attribution) scale
 # by their own iteration counts.
 
-def _walk_iter_cost(Q, X, D, W, score_itemsize=4, **_):
+def _walk_iter_cost(Q, X, D, W, score_itemsize=4, merge_bins=0, L=0, N=0,
+                    **_):
     """One _walk_machine body application at batch Q: the B*m = X
     candidate gather + scoring contraction dominates; the fitted
     WALK_SORT_* constants carry the argsort/segmented-scan/top-k
-    ensemble (calibrated against HloCostAnalysis; tests pin ±15%)."""
+    ensemble (calibrated against HloCostAnalysis; tests pin ±15%).
+
+    `merge_bins` > 0 prices the BINNED body instead: the X-wide sort
+    ensemble is gone — what remains is the (L + X)-wide bin reduction +
+    shortlist top-L (WALK_BINNED_* constants, per merged-row element)
+    and the L-wide lazy-mark sort ensemble (the WALK_SORT_* constants at
+    width L)."""
+    if merge_bins:
+        wall = X + max(L, 1)
+        flops = (2.0 * Q * X * D
+                 + costmodel.WALK_BINNED_FLOPS * Q * wall
+                 + costmodel.WALK_SORT_FLOPS * Q * max(L, 1))
+        nbytes = (2.0 * Q * X * D * score_itemsize
+                  + N * D * score_itemsize       # corpus gather operand
+                  + costmodel.WALK_BINNED_TRAFFIC * Q * wall * 4
+                  + costmodel.WALK_SORT_TRAFFIC * Q * max(L, 1) * 4
+                  + 2.0 * Q * W * 4)
+        return flops, nbytes
     flops = 2.0 * Q * X * D + costmodel.WALK_SORT_FLOPS * Q * X
     nbytes = (2.0 * Q * X * D * score_itemsize
               + costmodel.WALK_SORT_TRAFFIC * Q * X * 4
@@ -690,22 +862,27 @@ def _finalize_cost(Q, L, D, N, rerank=True, itemsize=4, **_):
     return flops, nbytes
 
 
-def _segment_cost(Q, X, D, W, score_itemsize=4, **_):
-    return _walk_iter_cost(Q, X, D, W, score_itemsize)
+def _segment_cost(Q, X, D, W, score_itemsize=4, merge_bins=0, L=0, N=0,
+                  **_):
+    return _walk_iter_cost(Q, X, D, W, score_itemsize,
+                           merge_bins=merge_bins, L=L, N=N)
 
 
-def _walk_full_cost(Q, P, X, D, L, W, N, score_itemsize=4, **_):
+def _walk_full_cost(Q, P, X, D, L, W, N, score_itemsize=4, merge_bins=0,
+                    **_):
     """Monolithic seed + walk + finalize, body counted once."""
     fs, bs = _seed_pivot_cost(Q, P, D, L, W)
-    fi, bi = _walk_iter_cost(Q, X, D, W, score_itemsize)
+    fi, bi = _walk_iter_cost(Q, X, D, W, score_itemsize,
+                             merge_bins=merge_bins, L=L, N=N)
     ff, bf = _finalize_cost(Q, L, D, N, rerank=False)
     return fs + fi + ff, bs + bi + bf
 
 
 def _walk_seeded_cost(Q, S, X, D, L, W, N, score_itemsize=4, itemsize=4,
-                      **_):
+                      merge_bins=0, **_):
     fs, bs = _seed_seeded_cost(Q, S, D, N, L, W, itemsize)
-    fi, bi = _walk_iter_cost(Q, X, D, W, score_itemsize)
+    fi, bi = _walk_iter_cost(Q, X, D, W, score_itemsize,
+                             merge_bins=merge_bins, L=L, N=N)
     ff, bf = _finalize_cost(Q, L, D, N, rerank=False)
     return fs + fi + ff, bs + bi + bf
 
@@ -746,12 +923,22 @@ class GraphSearchEngine:
                  score_dtype: str = "auto",
                  packed_neighbors: bool = False,
                  device_sample_rate: float = 0.0,
-                 roofline_probe: bool = False):
+                 roofline_probe: bool = False,
+                 binned_topk: str = "off",
+                 recall_target: float = topk_bins.DEFAULT_RECALL_TARGET):
         n = data.shape[0]
         assert graph.shape[0] == n, (graph.shape, n)
         self.n = n
         self.metric = DistCalcMethod(metric)
         self.base = base
+        # bin-reduction top-k (BinnedTopK param, ops/topk_bins.py):
+        # "off" keeps every selection exact (bit-parity path), "on"
+        # forces the binned frontier merge + finalize, "auto" engages
+        # them only at shapes where the reduction actually shrinks the
+        # sorted width.  Baked into the snapshot like score_dtype — a
+        # param flip invalidates the engine, never a live program.
+        self.binned_mode = topk_bins.normalize_mode(binned_topk)
+        self.recall_target = topk_bins.validate_recall_target(recall_target)
         self.data = jnp.asarray(data)
         # bf16 shadow corpus for in-loop scoring (BeamScoreDtype param):
         # halves the walk's dominant gather bytes and doubles the MXU rate
@@ -883,6 +1070,31 @@ class GraphSearchEngine:
         allows (packed bitset: 4 bytes per 32 ids -> N/8 bytes/query)."""
         return max(1, min(_VISITED_BUDGET // max(self.n // 8, 1), 1024))
 
+    def merge_bins_for(self, L: int, B: int) -> int:
+        """Bin count of the walk's binned frontier merge at pool size L
+        (0 = exact merge) — delegates to THE shared rule
+        (topk_bins.walk_merge_bins; the sharded/mesh kernels use the
+        same one, which is what keeps their id-parity contract intact
+        with BinnedTopK on)."""
+        return topk_bins.walk_merge_bins(
+            self.binned_mode, L, L + B * int(self.graph.shape[1]))
+
+    def seed_keep_for(self, L: int) -> int:
+        """Spare-queue depth of the binned pivot seeding (0 = exact
+        argsort seeding) — the shared topk_bins.seed_spare_keep rule at
+        this engine's pivot-pool width."""
+        return topk_bins.seed_spare_keep(
+            self.binned_mode, L, max(int(self.pivot_ids.shape[0]), L))
+
+    def finalize_bins_for(self, k_eff: int, L: int) -> int:
+        """Bin count of the finalize top-k over the L-wide pool (0 =
+        exact); sized by the recall-target formula, so it only engages
+        for pools much wider than k_eff."""
+        if self.binned_mode == "off":
+            return 0
+        return topk_bins.resolve_bins(self.binned_mode, k_eff, L,
+                                      self.recall_target)
+
     def score_itemsize(self) -> int:
         """Bytes per element of the in-loop scoring corpus (bf16 shadow
         halves the walk's gather bytes) — the cost ledger's byte scale."""
@@ -897,15 +1109,19 @@ class GraphSearchEngine:
         return ("int8" if jnp.issubdtype(self.data.dtype, jnp.integer)
                 else "f32")
 
-    def walk_iter_cost(self, rows: int, B: int):
+    def walk_iter_cost(self, rows: int, B: int, L: int = 0):
         """Ledger estimate of ONE walk-body iteration at batch `rows`
         (the beam.segment family's unit) — shared by the sampled
         roofline gauges and the scheduler's per-query slow-query
-        attribution."""
+        attribution.  Pass the pool size `L` so a binned-merge engine
+        prices the binned body; L=0 prices the exact body (the
+        attribution paths that don't know L keep their old estimate)."""
         return costmodel.estimate(
             "beam.segment", Q=rows, X=B * self.graph.shape[1],
             D=self.data.shape[1], W=_num_words(self.n),
-            score_itemsize=self.score_itemsize())
+            score_itemsize=self.score_itemsize(),
+            merge_bins=self.merge_bins_for(L, B) if L else 0, L=L,
+            N=self.n)
 
     def seed_state(self, queries: jax.Array, L: int,
                    seeds: Optional[jax.Array] = None) -> dict:
@@ -918,7 +1134,8 @@ class GraphSearchEngine:
             cand_ids, cand_d, visited, spare_ids, spare_d = \
                 _beam_seed_kernel(self.pivot_ids, self.pivot_vecs,
                                   self.pivot_mask, queries, L,
-                                  int(self.metric))
+                                  int(self.metric),
+                                  seed_keep=self.seed_keep_for(L))
         else:
             cand_ids, cand_d, visited = _beam_seed_seeded_kernel(
                 self.data, self.sqnorm, seeds, queries, L,
@@ -953,7 +1170,8 @@ class GraphSearchEngine:
             inject=inject if spare_ids is not None else 0,
             spare_ids=spare_ids, spare_d=state["spare_d"],
             data_score=self.data_score, nbr_vecs=self.nbr_vecs,
-            nbr_sq=self.nbr_sq)
+            nbr_sq=self.nbr_sq,
+            merge_bins=self.merge_bins_for(L, B))
         if sample:
             # dispatch-to-completion wall time: the kernel call returns as
             # soon as XLA enqueues, so only a sampled block_until_ready
@@ -969,7 +1187,7 @@ class GraphSearchEngine:
             # is an upper bound when rows converge mid-segment — the
             # gauges can overstate achieved rates near a drain tail,
             # never understate headroom at steady state.
-            est = self.walk_iter_cost(rows, B)
+            est = self.walk_iter_cost(rows, B, L)
             flops = est.flops * S
             nbytes = est.hbm_bytes * S
             dev_s = max(dev_ns, 1) / 1e9
@@ -1000,7 +1218,9 @@ class GraphSearchEngine:
         d, ids = _beam_finalize_kernel(
             self.data, self.sqnorm, self.deleted, state["queries"],
             state["cand_ids"], state["cand_d"], k_eff, int(self.metric),
-            self.base, rerank)
+            self.base, rerank,
+            binned_bins=self.finalize_bins_for(
+                k_eff, int(state["cand_ids"].shape[1])))
         return np.asarray(d), np.asarray(ids)
 
     def _search_segmented(self, queries: np.ndarray,
@@ -1067,6 +1287,9 @@ class GraphSearchEngine:
         nq = queries.shape[0]
         k_eff, L, B, T, limit = self.walk_plan(k, max_check, beam_width,
                                                pool_size, nbp_limit)
+        mb = self.merge_bins_for(L, B)
+        fb = self.finalize_bins_for(k_eff, L)
+        sk = self.seed_keep_for(L)
         chunk = self.chunk_size()
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
@@ -1092,7 +1315,8 @@ class GraphSearchEngine:
                     jnp.asarray(q), t_limit,
                     k_eff, L, B, int(self.metric), self.base, limit,
                     inject=dynamic_pivots, data_score=self.data_score,
-                    nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
+                    nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
+                    merge_bins=mb, finalize_bins=fb, seed_keep=sk)
             else:
                 s = seeds.astype(np.int32, copy=False)
                 if q_pad != nq:
@@ -1104,7 +1328,8 @@ class GraphSearchEngine:
                     jnp.asarray(s), jnp.asarray(q), t_limit,
                     k_eff, L, B, int(self.metric), self.base, limit,
                     data_score=self.data_score,
-                    nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
+                    nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
+                    merge_bins=mb, finalize_bins=fb)
             out_d[:, :k_eff] = np.asarray(d)[:nq]
             out_i[:, :k_eff] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -1124,7 +1349,8 @@ class GraphSearchEngine:
                 jnp.asarray(q.reshape(m, chunk, D)), t_limit,
                 k_eff, L, B, int(self.metric), self.base, limit,
                 inject=dynamic_pivots, data_score=self.data_score,
-                nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
+                nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
+                merge_bins=mb, finalize_bins=fb, seed_keep=sk)
         else:
             s = seeds.astype(np.int32, copy=False)
             if m * chunk != nq:
@@ -1137,7 +1363,8 @@ class GraphSearchEngine:
                 jnp.asarray(q.reshape(m, chunk, D)), t_limit,
                 k_eff, L, B, int(self.metric), self.base, limit,
                 data_score=self.data_score,
-                nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq)
+                nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
+                merge_bins=mb, finalize_bins=fb)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :k_eff] = d[:nq]

@@ -35,6 +35,7 @@ from sptag_tpu.core.types import (
 )
 from sptag_tpu.io import format as fmt
 from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.ops import topk_bins
 from sptag_tpu.utils import costmodel, devmem, round_up
 
 _ROW_PAD = 128      # pad corpus rows to multiples of this (TPU lane width)
@@ -49,27 +50,40 @@ def _query_bucket(q: int) -> int:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "metric", "base", "approx"))
+                   static_argnames=("k", "metric", "base", "approx",
+                                    "recall_target", "binned_bins"))
 def _flat_search_kernel(data, sqnorm, invalid, queries, k: int,
-                        metric: int, base: int, approx: bool = False):
+                        metric: int, base: int, approx: bool = False,
+                        recall_target: float = 0.99,
+                        binned_bins: int = 0):
     """One fused program: distance matrix -> mask -> top-k.
 
     `approx=True` selects `lax.approx_max_k` — the TPU's hardware-
     accelerated partial-reduction top-k (the peak-FLOP/s KNN recipe of
     arXiv:2206.14286, PAPERS.md): the (Q, N) selection stops being the
-    bottleneck of the exact scan at large N.  Per-op recall_target 0.99;
+    bottleneck of the exact scan at large N.  Per-op `recall_target`
+    (the ApproxRecallTarget parameter — previously a hard-coded 0.99);
     the handful of true neighbors it may miss are beyond the exactness
-    contract the `ApproxTopK` parameter explicitly trades away."""
+    contract the `ApproxTopK` parameter explicitly trades away.
+
+    `binned_bins` > 0 selects the portable bin-reduction top-k instead
+    (ops/topk_bins.py, BinnedTopK): same coarse-select shape, but it
+    accelerates every backend — `approx_max_k` lowers to a full sort
+    off-TPU.  When both are set, binned wins (it subsumes the recipe)."""
     if metric == int(DistCalcMethod.L2):
         d = dist_ops.pairwise_l2(queries, data, sqnorm)
     else:
         d = dist_ops.pairwise_cosine(queries, data, base)
     d = jnp.where(invalid[None, :], jnp.float32(MAX_DIST), d)
-    if approx:
-        neg, idx = jax.lax.approx_max_k(-d, k, recall_target=0.99)
+    if binned_bins:
+        dists, idx = topk_bins.binned_topk(d, k, binned_bins)
+    elif approx:
+        neg, idx = jax.lax.approx_max_k(-d, k,
+                                        recall_target=recall_target)
+        dists = -neg
     else:
         neg, idx = jax.lax.top_k(-d, k)
-    dists = -neg
+        dists = -neg
     ids = jnp.where(dists >= jnp.float32(MAX_DIST), -1, idx).astype(jnp.int32)
     return dists, ids
 
@@ -184,13 +198,25 @@ def _flat_sketch_kernel(data, sqnorm, invalid, sketches, mean, queries,
 # cost-ledger entries (utils/costmodel.py; graftlint GL605)
 # ---------------------------------------------------------------------------
 
-def _flat_scan_cost(Q, N, D, k, itemsize=4, **_):
+def _flat_scan_cost(Q, N, D, k, itemsize=4, binned_bins=0, **_):
     """Exact scan: one (Q, D) x (N, D) contraction + norms + masked
     top-k.  Bytes: corpus + queries + norms/tombstones in, results out,
     plus the materialized (Q, N) score matrix's mask/neg/top-k traffic
-    (the SCAN_MATRIX_TRAFFIC calibration)."""
+    (the SCAN_MATRIX_TRAFFIC calibration).  With `binned_bins` the
+    selection is the bin reduction: the (Q, N) matrix traversals stay
+    (mask + the min/argmin reduction reads), plus the shortlist select
+    (ops/topk_bins.binned_select_cost) — the win is the SORT the exact
+    top-k would add on top, which the exact branch's topk term carries
+    implicitly in XLA's numbers, not in this formula."""
     flops = (costmodel.matmul_flops(Q, N, D) + 2.0 * D * (Q + N)
              + 2.0 * Q * N)
+    if binned_bins:
+        sel_f, sel_b = topk_bins.binned_select_cost(Q, N, k, binned_bins)
+        nbytes = (N * D * itemsize + Q * D * itemsize + N * 4 + N
+                  + Q * k * 8
+                  + costmodel.SCAN_MATRIX_TRAFFIC * Q * N * 4
+                  + sel_b)
+        return flops + sel_f, nbytes
     nbytes = (N * D * itemsize + Q * D * itemsize + N * 4 + N + Q * k * 8
               + costmodel.SCAN_MATRIX_TRAFFIC * Q * N * 4)
     return flops, nbytes
@@ -500,10 +526,16 @@ class FlatIndex(VectorIndex):
                 jnp.asarray(queries), k_eff, R,
                 int(self.dist_calc_method), self.base)
         else:
+            rt = topk_bins.validate_recall_target(
+                getattr(self.params, "approx_recall_target", 0.99))
+            bins = topk_bins.resolve_bins(
+                str(getattr(self.params, "binned_topk", "off")), k_eff,
+                data_d.shape[0], rt)
             dists, ids = _flat_search_kernel(
                 data_d, sqnorm_d, invalid_d, jnp.asarray(queries), k_eff,
                 int(self.dist_calc_method), self.base,
-                approx=bool(getattr(self.params, "approx_topk", False)))
+                approx=bool(getattr(self.params, "approx_topk", False)),
+                recall_target=rt, binned_bins=bins)
         dists = np.asarray(dists)[:q]
         ids = np.asarray(ids)[:q]
         if k_eff < k:

@@ -138,10 +138,19 @@ class _SlotPool:
     def iter_cost1(self):
         """Ledger cost of ONE walk iteration for ONE query in this pool
         (slow-query roofline attribution); None when the engine predates
-        the cost ledger or the family is unregistered."""
+        the cost ledger or the family is unregistered.  Estimated at the
+        pool's slot count and divided down: the binned body's byte
+        formula carries a per-DISPATCH corpus-operand term (N*D) that a
+        Q=1 estimate would charge in full to every query (the same
+        amortization bench.py's roofline row applies)."""
         if self._iter_cost1 is None:
             try:
-                self._iter_cost1 = self.engine.walk_iter_cost(1, self.B)
+                rows = max(int(self.slots), 1)
+                est = self.engine.walk_iter_cost(rows, self.B, self.L)
+                from sptag_tpu.utils.costmodel import CostEstimate
+
+                self._iter_cost1 = CostEstimate(
+                    est.family, est.flops / rows, est.hbm_bytes / rows)
             except Exception:                             # noqa: BLE001
                 self._iter_cost1 = False
         return self._iter_cost1 or None

@@ -219,6 +219,22 @@ _COMMON_TAIL_SPECS = [
     _spec("mesh_serve", int, 0, "MeshServe"),
     _spec("mesh_shard_axis", int, 0, "MeshShardAxis"),
     _spec("mesh_k_local", int, 0, "MeshKLocal"),
+    # bin-reduction top-k (ops/topk_bins.py, ISSUE 13 — the TPU-KNN
+    # peak-FLOP/s recipe, arXiv:2206.14286).  "off" (default) keeps
+    # every selection exact and serve bytes byte-identical; "on" forces
+    # the binned beam-walk frontier merge + finalize and the binned
+    # dense/flat final select; "auto" engages each site only when the
+    # scored row is wide enough that the reduction beats the exact
+    # top-k (at least 2x the bin count).  Engine-baked: a flip on a
+    # warm index invalidates the snapshot, never patches a live program
+    _spec("binned_topk", str, "off", "BinnedTopK"),
+    # recall target of the approximate selections: sizes the bin count
+    # of BinnedTopK's recall-target sites (dense/flat final select,
+    # walk finalize) AND replaces the previously hard-coded 0.99 of the
+    # FLAT ApproxTopK path.  (0, 1]; 1.0 = exact.  The beam MERGE's bin
+    # count is structural (>= pool size), not recall-target-sized —
+    # see DESIGN.md §19
+    _spec("approx_recall_target", float, 0.99, "ApproxRecallTarget"),
 ] + [
     # live-mutation durability + delta-shard knobs (ISSUE 9).  All
     # default OFF: serve bytes and on-disk layout are unchanged until an
@@ -409,11 +425,22 @@ class FlatParams(ParamSet):
         _spec("max_check", int, 8192, "MaxCheck"),
         _spec("batch_size", int, 256, "BatchSize"),
         # TPU-only, opt-in: hardware-accelerated approximate top-k
-        # (lax.approx_max_k, recall_target 0.99 per op — the peak-FLOP/s
-        # KNN recipe, arXiv:2206.14286) instead of the exact sort-based
-        # selection.  Trades the index's exactness guarantee for
-        # selection speed at large N; distances of returned ids stay exact
+        # (lax.approx_max_k at ApproxRecallTarget per op — the
+        # peak-FLOP/s KNN recipe, arXiv:2206.14286) instead of the exact
+        # sort-based selection.  Trades the index's exactness guarantee
+        # for selection speed at large N; distances of returned ids stay
+        # exact
         _spec("approx_topk", bool, False, "ApproxTopK"),
+        # bin-reduction top-k over the (Q, N) scan rows (ops/topk_bins
+        # .py): off/on/auto, same semantics as the graph indexes' spec
+        # of this name.  Works on every backend (approx_max_k is
+        # TPU-accelerated only); composable with ApproxTopK — binned
+        # wins where approx_max_k is unavailable or falls back to sort
+        _spec("binned_topk", str, "off", "BinnedTopK"),
+        # recall target shared by ApproxTopK (per-op recall_target,
+        # previously hard-coded 0.99) and BinnedTopK's bin-count math;
+        # (0, 1], 1.0 = exact.  Swept by bench's Pareto stage
+        _spec("approx_recall_target", float, 0.99, "ApproxRecallTarget"),
         # TPU-only, opt-in: 1-bit sign-sketch pre-filter (XOR-friendly
         # binary quantization, arXiv:2008.02002 PAPERS.md).  The scan
         # reads packed (N, ceil(D/32)) int32 sketches — 1/32 of the f32

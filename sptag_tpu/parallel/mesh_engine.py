@@ -53,6 +53,7 @@ from sptag_tpu.algo.engine import (
     beam_pool_size,
     beam_width_for,
 )
+from sptag_tpu.ops import topk_bins
 from sptag_tpu.parallel._compat import shard_map
 from sptag_tpu.utils import costmodel, roofline
 
@@ -78,9 +79,10 @@ def _state_specs():
     return (r3, r3, r3, r3, r2, r2, r2)
 
 
-@functools.partial(jax.jit, static_argnames=("L", "metric", "mesh"))
+@functools.partial(jax.jit, static_argnames=("L", "metric", "mesh",
+                                             "seed_keep"))
 def _mesh_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
-                      metric: int, mesh: Mesh):
+                      metric: int, mesh: Mesh, seed_keep: int = 0):
     """Per-shard pivot seeding of the replicated query batch: each shard
     runs the single-chip `_seed_from_pivots` against its own pivot set
     and returns the initialized walk state with the shard axis at
@@ -88,7 +90,8 @@ def _mesh_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
 
     def local(pids, pvecs, pmask, q):
         cand_ids, cand_d, visited, spare_ids, spare_d = _seed_from_pivots(
-            pids[0], pvecs[0], pmask[0], q, L, metric)
+            pids[0], pvecs[0], pmask[0], q, L, metric,
+            seed_keep=seed_keep)
         state = _init_walk_state(cand_ids, cand_d, visited)
         return tuple(_shardax(a) for a in state) + (
             _shardax(spare_ids), _shardax(spare_d))
@@ -106,12 +109,12 @@ def _mesh_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
 @functools.partial(
     jax.jit,
     static_argnames=("k_local", "L", "B", "S", "metric", "base",
-                     "nbp_limit", "inject", "mesh"))
+                     "nbp_limit", "inject", "mesh", "merge_bins"))
 def _mesh_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
                          cand_d, expanded, visited, no_better, ptr, it,
                          spare_ids, spare_d, k_local: int, L: int, B: int,
                          S: int, metric: int, base: int, nbp_limit: int,
-                         inject: int, mesh: Mesh):
+                         inject: int, mesh: Mesh, merge_bins: int = 0):
     """Mesh-wide segment step: every shard advances its rows by at most
     S iterations of the SAME `_walk_machine` body the single-chip
     segment kernel runs, over its own slice of the corpus/graph.  No
@@ -128,7 +131,7 @@ def _mesh_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
         body, row_alive = _walk_machine(
             data_s, sqnorm_s, graph_s, q, tl, k_local, L, B, metric,
             base, nbp_limit, spare_ids=si[:, 0], spare_d=sd[:, 0],
-            inject=inject)
+            inject=inject, merge_bins=merge_bins)
 
         def cond(carry):
             seg, st = carry
@@ -155,21 +158,27 @@ def _mesh_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k_local", "k_final", "metric", "base", "mesh"))
+    static_argnames=("k_local", "k_final", "metric", "base", "mesh",
+                     "binned_bins"))
 def _mesh_finalize_kernel(data, sqnorm, deleted, queries, cand_ids,
                           cand_d, k_local: int, k_final: int, metric: int,
-                          base: int, mesh: Mesh):
+                          base: int, mesh: Mesh, binned_bins: int = 0):
     """Retire epilogue: per-shard rerank/tombstone-filter/top-k_local
     (identical to the single-chip finalize), shard-local ids remapped to
     global, then the ICI all-gather + `lax.top_k` global merge — the
-    same merge the monolithic `_sharded_beam_kernel` performs."""
+    same merge the monolithic `_sharded_beam_kernel` performs.
+    `binned_bins` routes the per-shard local select through the bin
+    reduction (BinnedTopK): the all-gather still moves only k_local
+    entries per shard, so the reduction shrinks the local sort without
+    touching ICI bytes (MeshKLocal owns that axis)."""
     from sptag_tpu.parallel.sharded import _gather_merge
 
     def local(data_s, sqnorm_s, del_s, q, ci, cd):
         n_local = data_s.shape[0]
         shard = jax.lax.axis_index(SHARD_AXIS)
         d, ids = _finalize(data_s, sqnorm_s, del_s, q, ci[:, 0], cd[:, 0],
-                           k_local, metric, base, rerank=False)
+                           k_local, metric, base, rerank=False,
+                           binned_bins=binned_bins)
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 
@@ -197,8 +206,10 @@ def _mesh_seed_cost(Q, P, D, L, W, n_dev, **_):
     return n_dev * f, n_dev * b
 
 
-def _mesh_segment_cost(Q, X, D, W, n_dev, score_itemsize=4, **_):
-    f, b = _walk_iter_cost(Q, X, D, W, score_itemsize)
+def _mesh_segment_cost(Q, X, D, W, n_dev, score_itemsize=4,
+                       merge_bins=0, L=0, N=0, **_):
+    f, b = _walk_iter_cost(Q, X, D, W, score_itemsize,
+                           merge_bins=merge_bins, L=L, N=N)
     return n_dev * f, n_dev * b
 
 
@@ -235,6 +246,16 @@ class MeshGraphEngine:
 
     def __init__(self, sharded, roofline_probe: bool = False):
         self._sharded = sharded
+        # BinnedTopK rides the shard params (the same engine-baked knob
+        # the single-chip engine resolves); one shared rule per site —
+        # topk_bins.walk_merge_bins / resolve_bins — so the scheduler
+        # path stays id-identical to the monolithic mesh search
+        self.binned_mode = topk_bins.normalize_mode(
+            getattr(getattr(sharded, "params", None), "binned_topk",
+                    "off"))
+        self.recall_target = topk_bins.validate_recall_target(
+            getattr(getattr(sharded, "params", None),
+                    "approx_recall_target", 0.99))
         self.mesh: Mesh = sharded.mesh
         self.n = int(sharded.n)
         self.n_local = int(sharded.n_local)
@@ -286,6 +307,21 @@ class MeshGraphEngine:
         return max(1, min(_VISITED_BUDGET // max(self.n_local // 8, 1),
                           1024))
 
+    def merge_bins_for(self, L: int, B: int) -> int:
+        """Shared walk-merge bin rule (see GraphSearchEngine)."""
+        return topk_bins.walk_merge_bins(
+            self.binned_mode, L, L + B * int(self.graph.shape[1]))
+
+    def seed_keep_for(self, L: int) -> int:
+        """Shared binned-seeding rule at the PER-SHARD pivot width."""
+        return topk_bins.seed_spare_keep(
+            self.binned_mode, L,
+            max(int(self.pivot_ids.shape[1]), L))
+
+    def finalize_bins_for(self, k_local: int, L: int) -> int:
+        return topk_bins.resolve_bins(self.binned_mode, k_local, L,
+                                      self.recall_target)
+
     def score_itemsize(self) -> int:
         return int(jnp.dtype(self.data.dtype).itemsize)
 
@@ -293,14 +329,18 @@ class MeshGraphEngine:
         return ("int8" if jnp.issubdtype(self.data.dtype, jnp.integer)
                 else "f32")
 
-    def walk_iter_cost(self, rows: int, B: int):
+    def walk_iter_cost(self, rows: int, B: int, L: int = 0):
         """Total mesh device work of ONE walk iteration at batch `rows`
         (every shard walks simultaneously) — the scheduler's per-query
-        roofline attribution unit."""
+        roofline attribution unit.  `L` prices the binned body when the
+        engine runs BinnedTopK (same contract as the single-chip
+        engine's walk_iter_cost)."""
         return costmodel.estimate(
             "sharded.segment", Q=rows, X=B * self.graph.shape[1],
             D=self.data.shape[1], W=_num_words(self.n_local),
-            n_dev=self.n_shards, score_itemsize=self.score_itemsize())
+            n_dev=self.n_shards, score_itemsize=self.score_itemsize(),
+            merge_bins=self.merge_bins_for(L, B) if L else 0, L=L,
+            N=self.n_local)
 
     def seed_state(self, queries: jax.Array, L: int,
                    seeds: Optional[jax.Array] = None) -> dict:
@@ -309,7 +349,8 @@ class MeshGraphEngine:
                 "mesh scheduler path seeds from per-shard pivots only")
         out = _mesh_seed_kernel(self.pivot_ids, self.pivot_vecs,
                                 self.pivot_mask, queries, L,
-                                int(self.metric), self.mesh)
+                                int(self.metric), self.mesh,
+                                seed_keep=self.seed_keep_for(L))
         (cand_ids, cand_d, expanded, visited, no_better, ptr, it,
          spare_ids, spare_d) = out
         return {"queries": queries, "cand_ids": cand_ids, "cand_d": cand_d,
@@ -326,7 +367,8 @@ class MeshGraphEngine:
             state["visited"], state["no_better"], state["ptr"],
             state["it"], state["spare_ids"], state["spare_d"],
             self._k_local(k_eff), L, B, S, int(self.metric), self.base,
-            nbp_limit, inject, self.mesh)
+            nbp_limit, inject, self.mesh,
+            merge_bins=self.merge_bins_for(L, B))
         new = dict(state)
         (new["cand_ids"], new["cand_d"], new["expanded"], new["visited"],
          new["no_better"], new["ptr"], new["it"], alive) = out
@@ -339,5 +381,8 @@ class MeshGraphEngine:
         d, ids = _mesh_finalize_kernel(
             self.data, self.sqnorm, self.deleted, state["queries"],
             state["cand_ids"], state["cand_d"], self._k_local(k_eff),
-            k_eff, int(self.metric), self.base, self.mesh)
+            k_eff, int(self.metric), self.base, self.mesh,
+            binned_bins=self.finalize_bins_for(
+                self._k_local(k_eff),
+                int(state["cand_ids"].shape[-1])))
         return np.asarray(d), np.asarray(ids)

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sptag_tpu.core.index import MAX_DIST
 from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
+from sptag_tpu.ops import topk_bins
 from sptag_tpu.parallel._compat import shard_map
 from sptag_tpu.utils import costmodel, devmem, locksan, metrics, round_up
 
@@ -174,11 +175,14 @@ class ShardedFlatIndex:
 @functools.partial(
     jax.jit,
     static_argnames=("k_local", "k_final", "L", "B", "T", "metric", "base",
-                     "nbp_limit", "mesh"))
+                     "nbp_limit", "mesh", "merge_bins", "finalize_bins",
+                     "seed_keep"))
 def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                          pivot_mask, queries, k_local: int, k_final: int,
                          L: int, B: int, T: int,
-                         metric: int, base: int, nbp_limit: int, mesh: Mesh):
+                         metric: int, base: int, nbp_limit: int, mesh: Mesh,
+                         merge_bins: int = 0, finalize_bins: int = 0,
+                         seed_keep: int = 0):
     """One program: per-shard pivot-seeded beam walk over the shard's OWN
     RNG graph (local ids), then ICI all-gather of each shard's (dist,
     global-id) top-k and a global top-k re-rank.  This subsumes the
@@ -195,7 +199,8 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
         d, ids = _beam_search_kernel(
             data_s, sqnorm_s, graph_s, deleted_s, pids_s[0], pvecs_s[0],
             pmask_s[0], q_s, t_limit, k_local, L, B, metric, base,
-            nbp_limit)
+            nbp_limit, merge_bins=merge_bins, finalize_bins=finalize_bins,
+            seed_keep=seed_keep)
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 
@@ -215,11 +220,12 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
 @functools.partial(
     jax.jit,
     static_argnames=("k_local", "k_final", "nprobe", "metric", "base",
-                     "dedup", "mesh"))
+                     "dedup", "mesh", "binned_bins"))
 def _sharded_dense_kernel(data_perm, member_ids, member_sq, centroids,
                           cent_sq, cent_valid, deleted, queries,
                           k_local: int, k_final: int, nprobe: int,
-                          metric: int, base: int, dedup: bool, mesh: Mesh):
+                          metric: int, base: int, dedup: bool, mesh: Mesh,
+                          binned_bins: int = 0):
     """One program: per-shard dense block scan (each shard probes the top
     `nprobe` of its OWN kd/k-means partition blocks — padded blocks are
     masked out of the centroid ranking), then ICI all-gather + global
@@ -243,7 +249,8 @@ def _sharded_dense_kernel(data_perm, member_ids, member_sq, centroids,
         vecs = dp_s[0][topc].reshape(Q, nprobe * Pb, dp_s.shape[3])
         nd = dist_ops.batched_gathered_distance(
             q_s, vecs, DistCalcMethod(metric), base, sq)
-        d, out_ids = _finalize_topk(nd, ids, del_s, dedup, k_local)
+        d, out_ids = _finalize_topk(nd, ids, del_s, dedup, k_local,
+                                    binned_bins=binned_bins)
         gids = jnp.where(out_ids >= 0, out_ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 
@@ -1071,7 +1078,10 @@ class ShardedBKTIndex:
             self.dense_perm, self.dense_ids, self.dense_sq,
             self.dense_cent, self.dense_cent_sq, self.dense_cent_valid,
             self.deleted, jnp.asarray(queries), k_local, k_final, nprobe,
-            int(self.metric), self.base, False, self.mesh)
+            int(self.metric), self.base, False, self.mesh,
+            binned_bins=topk_bins.resolve_bins(
+                self._binned_mode(), k_local,
+                nprobe * self.dense_cluster_size, self._recall_target()))
         return _pad_to_k(np.asarray(d), np.asarray(ids), k, k_final)
 
     def _place(self, data, graph, deleted, pivot_ids, pivot_vecs,
@@ -1196,6 +1206,17 @@ class ShardedBKTIndex:
         return self._search_raw(queries, k, mc_shard, beam_width,
                                 pool_size)
 
+    def _binned_mode(self) -> str:
+        """BinnedTopK of the shard params (the mesh face of the
+        engine-baked knob); normalized once per call — the kernels key
+        their compiles on the resolved bin count, not the string."""
+        return topk_bins.normalize_mode(
+            getattr(self.params, "binned_topk", "off"))
+
+    def _recall_target(self) -> float:
+        return topk_bins.validate_recall_target(
+            getattr(self.params, "approx_recall_target", 0.99))
+
     def _merge_k_local(self, k: int) -> int:
         """Per-shard contribution to the global merge: min(k, n_local)
         by default; `MeshKLocal` (core/params.py) caps it lower to trade
@@ -1217,9 +1238,20 @@ class ShardedBKTIndex:
         B = beam_width_for(beam_width, max_check, L)
         T = max(1, -(-max_check // B))
         limit = max(self.nbp_limit, (max_check // 64) // B, 1)
+        # BinnedTopK (ISSUE 13): the SAME shared bin rules the
+        # single-chip engine and the mesh scheduler resolve, so the
+        # monolithic and scheduler mesh paths stay id-identical
+        mb = topk_bins.walk_merge_bins(
+            self._binned_mode(), L, L + B * int(self.graph.shape[1]))
+        fb = topk_bins.resolve_bins(self._binned_mode(), k_local, L,
+                                    self._recall_target())
+        sk = topk_bins.seed_spare_keep(
+            self._binned_mode(), L,
+            max(int(self.pivot_ids.shape[1]), L))
         d, ids = _sharded_beam_kernel(
             self.data, self.sqnorm, self.graph, self.deleted,
             self.pivot_ids, self.pivot_vecs, self.pivot_mask,
             jnp.asarray(queries), k_local, k_final, L, B, T,
-            int(self.metric), self.base, limit, self.mesh)
+            int(self.metric), self.base, limit, self.mesh,
+            merge_bins=mb, finalize_bins=fb, seed_keep=sk)
         return _pad_to_k(np.asarray(d), np.asarray(ids), k, k_final)

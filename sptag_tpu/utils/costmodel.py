@@ -189,6 +189,19 @@ WALK_SORT_FLOPS = 290.0
 #: scan intermediates), in 4-byte words
 WALK_SORT_TRAFFIC = 130.0
 
+#: per-merged-row-element flops of the BINNED walk body's selection
+#: ensemble (bin min/argmin reductions + shortlist top-L + the
+#: rank-select pop's cumsum/scatter), fitted like WALK_SORT_FLOPS
+#: against this container's HloCostAnalysis (BinnedTopK, ISSUE 13;
+#: measured 31.8-34.5 across three shapes)
+WALK_BINNED_FLOPS = 33.0
+
+#: per-merged-row-element word traffic of the same binned ensemble
+#: (fitted 14-24 once the corpus gather-operand term is split out —
+#: the binned bytes formula carries N*D explicitly, unlike the exact
+#: body whose X-wide ensemble dwarfs it)
+WALK_BINNED_TRAFFIC = 19.0
+
 
 def matmul_flops(m: float, n: float, k: float) -> float:
     """Dense (m, k) x (k, n) contraction: 2·m·n·k."""

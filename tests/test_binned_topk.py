@@ -1,0 +1,373 @@
+"""ISSUE 13 — bin-reduction top-k (ops/topk_bins.py + the BinnedTopK wiring).
+
+Four contracts under test:
+
+* the PRIMITIVE: `binned_topk` matches `lax.top_k` exactly whenever no
+  two winners collide in a bin (bins >= width is always exact), ties
+  resolve to the lowest index like `top_k`, and measured recall over
+  random rows meets the `bins_for` target — including adversarial
+  near-tie and clustered-winner distributions;
+* the WALK: with BinnedTopK on, segmented and monolithic walks stay
+  bit-identical (the scheduler's retire contract), the scheduler path
+  returns the monolithic ids, and end recall on a real kNN graph stays
+  close to the exact walk's;
+* the MESH: monolithic sharded search and the mesh scheduler path stay
+  id-identical with BinnedTopK on (the shared walk_merge_bins rule);
+* OFF-PARITY: with BinnedTopK at its default (off) every engine resolves
+  bins=0, results are bit-identical to an engine that never heard of the
+  parameter, and serve wire bytes match the reference layout (the
+  ci_check.sh standalone pass).
+
+Corpora are tiny: what is under test is selection algebra and parity,
+not throughput — the bench owns the perf claim.
+"""
+
+import math
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sptag_tpu as sp
+from sptag_tpu.core.types import DistCalcMethod
+from sptag_tpu.ops import topk_bins
+
+# ---------------------------------------------------------------------------
+# primitive: math + exactness + ties
+# ---------------------------------------------------------------------------
+
+
+def test_bins_for_math():
+    # inverts E[recall] ~ exp(-k(k-1)/2bins); floors at 2k, caps at width
+    assert topk_bins.bins_for(1, 1 << 20, 0.5) == 2       # 2k floor
+    b99 = topk_bins.bins_for(10, 1 << 20, 0.99)
+    b95 = topk_bins.bins_for(10, 1 << 20, 0.95)
+    assert b99 > b95 >= 512                  # tighter target -> more bins
+    need = 10 * 9 / (2 * math.log(1 / 0.95))
+    assert b95 == topk_bins.pow2ceil(int(math.ceil(need)))
+    assert topk_bins.bins_for(10, 256, 0.99) == 256       # width cap
+    assert topk_bins.bins_for(10, 300, 1.0) == 512        # exact: pow2(width)
+
+
+def test_recall_target_validation():
+    for bad in (0.0, -0.1, 1.5):
+        with pytest.raises(ValueError):
+            topk_bins.validate_recall_target(bad)
+    assert topk_bins.validate_recall_target(1.0) == 1.0
+
+
+def test_resolve_and_merge_bin_rules():
+    assert topk_bins.resolve_bins("off", 10, 4096) == 0
+    assert topk_bins.resolve_bins("0", 10, 4096) == 0
+    # at the default 0.99 target, k=10 wants 8192 bins — a 4096-wide
+    # row stays exact even under "on"; a looser target engages
+    assert topk_bins.resolve_bins("on", 10, 4096) == 0
+    assert topk_bins.resolve_bins("on", 10, 4096, 0.95) == 1024
+    # auto declines narrow rows, engages wide ones
+    assert topk_bins.resolve_bins("auto", 10, 64) == 0
+    assert topk_bins.resolve_bins("auto", 10, 1 << 16, 0.95) > 0
+    with pytest.raises(ValueError):
+        topk_bins.resolve_bins("maybe", 10, 4096)
+    # the walk-merge rule: bins always covers the sorted beam prefix
+    # twice over (measured recall tradeoff — see walk_merge_bins)
+    for L in (3, 64, 320, 1000):
+        bins = topk_bins.walk_merge_bins("on", L, L + 4096)
+        assert bins >= 2 * L and bins == topk_bins.pow2ceil(2 * L)
+    assert topk_bins.walk_merge_bins("off", 64, 4096) == 0
+    # auto: narrow candidate block -> stay exact
+    assert topk_bins.walk_merge_bins("auto", 64, 96) == 0
+    # binned seeding: spare queue truncates to 3L when the pivot pool is
+    # wide enough to make the reduction pay; off/narrow -> exact
+    assert topk_bins.seed_spare_keep("off", 64, 8192) == 0
+    assert topk_bins.seed_spare_keep("on", 64, 8192) == 192
+    assert topk_bins.seed_spare_keep("on", 64, 300) == 0
+
+
+def test_binned_topk_exact_when_bins_cover_width():
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.standard_normal((8, 100)).astype(np.float32))
+    bins = topk_bins.pow2ceil(100)
+    vals, idx = topk_bins.binned_topk_kernel(d, 10, bins)
+    neg, ref = jax.lax.top_k(-d, 10)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(vals), -np.asarray(neg))
+
+
+def test_binned_topk_tie_rule_matches_top_k():
+    # duplicated minimum values: both the bin argmin (lowest stride) and
+    # the shortlist top_k (lowest index) must resolve like lax.top_k
+    d = np.full((1, 64), 5.0, np.float32)
+    d[0, [3, 35]] = 1.0          # same bin (32 bins): col 3 wins
+    d[0, [10, 20]] = 2.0         # different bins: both survive
+    vals, idx = topk_bins.binned_topk_kernel(jnp.asarray(d), 4, 32)
+    assert idx[0, 0] == 3                      # tie -> lowest column
+    assert set(np.asarray(idx[0, 1:3]).tolist()) == {10, 20}
+
+
+# ---------------------------------------------------------------------------
+# primitive: recall floors (random + adversarial distributions)
+# ---------------------------------------------------------------------------
+
+
+def _measured_recall(d, k, bins):
+    vals, idx = topk_bins.binned_topk_kernel(jnp.asarray(d), k, bins)
+    _, ref = jax.lax.top_k(-jnp.asarray(d), k)
+    idx, ref = np.asarray(idx), np.asarray(ref)
+    hits = [len(set(idx[i].tolist()) & set(ref[i].tolist()))
+            for i in range(d.shape[0])]
+    return float(np.mean(hits)) / k
+
+
+@pytest.mark.parametrize("N,k,rt", [(4096, 10, 0.95), (4096, 10, 0.99),
+                                    (16384, 32, 0.95), (1024, 1, 0.9)])
+def test_recall_floor_random_rows(N, k, rt):
+    """Measured recall over uniform rows meets the bins_for target minus
+    sampling slack (3 sigma over rows*k Bernoulli trials)."""
+    rng = np.random.default_rng(42)
+    rows = 64
+    d = rng.standard_normal((rows, N)).astype(np.float32)
+    bins = topk_bins.bins_for(k, N, rt)
+    rec = _measured_recall(d, k, bins)
+    slack = 3.0 * math.sqrt(rt * (1 - rt) / (rows * k)) + 1e-9
+    assert rec >= rt - slack - 0.01, (rec, rt, bins)
+
+
+def test_recall_floor_adversarial_near_ties():
+    """Near-tie distributions: the true top-k all within float eps of
+    each other (tie-ordering churn) and CLUSTERED in adjacent columns —
+    the strided binning must spread adjacent winners across bins."""
+    rng = np.random.default_rng(7)
+    rows, N, k = 64, 4096, 10
+    d = rng.uniform(1.0, 2.0, (rows, N)).astype(np.float32)
+    start = rng.integers(0, N - k, rows)
+    for i in range(rows):
+        # k adjacent near-tied winners (spacing < any bin stride)
+        d[i, start[i]:start[i] + k] = 0.5 + np.arange(k) * 1e-6
+    bins = topk_bins.bins_for(k, N, 0.95)
+    rec = _measured_recall(d, k, bins)
+    # adjacent columns land in k DISTINCT bins (strided rule): exact
+    assert rec == 1.0, rec
+
+
+def test_recall_collapses_only_on_same_bin_collisions():
+    """The documented failure mode: winners exactly `bins` columns apart
+    share a bin and only one survives — the contract the recall-target
+    math prices in (uniform rows almost never do this)."""
+    N, k = 16384, 8
+    bins = topk_bins.bins_for(k, N, 0.95)
+    d = np.ones((1, N), np.float32)
+    d[0, np.arange(k) * bins] = 0.0        # all k in bin 0
+    rec = _measured_recall(d, k, bins)
+    assert rec == pytest.approx(1.0 / k)
+
+
+# ---------------------------------------------------------------------------
+# walk: recall vs exact + parity with BinnedTopK on
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def knn_setup():
+    """Small corpus with a TRUE kNN graph: walk recall is meaningful."""
+    rng = np.random.default_rng(5)
+    N, D, m = 1500, 24, 12
+    data = rng.standard_normal((N, D)).astype(np.float32)
+    sq = (data ** 2).sum(1)
+    d2 = sq[:, None] + sq[None, :] - 2 * data @ data.T
+    np.fill_diagonal(d2, np.inf)
+    graph = np.argsort(d2, axis=1)[:, :m].astype(np.int32)
+    pivots = rng.choice(N, 96, replace=False).astype(np.int32)
+    queries = rng.standard_normal((24, D)).astype(np.float32)
+    truth = np.argsort(sq[None, :] - 2 * queries @ data.T,
+                       axis=1)[:, :10]
+    return data, graph, pivots, queries, truth
+
+
+def _recall(ids, truth):
+    return float(np.mean([
+        len(set(ids[i, :10].tolist()) & set(truth[i].tolist())) / 10
+        for i in range(len(ids))]))
+
+
+def test_binned_walk_recall_close_to_exact(knn_setup):
+    from sptag_tpu.algo.engine import GraphSearchEngine
+
+    data, graph, pivots, queries, truth = knn_setup
+    kw = dict(max_check=256, beam_width=8)
+    eng_off = GraphSearchEngine(data, graph, pivots, None,
+                                DistCalcMethod.L2, 1, score_dtype="f32")
+    eng_on = GraphSearchEngine(data, graph, pivots, None,
+                               DistCalcMethod.L2, 1, score_dtype="f32",
+                               binned_topk="on")
+    _, i0 = eng_off.search(queries, 10, **kw)
+    _, i1 = eng_on.search(queries, 10, **kw)
+    r0, r1 = _recall(i0, truth), _recall(i1, truth)
+    # lazy marking keeps shortlist-dropped candidates rediscoverable, so
+    # the binned walk tracks the exact one closely at equal budget
+    assert r1 >= r0 - 0.05, (r0, r1)
+    # no duplicate ids may survive the binned merge/finalize
+    for row in i1:
+        live = row[row >= 0].tolist()
+        assert len(set(live)) == len(live), row
+
+
+def test_binned_segmented_parity(knn_setup):
+    """Monolithic vs segmented walk, bit for bit, WITH the binned merge
+    — the absorbing-state contract is body-independent."""
+    from sptag_tpu.algo.engine import GraphSearchEngine
+
+    data, graph, pivots, queries, _ = knn_setup
+    eng = GraphSearchEngine(data, graph, pivots, None, DistCalcMethod.L2,
+                            1, score_dtype="f32", binned_topk="on")
+    for mc, bw, seg in [(128, 8, 2), (256, 4, 5)]:
+        d0, i0 = eng.search(queries, 5, max_check=mc, beam_width=bw)
+        d1, i1 = eng.search(queries, 5, max_check=mc, beam_width=bw,
+                            segment_iters=seg)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+
+
+def test_binned_scheduler_parity_bkt():
+    """BKT index with BinnedTopK=on: the continuous-batching scheduler
+    returns the monolithic ids (retire/compact/refill preserve the
+    binned body's absorbing states exactly like the exact body's)."""
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((500, 16)).astype(np.float32)
+    queries = rng.standard_normal((12, 16)).astype(np.float32)
+    idx = sp.create_instance("BKT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    for n, v in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                 ("Samples", "200"), ("TPTNumber", "2"),
+                 ("TPTLeafSize", "50"), ("NeighborhoodSize", "8"),
+                 ("CEF", "64"), ("MaxCheckForRefineGraph", "128"),
+                 ("RefineIterations", "1"), ("SearchMode", "beam"),
+                 ("MaxCheck", "96"), ("BinnedTopK", "on")]:
+        assert idx.set_parameter(n, v), n
+    assert idx.build(data) == sp.ErrorCode.Success
+    try:
+        eng = idx._get_engine()
+        assert eng.binned_mode == "on"
+        _, i_mono = idx.search_batch(queries, 5)
+        idx.set_parameter("ContinuousBatching", "1")
+        _, i_cb = idx.search_batch(queries, 5)
+        np.testing.assert_array_equal(i_mono, i_cb)
+    finally:
+        idx.close()
+
+
+def test_binned_mode_validation():
+    from sptag_tpu.algo.engine import GraphSearchEngine
+
+    data = np.zeros((4, 8), np.float32)
+    graph = np.zeros((4, 2), np.int32)
+    with pytest.raises(ValueError):
+        GraphSearchEngine(data, graph, np.zeros(1, np.int32), None,
+                          DistCalcMethod.L2, 1, binned_topk="sideways")
+
+
+# ---------------------------------------------------------------------------
+# mesh: id-parity with BinnedTopK on (shared walk_merge_bins rule)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_binned_scheduler_matches_monolithic(host_mesh):
+    from sptag_tpu.algo.scheduler import gather_futures
+    from sptag_tpu.parallel.sharded import ShardedBKTIndex
+
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((256, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    index = ShardedBKTIndex.build(
+        data, DistCalcMethod.L2, mesh=host_mesh(2),
+        params={"BKTNumber": 1, "BKTKmeansK": 4, "TPTNumber": 2,
+                "TPTLeafSize": 32, "NeighborhoodSize": 8, "CEF": 16,
+                "MaxCheckForRefineGraph": 64, "RefineIterations": 1,
+                "MaxCheck": 128, "SearchMode": "beam",
+                "BinnedTopK": "on"})
+    assert index._binned_mode() == "on"
+    d_mono, i_mono = index.search(q, 5)
+    index.enable_continuous_batching(slots=32)
+    d_cb, i_cb = gather_futures(index.submit_batch(q, 5), 5)
+    np.testing.assert_array_equal(i_mono, i_cb)
+    np.testing.assert_allclose(d_mono, d_cb, rtol=1e-5, atol=1e-6)
+    index.retire_scheduler()
+
+
+# ---------------------------------------------------------------------------
+# off-parity: default off = bins 0 everywhere + reference wire bytes
+# ---------------------------------------------------------------------------
+
+
+def test_binned_off_parity_resolution():
+    """Default params resolve bins=0 at every site: the engines run the
+    EXACT kernels (merge_bins=0 compiles the legacy body unchanged)."""
+    rng = np.random.default_rng(9)
+    data = rng.standard_normal((300, 16)).astype(np.float32)
+    idx = sp.create_instance("BKT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    for n, v in [("BKTNumber", "1"), ("BKTKmeansK", "8"),
+                 ("Samples", "200"), ("TPTNumber", "2"),
+                 ("TPTLeafSize", "50"), ("NeighborhoodSize", "8"),
+                 ("CEF", "64"), ("MaxCheckForRefineGraph", "128"),
+                 ("RefineIterations", "1"), ("MaxCheck", "96")]:
+        idx.set_parameter(n, v)
+    assert idx.build(data) == sp.ErrorCode.Success
+    try:
+        assert str(idx.get_parameter("BinnedTopK")) == "off"
+        eng = idx._get_engine()
+        assert eng.binned_mode == "off"
+        k_eff, L, B, _, _ = eng.walk_plan(10, 96, 16)
+        assert eng.merge_bins_for(L, B) == 0
+        assert eng.finalize_bins_for(k_eff, L) == 0
+    finally:
+        idx.close()
+
+
+def test_binned_off_parity_golden_bytes():
+    """With BinnedTopK at its default, a served search response is
+    byte-identical to the reference wire layout (the ci_check.sh
+    standalone pass — pattern shared with every off-by-default knob)."""
+    from conftest import ServerThread
+    from sptag_tpu.serve import wire
+    from sptag_tpu.serve.server import SearchServer
+    from sptag_tpu.serve.service import (SearchExecutor, ServiceContext,
+                                         ServiceSettings)
+
+    rng = np.random.default_rng(13)
+    data = rng.standard_normal((200, 12)).astype(np.float32)
+    flat = sp.create_instance("FLAT", "Float")
+    flat.set_parameter("DistCalcMethod", "L2")
+    flat.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("f", flat)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        qtext = "|".join(str(x) for x in data[3])
+        expected_result = SearchExecutor(ctx).execute(qtext)
+        expected_result.request_id = ""
+        expected_body = expected_result.pack()
+        expected = wire.PacketHeader(
+            wire.PacketType.SearchResponse, wire.PacketProcessStatus.Ok,
+            len(expected_body), 1, 99).pack() + expected_body
+        body = wire.RemoteQuery(qtext).pack()
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, 99).pack() + body)
+        s.settimeout(10)
+        got = b""
+        while len(got) < len(expected):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        s.close()
+        assert got == expected
+    finally:
+        t.stop()

@@ -106,6 +106,24 @@ def test_crosscheck_dense_scan(Q, C, P, D, nprobe, k):
                   nprobe=nprobe, k=k)
 
 
+@pytest.mark.parametrize("Q,C,P,D,nprobe,U,G,k",
+                         [(32, 64, 128, 64, 4, 8, 8, 10),
+                          (64, 32, 64, 32, 2, 4, 4, 5)])
+def test_crosscheck_dense_grouped(Q, C, P, D, nprobe, U, G, k):
+    """ISSUE 13 satellite: the grouped-dense family, never crosschecked
+    before, holds the same ±15% bar at two shapes."""
+    from sptag_tpu.algo.dense import _dense_search_grouped_kernel
+
+    compiled = _dense_search_grouped_kernel.lower(
+        jnp.zeros((C, P, D)), jnp.zeros((C, P), jnp.int32),
+        jnp.zeros((C, P)), jnp.zeros((C, D)), jnp.zeros((C,)),
+        jnp.zeros((C * P,), bool), jnp.zeros((Q, D)),
+        jnp.int32(Q), k, nprobe, U, G, int(DistCalcMethod.L2), 1,
+        False, False, False, 0).compile()
+    _assert_close("dense.grouped", compiled, Q=Q, C=C, P=P, D=D,
+                  nprobe=nprobe, U=U, G=G, k=k)
+
+
 @pytest.mark.parametrize("Q,L,B,N,D,m,S",
                          [(8, 64, 16, 2048, 64, 32, 4),
                           (32, 128, 32, 4096, 128, 32, 8)])
@@ -126,6 +144,51 @@ def test_crosscheck_beam_segment(Q, L, B, N, D, m, S):
         10, L, B, S, int(DistCalcMethod.L2), 1, 3, 0,
         None, None, None, None, None).compile()
     _assert_close("beam.segment", compiled, Q=Q, X=B * m, D=D, W=W)
+
+
+@pytest.mark.parametrize("Q,L,B,N,D,m,S",
+                         [(8, 64, 16, 2048, 64, 32, 4),
+                          (32, 128, 32, 4096, 128, 32, 8),
+                          (16, 320, 64, 16384, 128, 32, 4)])
+def test_crosscheck_beam_segment_binned(Q, L, B, N, D, m, S):
+    """ISSUE 13: the BINNED walk body's recalibrated formula
+    (WALK_BINNED_* constants + the explicit corpus gather-operand term)
+    holds ±15% at three shapes, including the bench's (L=320, B=64)."""
+    from sptag_tpu.algo.engine import _beam_segment_kernel, _num_words
+    from sptag_tpu.ops import topk_bins
+
+    W = _num_words(N)
+    # the PRODUCTION bin rule (walk_merge_bins' pow2ceil(2L)), not an
+    # arbitrary count — the crosscheck must pin the shipped configuration
+    mb = topk_bins.walk_merge_bins("on", L, L + B * m)
+    assert mb == topk_bins.pow2ceil(2 * L)
+    compiled = _beam_segment_kernel.lower(
+        jnp.zeros((N, D)), jnp.zeros((N,)),
+        jnp.zeros((N, m), jnp.int32), jnp.zeros((Q, D)),
+        jnp.zeros((Q,), jnp.int32), jnp.zeros((Q, L), jnp.int32),
+        jnp.zeros((Q, L)), jnp.zeros((Q, L + 1), bool),
+        jnp.zeros((Q, W), jnp.int32), jnp.zeros((Q,), jnp.int32),
+        jnp.zeros((Q,), jnp.int32), jnp.zeros((Q,), jnp.int32),
+        10, L, B, S, int(DistCalcMethod.L2), 1, 3, 0,
+        None, None, None, None, None, mb).compile()
+    _assert_close("beam.segment", compiled, Q=Q, X=B * m, D=D, W=W,
+                  merge_bins=mb, L=L, N=N)
+
+
+@pytest.mark.parametrize("Q,N,D,k,rt", [(32, 4096, 64, 10, 0.9)])
+def test_crosscheck_flat_scan_binned(Q, N, D, k, rt):
+    """The binned FLAT select's formula (one fewer full (Q, N) traversal
+    + the shortlist select term) holds the same bar."""
+    from sptag_tpu.algo.flat import _flat_search_kernel
+    from sptag_tpu.ops import topk_bins
+
+    bins = topk_bins.bins_for(k, N, rt)
+    compiled = _flat_search_kernel.lower(
+        jnp.zeros((N, D)), jnp.zeros((N,)), jnp.zeros((N,), bool),
+        jnp.zeros((Q, D)), k, int(DistCalcMethod.L2), 1, False, rt,
+        bins).compile()
+    _assert_close("flat.scan", compiled, Q=Q, N=N, D=D, k=k,
+                  binned_bins=bins)
 
 
 def test_walk_iter_cost_matches_segment_family():

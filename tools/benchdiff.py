@@ -79,6 +79,12 @@ METRICS: List[Metric] = [
     Metric("kdt_cosine_qps", HIGHER, 0.20, 10.0),
     Metric("kdt_dense_qps", HIGHER, 0.20, 25.0),
     Metric("beam_qps", HIGHER, 0.20, 2.0),
+    # ISSUE 13: the binned walk's margin over the exact-top-k reference
+    # pass measured in the SAME run — the bin-reduction specialization's
+    # reason to exist.  Ratio of two same-run numbers, so it holds even
+    # across host-speed changes that shift every absolute QPS.
+    Metric("beam_binned_speedup", HIGHER, 0.20, 0.3),
+    Metric("beam_exact_qps", HIGHER, 0.20, 2.0),
     # latency (lower is better)
     Metric("p50_batch_ms", LOWER, 0.20, 20.0),
     Metric("p99_batch_ms", LOWER, 0.20, 30.0),
@@ -88,6 +94,8 @@ METRICS: List[Metric] = [
     Metric("int8_recall_at_10", HIGHER, 0.01, 0.005,
            platform_bound=False),
     Metric("beam_recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
+    Metric("beam_exact_recall_at_10", HIGHER, 0.01, 0.005,
            platform_bound=False),
     Metric("kdt_cosine_recall_at_10", HIGHER, 0.01, 0.005,
            platform_bound=False),

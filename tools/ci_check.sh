@@ -153,6 +153,16 @@ env JAX_PLATFORMS=cpu SPTAG_RACESAN=1 python -m pytest \
     tests/test_beam_segmented.py tests/test_racesan.py -q \
     -p no:cacheprovider -m 'not slow'
 
+# the ISSUE 13 perf gate, standalone: with BinnedTopK at its default
+# (off) every engine resolves bins=0 and compiles the byte-identical
+# exact kernels, and a served response matches the reference wire
+# layout; the same module holds the binned-on parity contracts
+# (segmented/monolithic bit-parity, scheduler ids, mesh ids) and the
+# recall-floor property tests of the bin-reduction primitive
+echo "== binned top-k off: parity + golden bytes (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_binned_topk.py -q \
+    -p no:cacheprovider -k "off_parity or parity"
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within
