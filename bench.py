@@ -1109,7 +1109,59 @@ def run_bench():
             except Exception as e:                   # noqa: BLE001
                 result.setdefault("pareto_errors", {})[
                     "flat_approx"] = repr(e)[:200]
+            # tiered-cascade sweep (ISSUE 14 satellite): TierBudgetSketch
+            # rows on the headline corpus at a fixed int8 budget — the
+            # recall-vs-QPS face of the sketch tier's budget knob (each
+            # budget is a static kernel shape = one compile per row)
+            try:
+                cs_rows = []
+                flat_c = sp.create_instance("FLAT", "Float")
+                flat_c.set_parameter("DistCalcMethod", "L2")
+                flat_c.set_parameter("CascadeSearch", "1")
+                flat_c.set_parameter("TierBudgetInt8", "1024")
+                flat_c.build(data)
+                qn = min(len(queries), 512)
+                for b1s in (2048, 8192, 16384):
+                    if _remaining(sb_par) < 15:
+                        result.setdefault("pareto_dropped", []).append(
+                            "flat_cascade@%d" % b1s)
+                        continue
+                    flat_c.set_parameter("TierBudgetSketch", str(b1s))
+                    flat_c.search_batch(queries[:qn], k)       # warm
+                    t0 = time.perf_counter()
+                    _, idsc = flat_c.search_batch(queries[:qn], k)
+                    dt = time.perf_counter() - t0
+                    rec = recall_at_k(idsc, truth[:qn], k)
+                    lo, hi = qualmon.wilson(rec * qn * k, qn * k)
+                    cs_rows.append({
+                        "tier_budget_sketch": b1s,
+                        "qps": round(qn / dt, 1),
+                        "recall_at_10": round(rec, 4),
+                        "ci": [round(lo, 4), round(hi, 4)],
+                        "queries": qn,
+                    })
+                if cs_rows:
+                    pareto["flat_cascade"] = cs_rows
+                del flat_c
+            except Exception as e:                   # noqa: BLE001
+                result.setdefault("pareto_errors", {})[
+                    "flat_cascade"] = repr(e)[:200]
             result["quality_pareto"] = pareto
+            checkpoint()
+
+        # beyond-HBM tiered-capacity stage (ISSUE 14): vectors servable
+        # per GB of HBM at a fixed recall@10 floor — fp-only vs int8+fp
+        # vs full cascade vs the host tiers, every byte READ FROM THE
+        # DEVMEM LEDGER (never estimated), recall vs a same-subset exact
+        # oracle with Wilson CIs.  tools/benchdiff.py holds
+        # capacity.vectors_per_gb and capacity.cascade_recall_at_10.
+        sb_cap = _stage_budget(result, "capacity", budget_s, 240.0, 60.0)
+        if sb_cap is not None:
+            try:
+                result["capacity"] = _capacity_measure(data, queries, k,
+                                                       sb_cap)
+            except Exception as e:                       # noqa: BLE001
+                result["capacity_error"] = repr(e)[:300]
             checkpoint()
 
         # open-loop load-generator stage (ISSUE 8 satellite): serve the
@@ -1183,6 +1235,104 @@ def run_bench():
     except OSError:
         pass
     print(json.dumps(result), flush=True)
+
+
+def _capacity_measure(data, queries, k, budget_s):
+    """Beyond-HBM capacity stage (ISSUE 14): build the SAME corpus
+    subset under each residency config, measure resident device/host
+    bytes off the devmem ledger (before/after deltas around each
+    build+warm, GC-fenced), and report vectors-per-GB-of-HBM plus
+    recall@10 vs a same-subset exact oracle.
+
+    The headline (``vectors_per_gb`` / ``cascade_recall_at_10``) is the
+    densest cascade config whose recall@10 lands INSIDE the fp-only
+    (exact) run's Wilson CI — capacity claims below the recall floor
+    don't count.  ``host``/``host_all`` rows additionally prove the
+    zero-residency contract: their fp bytes appear host-side only."""
+    import gc
+
+    import sptag_tpu as sp
+    from sptag_tpu.utils import devmem, qualmon
+
+    nc = min(len(data), 50_000)
+    sub = np.ascontiguousarray(data[:nc])
+    qn = min(len(queries), 512)
+    qs = np.ascontiguousarray(queries[:qn])
+    dn = (sub.astype(np.float32) ** 2).sum(1)
+    truth = exact_topk(sub, dn, qs, k)
+    b1, b2 = 8192, 1024
+    configs = [
+        ("fp_only", {}),
+        # TierBudgetSketch >= corpus composes the sketch tier out: the
+        # int8 tier scans everything, fp re-ranks the shortlist
+        ("int8_fp", {"CascadeSearch": "1",
+                     "TierBudgetSketch": str(2 * nc),
+                     "TierBudgetInt8": str(b2)}),
+        ("cascade", {"CascadeSearch": "1", "TierBudgetSketch": str(b1),
+                     "TierBudgetInt8": str(b2)}),
+        ("host", {"CascadeSearch": "1", "TierBudgetSketch": str(b1),
+                  "TierBudgetInt8": str(b2), "CorpusTier": "host"}),
+        ("host_all", {"CascadeSearch": "1", "TierBudgetSketch": str(b1),
+                      "TierBudgetInt8": str(b2),
+                      "CorpusTier": "host_all"}),
+    ]
+    out = {"n": nc, "queries": qn, "tier_budget_sketch": b1,
+           "tier_budget_int8": b2, "rows": {}}
+    for label, params in configs:
+        if _remaining(budget_s) < 20:
+            out.setdefault("dropped", []).append(label)
+            continue
+        gc.collect()
+        dev_before = devmem.device_bytes()
+        host_before = devmem.total_bytes() - dev_before
+        idx = sp.create_instance("FLAT", "Float")
+        idx.set_parameter("DistCalcMethod", "L2")
+        for pk, pv in params.items():
+            idx.set_parameter(pk, pv)
+        idx.build(sub)
+        idx.search_batch(qs[:32], k)        # warm; materializes tiers
+        t0 = time.perf_counter()
+        _, ids = idx.search_batch(qs, k)
+        dt = time.perf_counter() - t0
+        dev = devmem.device_bytes() - dev_before
+        host = (devmem.total_bytes() - devmem.device_bytes()) \
+            - host_before
+        rec = recall_at_k(ids, truth, k)
+        lo, hi = qualmon.wilson(rec * qn * k, qn * k)
+        out["rows"][label] = {
+            "device_bytes": int(dev),
+            "host_bytes": int(max(host, 0)),
+            "vectors_per_gb": round(nc / max(dev, 1) * 1e9, 1),
+            "recall_at_10": round(rec, 4),
+            "ci": [round(lo, 4), round(hi, 4)],
+            "qps": round(qn / dt, 1),
+        }
+        del idx
+        gc.collect()
+    fp = out["rows"].get("fp_only")
+    if fp:
+        floor = fp["ci"][0]
+        out["recall_floor"] = floor
+        for label in ("host_all", "host", "cascade", "int8_fp"):
+            row = out["rows"].get(label)
+            if row is None or row["recall_at_10"] < floor:
+                continue
+            out["best_config"] = label
+            out["vectors_per_gb"] = row["vectors_per_gb"]
+            out["cascade_recall_at_10"] = row["recall_at_10"]
+            out["cascade_recall_within_exact_ci"] = True
+            out["capacity_ratio_vs_fp"] = round(
+                row["vectors_per_gb"]
+                / max(fp["vectors_per_gb"], 1e-9), 2)
+            break
+    for label in ("host", "host_all"):
+        row = out["rows"].get(label)
+        if row is not None:
+            # the residency proof: fp bytes live HOST-side (the ledger's
+            # host=True entries), never in the HBM total
+            out.setdefault("host_fp_bytes_host_side", {})[label] = bool(
+                row["host_bytes"] >= nc * sub.shape[1] * 4)
+    return out
 
 
 def _loadgen_measure(index, queries, k, budget_s):

@@ -196,7 +196,14 @@ class BKTIndex(VectorIndex):
                                 # bin-reduction top-k mode + its recall
                                 # target are baked into the engine's
                                 # compiled walk programs (ISSUE 13)
-                                "binnedtopk", "approxrecalltarget"})
+                                "binnedtopk", "approxrecalltarget",
+                                # tiered cascade (ISSUE 14): the int8
+                                # scoring corpus, its residency tier and
+                                # the fp re-rank budget are snapshot
+                                # state — a flip must rebuild, never
+                                # patch a live program
+                                "cascadesearch", "corpustier",
+                                "tierbudgetint8", "tierbudgetsketch"})
     # process-wide recorder knobs: applied DIRECTLY to flightrec at
     # set_parameter time (each maps to its own configure field, so
     # setting one never clobbers the others) — they are not baked into
@@ -206,8 +213,12 @@ class BKTIndex(VectorIndex):
                                 "flightdumponslowquery"})
     # baked into the materialized DENSE snapshot (replication layout and
     # cluster partition); DenseQueryGroup/DenseUnionFactor are read live
-    # at each search and need no invalidation
-    _DENSE_PARAMS = frozenset({"densereplicas", "denseclustersize"})
+    # at each search and need no invalidation.  The cascade knobs bake
+    # the int8 block layout + fp re-rank tier into the dense snapshot
+    # exactly like the engine (ISSUE 14)
+    _DENSE_PARAMS = frozenset({"densereplicas", "denseclustersize",
+                               "cascadesearch", "corpustier",
+                               "tierbudgetint8", "tierbudgetsketch"})
 
     def set_parameter(self, name: str, value: str) -> bool:
         ok = super().set_parameter(name, value)
@@ -281,7 +292,12 @@ class BKTIndex(VectorIndex):
                                      self.params, "binned_topk", "off")),
                                  recall_target=float(getattr(
                                      self.params, "approx_recall_target",
-                                     0.99)))
+                                     0.99)),
+                                 cascade_search=bool(int(getattr(
+                                     self.params, "cascade_search", 0))),
+                                 corpus_tier=str(getattr(
+                                     self.params, "corpus_tier",
+                                     "device")))
 
     def _get_engine(self) -> GraphSearchEngine:
         """Pin the current engine snapshot (epoch-based handoff,
@@ -313,7 +329,8 @@ class BKTIndex(VectorIndex):
             return self._engine
 
     def _build_dense_searcher(self,
-                              replicas: Optional[int] = None
+                              replicas: Optional[int] = None,
+                              cascade_ok: bool = True
                               ) -> DenseTreeSearcher:
         """Cluster-contiguous snapshot from the current tree.
 
@@ -329,10 +346,23 @@ class BKTIndex(VectorIndex):
         n = self._main_rows()
         data = self._host[:n]
         centers, clusters = self._dense_clusters()
+        cascade_cfg = None
+        if cascade_ok and int(getattr(self.params, "cascade_search", 0)) \
+                and np.issubdtype(data.dtype, np.floating):
+            # tiered cascade (ISSUE 14): int8-quantized dense blocks
+            # with a TierBudgetInt8-budgeted exact fp re-rank; the
+            # dense partition's nprobe prefilter plays the coarse-tier
+            # role the sketch scan plays on FLAT
+            cascade_cfg = {
+                "tier": str(getattr(self.params, "corpus_tier",
+                                    "device")),
+                "rerank_budget": int(getattr(self.params,
+                                             "tier_budget_int8", 0)),
+            }
         return DenseTreeSearcher(
             data, centers, clusters, self._deleted[:n],
             self.dist_calc_method, self.base,
-            replicas=replicas)
+            replicas=replicas, cascade_cfg=cascade_cfg)
 
     def _dense_clusters(self):
         """Tree partition plus nearest-center assignment of rows appended
@@ -500,7 +530,11 @@ class BKTIndex(VectorIndex):
             if cached is not None and cached[0] == key:
                 searcher = cached[1]
             else:
-                searcher = self._build_dense_searcher(replicas=1)
+                # refine searches stay full-precision: the cascade is a
+                # SERVING residency/speed trade, and a quantized refine
+                # would bake its noise into the saved graph edges
+                searcher = self._build_dense_searcher(replicas=1,
+                                                      cascade_ok=False)
                 self._refine_dense_cache = (key, searcher)
                 # starvation check at the SOURCE (round 5, measured at
                 # 10M: budget 256 over ~5,700 clusters probes nprobe=1 —

@@ -773,12 +773,47 @@ class DenseTreeSearcher:
                  clusters: List[np.ndarray],
                  deleted: Optional[np.ndarray],
                  metric: DistCalcMethod, base: int,
-                 replicas: int = 1):
+                 replicas: int = 1,
+                 cascade_cfg: Optional[dict] = None):
         self.metric = DistCalcMethod(metric)
         self.base = base
         self.n = data.shape[0]
         self.replicas = max(1, replicas)
-        lay = self.build_layout(data, clusters, self.metric, self.replicas)
+        # tiered cascade (CascadeSearch, ops/cascade.py ISSUE 14): the
+        # block layout holds the int8 quantization (quarter the f32
+        # bytes; the probe prefilter is the coarse tier), queries score
+        # in the quantized space (q / scale), and the final candidates
+        # re-rank against exact fp rows — device-resident or host-RAM
+        # per CorpusTier.  Integer corpora ignore the config (already
+        # quantized); cascade_cfg keys: tier, rerank_budget.
+        self.cascade_cfg = None
+        self.fp_d = None
+        self.fp_host: Optional[np.ndarray] = None
+        self.scale = 0.0
+        src = data
+        if cascade_cfg is not None \
+                and np.issubdtype(np.asarray(data).dtype, np.floating):
+            from sptag_tpu.ops import cascade as cascade_ops
+
+            tier = cascade_ops.normalize_tier(
+                cascade_cfg.get("tier", "device"))
+            if tier == "host_all":
+                tier = "host"       # dense has no sketch tier to keep
+            int8_np, scale = cascade_ops.quantize_int8(
+                np.asarray(data, np.float32))
+            self.scale = float(scale)
+            self.cascade_cfg = {
+                "tier": tier,
+                "rerank_budget": int(cascade_cfg.get("rerank_budget", 0)
+                                     or 0),
+            }
+            src = int8_np
+            if tier == "device":
+                self.fp_d = jnp.asarray(np.asarray(data, np.float32))
+            else:
+                self.fp_host = np.ascontiguousarray(
+                    np.asarray(data, np.float32))
+        lay = self.build_layout(src, clusters, self.metric, self.replicas)
         self.cluster_size = lay["cluster_size"]
         self.num_clusters = lay["num_clusters"]
         self.data_perm = jnp.asarray(lay["perm"])
@@ -805,6 +840,14 @@ class DenseTreeSearcher:
             devmem.track("int8_blocks", self, lay_bytes)
         else:
             devmem.track("dense_blocks", self, lay_bytes)
+        if self.fp_d is not None:
+            # cascade fp re-rank tier, device-resident (CorpusTier=device)
+            devmem.track("corpus", self, self.fp_d.nbytes)
+        if self.fp_host is not None:
+            # host-RAM fp tier: on /debug/memory, excluded from the HBM
+            # total (the capacity contract devmem's host flag exists for)
+            devmem.track("host_corpus", self, self.fp_host.nbytes,
+                         host=True)
 
     def set_deleted(self, deleted: np.ndarray) -> None:
         """Swap only the tombstone mask (delete-only mutation path)."""
@@ -815,11 +858,67 @@ class DenseTreeSearcher:
         sublane minimum for this dtype ((8,128) f32, (32,128) int8)."""
         return 32 if self.data_perm.dtype == jnp.dtype(jnp.int8) else 8
 
+    def _rerank_budget(self, k: int) -> int:
+        """Static fp-tier budget (TierBudgetInt8 semantics of
+        cascade.resolve_budgets: 0 = auto, power-of-two quantized,
+        >= k, <= corpus)."""
+        from sptag_tpu.ops import cascade as cascade_ops
+
+        b2 = self.cascade_cfg.get("rerank_budget", 0)
+        _, b2 = cascade_ops.resolve_budgets(max(self.n, 1), b2, k,
+                                            max(self.n, 1))
+        return max(b2, min(k, self.n))
+
     def search(self, queries: np.ndarray, k: int, max_check: int = 2048,
                group: int = 0, union_factor: int = 2,
                binned: str = "off",
                recall_target: float = topk_bins.DEFAULT_RECALL_TARGET
                ) -> Tuple[np.ndarray, np.ndarray]:
+        """Public search; with a cascade config the int8 block scan
+        produces a `TierBudgetInt8`-wide shortlist that the exact fp
+        tier re-ranks (device gather or host fetch per CorpusTier) —
+        returned distances are exact fp either way."""
+        if self.cascade_cfg is None:
+            return self._scan_topk(queries, k, max_check, group,
+                                   union_factor, binned, recall_target)
+        from sptag_tpu.ops import cascade as cascade_ops
+
+        queries = np.asarray(queries)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        nq = queries.shape[0]
+        b2 = self._rerank_budget(k)
+        # the int8 blocks hold x/scale: scoring q/scale against them
+        # keeps every per-query ordering identical to dequantized
+        # scoring without touching the block kernels
+        q_scaled = queries.astype(np.float32) / np.float32(self.scale)
+        _, ids = self._scan_topk(q_scaled, b2, max_check, group,
+                                 union_factor, binned, recall_target)
+        k_eff = min(k, ids.shape[1])
+        q_dev = jnp.asarray(queries.astype(np.float32))
+        if self.fp_host is not None:
+            # the shared ACCOUNTED gather (out-of-range ids drop to -1
+            # and count into cascade.host_fetch_dropped — never a silent
+            # clamp onto row 0's data)
+            rows, ids, _ = cascade_ops.gather_host_rows(self.fp_host, ids)
+            d, out = cascade_ops._fp_rerank_kernel(
+                q_dev, jnp.asarray(rows), jnp.asarray(ids), k_eff,
+                int(self.metric), self.base)
+        else:
+            d, out = cascade_ops._fp_rerank_resident_kernel(
+                self.fp_d, q_dev, jnp.asarray(ids), k_eff,
+                int(self.metric), self.base)
+        out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
+        out_i = np.full((nq, k), -1, np.int32)
+        out_d[:, :k_eff] = np.asarray(d)[:, :k_eff]
+        out_i[:, :k_eff] = np.asarray(out)[:, :k_eff]
+        return out_d, out_i
+
+    def _scan_topk(self, queries: np.ndarray, k: int, max_check: int = 2048,
+                   group: int = 0, union_factor: int = 2,
+                   binned: str = "off",
+                   recall_target: float = topk_bins.DEFAULT_RECALL_TARGET
+                   ) -> Tuple[np.ndarray, np.ndarray]:
         """`group` > 1 enables query-grouped probing (DenseQueryGroup):
         the batch is sorted by nearest centroid, split into groups of
         `group` queries, and each group probes the top

@@ -242,16 +242,27 @@ def _seed_from_pivots(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
 
 
 def _seed_from_seeds(data, sqnorm, seed_ids, queries, L: int, metric: int,
-                     base: int):
+                     base: int, score_scale: float = 0.0):
     """Per-query seeding (KDT): `seed_ids` (Q, S) come from a host-side tree
     descent per query (the reference's KDTSearch leaf seeding,
     KDTree.h:178-215); they are gathered and scored as one batched
-    contraction.  Returns (cand_ids, cand_d, visited)."""
+    contraction.  Returns (cand_ids, cand_d, visited).
+
+    `score_scale` > 0 AND an integer `data` (host-tier cascade: `data`
+    IS the int8 quantization): dequantize the gathered seed rows so
+    seed distances live in the same space as the walk's dequantized
+    scoring and the rescaled `sqnorm` — raw int8 rows against
+    dequantized norms would seed the beam with garbage distances.  The
+    dtype guard matters: on the DEVICE tier `data` stays fp (only the
+    walk's data_score shadow is int8) and scaling fp seed rows would
+    corrupt them instead."""
     Q = queries.shape[0]
     N = data.shape[0]
     S = seed_ids.shape[1]
 
     svecs = data[jnp.maximum(seed_ids, 0)]                       # (Q, S, D)
+    if score_scale and jnp.issubdtype(svecs.dtype, jnp.integer):
+        svecs = svecs.astype(jnp.float32) * jnp.float32(score_scale)
     ssq = sqnorm[jnp.maximum(seed_ids, 0)]
     d0 = dist_ops.batched_gathered_distance(
         queries, svecs, DistCalcMethod(metric), base, ssq)
@@ -283,23 +294,27 @@ def _beam_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
                              metric, seed_keep=seed_keep)
 
 
-@functools.partial(jax.jit, static_argnames=("L", "metric", "base"))
+@functools.partial(jax.jit, static_argnames=("L", "metric", "base",
+                                             "score_scale"))
 def _beam_seed_seeded_kernel(data, sqnorm, seed_ids, queries, L: int,
-                             metric: int, base: int):
+                             metric: int, base: int,
+                             score_scale: float = 0.0):
     return _seed_from_seeds(data, sqnorm, seed_ids, queries, L, metric,
-                            base)
+                            base, score_scale=score_scale)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
-                     "inject", "merge_bins", "finalize_bins", "seed_keep"))
+                     "inject", "merge_bins", "finalize_bins", "seed_keep",
+                     "score_scale"))
 def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                         pivot_mask, queries, t_limit, k: int, L: int,
                         B: int, metric: int, base: int, nbp_limit: int,
                         inject: int = 4, data_score=None, nbr_vecs=None,
                         nbr_sq=None, merge_bins: int = 0,
-                        finalize_bins: int = 0, seed_keep: int = 0):
+                        finalize_bins: int = 0, seed_keep: int = 0,
+                        score_scale: float = 0.0):
     """Pivot-seeded monolithic walk: seed + walk + finalize fused in one
     program.  `t_limit` (Q,) carries the per-row iteration budget as a
     TRACED array, so distinct MaxCheck values that map to the same (L, B)
@@ -311,37 +326,43 @@ def _beam_search_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                  visited, k, L, B, t_limit, metric, base, nbp_limit,
                  spare_ids=spare_ids, spare_d=spare_d, inject=inject,
                  data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
-                 merge_bins=merge_bins, finalize_bins=finalize_bins)
+                 merge_bins=merge_bins, finalize_bins=finalize_bins,
+                 score_scale=score_scale)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
-                     "merge_bins", "finalize_bins"))
+                     "merge_bins", "finalize_bins", "score_scale"))
 def _beam_search_seeded_kernel(data, sqnorm, graph, deleted, seed_ids,
                                queries, t_limit, k: int, L: int, B: int,
                                metric: int, base: int, nbp_limit: int,
                                data_score=None, nbr_vecs=None,
                                nbr_sq=None, merge_bins: int = 0,
-                               finalize_bins: int = 0):
+                               finalize_bins: int = 0,
+                               score_scale: float = 0.0):
     cand_ids, cand_d, visited = _seed_from_seeds(data, sqnorm, seed_ids,
-                                                 queries, L, metric, base)
+                                                 queries, L, metric, base,
+                                                 score_scale=score_scale)
     return _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d,
                  visited, k, L, B, t_limit, metric, base, nbp_limit,
                  data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
-                 merge_bins=merge_bins, finalize_bins=finalize_bins)
+                 merge_bins=merge_bins, finalize_bins=finalize_bins,
+                 score_scale=score_scale)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
-                     "inject", "merge_bins", "finalize_bins", "seed_keep"))
+                     "inject", "merge_bins", "finalize_bins", "seed_keep",
+                     "score_scale"))
 def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                          pivot_mask, queries3, t_limit, k: int, L: int,
                          B: int, metric: int, base: int, nbp_limit: int,
                          inject: int = 4, data_score=None, nbr_vecs=None,
                          nbr_sq=None, merge_bins: int = 0,
-                         finalize_bins: int = 0, seed_keep: int = 0):
+                         finalize_bins: int = 0, seed_keep: int = 0,
+                         score_scale: float = 0.0):
     """(M, chunk, D) query chunks under one `lax.map` — a single device
     program for any batch size (one upload, one dispatch, one read; the
     tunneled backend costs ~60 ms per host round trip).  The per-chunk
@@ -356,20 +377,22 @@ def _beam_search_chunked(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                                    nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
                                    merge_bins=merge_bins,
                                    finalize_bins=finalize_bins,
-                                   seed_keep=seed_keep)
+                                   seed_keep=seed_keep,
+                                   score_scale=score_scale)
     return jax.lax.map(body, queries3)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "metric", "base", "nbp_limit",
-                     "merge_bins", "finalize_bins"))
+                     "merge_bins", "finalize_bins", "score_scale"))
 def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
                                 queries3, t_limit, k: int, L: int, B: int,
                                 metric: int, base: int, nbp_limit: int,
                                 data_score=None, nbr_vecs=None,
                                 nbr_sq=None, merge_bins: int = 0,
-                                finalize_bins: int = 0):
+                                finalize_bins: int = 0,
+                                score_scale: float = 0.0):
     def body(args):
         s, q = args
         return _beam_search_seeded_kernel(data, sqnorm, graph, deleted, s,
@@ -378,7 +401,8 @@ def _beam_search_seeded_chunked(data, sqnorm, graph, deleted, seeds3,
                                           data_score=data_score,
                                           nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
                                           merge_bins=merge_bins,
-                                          finalize_bins=finalize_bins)
+                                          finalize_bins=finalize_bins,
+                                          score_scale=score_scale)
     return jax.lax.map(body, (seeds3, queries3))
 
 
@@ -403,7 +427,7 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
                   B: int, metric: int, base: int, nbp_limit: int,
                   spare_ids=None, spare_d=None, inject: int = 0,
                   data_score=None, nbr_vecs=None, nbr_sq=None,
-                  merge_bins: int = 0):
+                  merge_bins: int = 0, score_scale: float = 0.0):
     """One beam iteration as a reusable (body, row_alive) pair over the
     walk's constants — shared verbatim by the monolithic `lax.while_loop`
     walk and the segmented kernel, so the two execute IDENTICAL per-row
@@ -473,9 +497,14 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
     Q = queries.shape[0]
     N = data.shape[0]
     score_src = data_score if data_score is not None else data
+    # the bf16-shadow cast only applies between FLOAT dtypes: an int8
+    # scoring corpus (score_scale below) keeps f32 queries — the
+    # gathered rows are dequantized back to f32 before the contraction
     queries_s = (queries.astype(score_src.dtype)
                  if queries.dtype != score_src.dtype and
-                 jnp.issubdtype(queries.dtype, jnp.floating) else queries)
+                 jnp.issubdtype(queries.dtype, jnp.floating) and
+                 jnp.issubdtype(score_src.dtype, jnp.floating)
+                 else queries)
     Ps = 0 if spare_ids is None else spare_ids.shape[1]
     use_spares = Ps > 0 and inject > 0
     # only REAL spare entries count as remaining work — the spare queue is
@@ -604,6 +633,13 @@ def _walk_machine(data, sqnorm, graph, queries, t_limit, k: int, L: int,
             gather_idx = jnp.where(fresh, flat, 0)
             cvecs = score_src[gather_idx]                        # (Q, C, D)
             csq = sqnorm[gather_idx]
+        if score_scale:
+            # int8 cascade tier (CascadeSearch, ops/cascade.py): the
+            # gathered rows are the int8 quantization of the corpus —
+            # dequantize so in-loop distances stay in (approximately)
+            # the true-distance space the f32-scored seeds live in; the
+            # finalize re-rank restores exact fp distances
+            cvecs = cvecs.astype(jnp.float32) * jnp.float32(score_scale)
         nd = dist_ops.batched_gathered_distance(
             queries_s, cvecs, DistCalcMethod(metric), base, csq)
         nd = jnp.where(fresh, nd, MAX_DIST)
@@ -704,7 +740,7 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
           k: int, L: int, B: int, t_limit, metric: int, base: int,
           nbp_limit: int, spare_ids=None, spare_d=None, inject: int = 0,
           data_score=None, nbr_vecs=None, nbr_sq=None, merge_bins: int = 0,
-          finalize_bins: int = 0):
+          finalize_bins: int = 0, score_scale: float = 0.0):
     """Monolithic walk: run the shared body under one `lax.while_loop`
     until no row is alive, then finalize.  `t_limit` is a (Q,) traced
     budget vector (iterations per row) — budgets no longer mint compiles,
@@ -713,7 +749,7 @@ def _walk(data, sqnorm, graph, deleted, queries, cand_ids, cand_d, visited,
         data, sqnorm, graph, queries, t_limit, k, L, B, metric, base,
         nbp_limit, spare_ids=spare_ids, spare_d=spare_d, inject=inject,
         data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
-        merge_bins=merge_bins)
+        merge_bins=merge_bins, score_scale=score_scale)
 
     def cond(state):
         return jnp.any(row_alive(state))
@@ -757,13 +793,14 @@ def _finalize(data, sqnorm, deleted, queries, cand_ids, cand_d, k_eff: int,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "B", "S", "metric", "base", "nbp_limit",
-                     "inject", "merge_bins"))
+                     "inject", "merge_bins", "score_scale"))
 def _beam_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
                          cand_d, expanded, visited, no_better, ptr, it,
                          k: int, L: int, B: int, S: int, metric: int,
                          base: int, nbp_limit: int, inject: int = 0,
                          spare_ids=None, spare_d=None, data_score=None,
-                         nbr_vecs=None, nbr_sq=None, merge_bins: int = 0):
+                         nbr_vecs=None, nbr_sq=None, merge_bins: int = 0,
+                         score_scale: float = 0.0):
     """Segmented walk: at most S iterations of the SAME body the
     monolithic walk runs, over loop-carried state passed in and returned
     intact — the device half of the continuous-batching walk
@@ -775,7 +812,7 @@ def _beam_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
         data, sqnorm, graph, queries, t_limit, k, L, B, metric, base,
         nbp_limit, spare_ids=spare_ids, spare_d=spare_d, inject=inject,
         data_score=data_score, nbr_vecs=nbr_vecs, nbr_sq=nbr_sq,
-        merge_bins=merge_bins)
+        merge_bins=merge_bins, score_scale=score_scale)
 
     def cond(carry):
         seg, state = carry
@@ -800,6 +837,24 @@ def _beam_finalize_kernel(data, sqnorm, deleted, queries, cand_ids, cand_d,
                      k_eff, metric, base, rerank, binned_bins=binned_bins)
 
 
+@functools.partial(jax.jit, static_argnames=("k_eff", "metric", "base"))
+def _beam_finalize_gathered_kernel(rows, dead, queries, cand_ids,
+                                   k_eff: int, metric: int, base: int):
+    """Host-tier finalize (CorpusTier=host, ops/cascade.py ISSUE 14):
+    exact fp re-rank of the final L-pool over rows FETCHED FROM HOST
+    RAM — the walk itself scored the int8 quantization, and the fp
+    corpus never becomes device-resident.  `rows` is the (Q, L, D) f32
+    host gather of `cand_ids` (row 0 for voids); `dead` the matching
+    tombstone gather.  Tombstones fold into the ids and the epilogue IS
+    cascade.rerank_gathered — the one traced function every fp re-rank
+    tier shares (its bit-parity contract)."""
+    from sptag_tpu.ops import cascade as cascade_ops
+
+    ids = jnp.where(dead, -1, cand_ids)
+    return cascade_ops.rerank_gathered(queries, rows, ids, k_eff, metric,
+                                       base)
+
+
 # ---------------------------------------------------------------------------
 # cost-ledger entries (utils/costmodel.py; graftlint GL605)
 # ---------------------------------------------------------------------------
@@ -811,7 +866,7 @@ def _beam_finalize_kernel(data, sqnorm, deleted, queries, cand_ids, cand_d,
 # by their own iteration counts.
 
 def _walk_iter_cost(Q, X, D, W, score_itemsize=4, merge_bins=0, L=0, N=0,
-                    **_):
+                    score_scale=0, **_):
     """One _walk_machine body application at batch Q: the B*m = X
     candidate gather + scoring contraction dominates; the fitted
     WALK_SORT_* constants carry the argsort/segmented-scan/top-k
@@ -822,19 +877,24 @@ def _walk_iter_cost(Q, X, D, W, score_itemsize=4, merge_bins=0, L=0, N=0,
     shortlist top-L (WALK_BINNED_* constants, per merged-row element)
     and the L-wide lazy-mark sort ensemble (the WALK_SORT_* constants at
     width L)."""
+    # int8 cascade scoring (score_scale > 0): the dequantize cast +
+    # multiply is another 2·Q·X·D elementwise ops, and the dequantized
+    # f32 copy doubles the post-gather traffic words
+    deq_f = 2.0 * Q * X * D if score_scale else 0.0
+    deq_b = Q * X * D * 4.0 if score_scale else 0.0
     if merge_bins:
         wall = X + max(L, 1)
-        flops = (2.0 * Q * X * D
+        flops = (2.0 * Q * X * D + deq_f
                  + costmodel.WALK_BINNED_FLOPS * Q * wall
                  + costmodel.WALK_SORT_FLOPS * Q * max(L, 1))
-        nbytes = (2.0 * Q * X * D * score_itemsize
+        nbytes = (2.0 * Q * X * D * score_itemsize + deq_b
                   + N * D * score_itemsize       # corpus gather operand
                   + costmodel.WALK_BINNED_TRAFFIC * Q * wall * 4
                   + costmodel.WALK_SORT_TRAFFIC * Q * max(L, 1) * 4
                   + 2.0 * Q * W * 4)
         return flops, nbytes
-    flops = 2.0 * Q * X * D + costmodel.WALK_SORT_FLOPS * Q * X
-    nbytes = (2.0 * Q * X * D * score_itemsize
+    flops = 2.0 * Q * X * D + deq_f + costmodel.WALK_SORT_FLOPS * Q * X
+    nbytes = (2.0 * Q * X * D * score_itemsize + deq_b
               + costmodel.WALK_SORT_TRAFFIC * Q * X * 4
               + 2.0 * Q * W * 4)
     return flops, nbytes
@@ -863,9 +923,10 @@ def _finalize_cost(Q, L, D, N, rerank=True, itemsize=4, **_):
 
 
 def _segment_cost(Q, X, D, W, score_itemsize=4, merge_bins=0, L=0, N=0,
-                  **_):
+                  score_scale=0, **_):
     return _walk_iter_cost(Q, X, D, W, score_itemsize,
-                           merge_bins=merge_bins, L=L, N=N)
+                           merge_bins=merge_bins, L=L, N=N,
+                           score_scale=score_scale)
 
 
 def _walk_full_cost(Q, P, X, D, L, W, N, score_itemsize=4, merge_bins=0,
@@ -897,6 +958,14 @@ def _walk_seeded_chunked_cost(M_chunks, **shape):
     return M_chunks * f, M_chunks * b
 
 
+def _finalize_gathered_cost(Q, L, D, itemsize=4, **_):
+    flops = 2.0 * Q * L * D + 3.0 * Q * L * D / 2.0 + 4.0 * Q * L
+    nbytes = 2.0 * Q * L * D * itemsize + 6.0 * Q * L * 4
+    return flops, nbytes
+
+
+costmodel.register("beam.finalize_gathered", _beam_finalize_gathered_kernel,
+                   _finalize_gathered_cost)
 costmodel.register("beam.seed", _beam_seed_kernel, _seed_pivot_cost)
 costmodel.register("beam.seed_seeded", _beam_seed_seeded_kernel,
                    _seed_seeded_cost)
@@ -925,12 +994,44 @@ class GraphSearchEngine:
                  device_sample_rate: float = 0.0,
                  roofline_probe: bool = False,
                  binned_topk: str = "off",
-                 recall_target: float = topk_bins.DEFAULT_RECALL_TARGET):
+                 recall_target: float = topk_bins.DEFAULT_RECALL_TARGET,
+                 cascade_search: bool = False,
+                 corpus_tier: str = "device"):
+        from sptag_tpu.ops import cascade as cascade_ops
+
         n = data.shape[0]
         assert graph.shape[0] == n, (graph.shape, n)
         self.n = n
         self.metric = DistCalcMethod(metric)
         self.base = base
+        # tiered cascade (CascadeSearch, ops/cascade.py ISSUE 14): the
+        # walk scores the int8 quantization of a float corpus (quarter
+        # the gather bytes of f32, half of the bf16 shadow) and the
+        # finalize re-ranks the final pool in exact fp.  CorpusTier=host
+        # additionally moves the fp corpus to HOST RAM: the int8 blocks
+        # ARE the device corpus, and the finalize fetches only the final
+        # L-pool rows host->device (zero full-corpus device residency).
+        # Integer corpora ignore the cascade (already quantized).
+        self.cascade = bool(cascade_search) and \
+            np.issubdtype(np.asarray(data).dtype, np.floating)
+        self.corpus_tier = (cascade_ops.normalize_tier(corpus_tier)
+                            if self.cascade else "device")
+        if self.corpus_tier == "host_all":
+            self.corpus_tier = "host"   # graph engines have no sketch tier
+        self.score_scale = 0.0
+        self.fp_host: Optional[np.ndarray] = None
+        self._deleted_np: Optional[np.ndarray] = None
+        self._cascade_int8 = None
+        if self.cascade:
+            int8_np, scale = cascade_ops.quantize_int8(
+                np.asarray(data, np.float32))
+            self._cascade_int8 = int8_np
+            self.score_scale = cascade_ops.walk_score_scale(
+                True, np.int8, scale)
+            # the packed-neighbor layout materializes SCORE-dtype rows;
+            # with the int8 tier active it would duplicate the corpus at
+            # the wrong dtype — the cascade supersedes it
+            packed_neighbors = False
         # bin-reduction top-k (BinnedTopK param, ops/topk_bins.py):
         # "off" keeps every selection exact (bit-parity path), "on"
         # forces the binned frontier merge + finalize, "auto" engages
@@ -939,7 +1040,14 @@ class GraphSearchEngine:
         # param flip invalidates the engine, never a live program.
         self.binned_mode = topk_bins.normalize_mode(binned_topk)
         self.recall_target = topk_bins.validate_recall_target(recall_target)
-        self.data = jnp.asarray(data)
+        if self.cascade and self.corpus_tier == "host":
+            # host tier: the int8 quantization IS the device corpus; the
+            # fp rows live host-side for the finalize fetch
+            self.data = jnp.asarray(self._cascade_int8)
+            self.fp_host = np.ascontiguousarray(
+                np.asarray(data, np.float32))
+        else:
+            self.data = jnp.asarray(data)
         # bf16 shadow corpus for in-loop scoring (BeamScoreDtype param):
         # halves the walk's dominant gather bytes and doubles the MXU rate
         # at +50% corpus HBM.  "auto" = bf16 on TPU only — CPU's bf16
@@ -953,19 +1061,45 @@ class GraphSearchEngine:
                                else "f32")
             except Exception:                           # noqa: BLE001
                 score_dtype = "f32"
-        self.data_score = (self.data.astype(jnp.bfloat16)
-                           if score_dtype == "bf16"
-                           and self.data.dtype == jnp.float32 else None)
+        if self.cascade and self.corpus_tier == "device":
+            # device-tier cascade: the int8 quantization replaces the
+            # bf16 shadow as the in-loop scoring corpus (half its bytes
+            # again); the finalize re-rank against the resident fp
+            # corpus restores exact distances, same as the bf16 path
+            self.data_score = jnp.asarray(self._cascade_int8)
+        else:
+            self.data_score = (self.data.astype(jnp.bfloat16)
+                               if score_dtype == "bf16"
+                               and self.data.dtype == jnp.float32
+                               else None)
+        self._cascade_int8 = None        # host copy served its purpose
         self.sqnorm = jax.jit(dist_ops.row_sqnorms)(self.data)
+        if self.fp_host is not None:
+            # host tier: `data` is int8, so its norms are in quantized
+            # units — rescale into the dequantized space the walk's
+            # scoring (and the f32-scored pivot seeds) live in
+            self.sqnorm = self.sqnorm * jnp.float32(self.score_scale
+                                                    * self.score_scale)
         self.graph = jnp.asarray(graph.astype(np.int32, copy=False))
         if deleted is None:
             deleted = np.zeros(n, bool)
         self.deleted = jnp.asarray(deleted[:n])
+        if self.fp_host is not None:
+            # host finalize gathers tombstones host-side alongside rows
+            self._deleted_np = np.ascontiguousarray(deleted[:n])
         pivot_ids = np.asarray(pivot_ids, np.int32)
         if len(pivot_ids) == 0:
             pivot_ids = np.zeros(1, np.int32)
         self.pivot_ids = jnp.asarray(pivot_ids)
-        self.pivot_vecs = self.data[self.pivot_ids]
+        if self.fp_host is not None:
+            # dequantized f32 pivots: seed distances must live in the
+            # same (approximate) space the walk's dequantized scoring
+            # does — the beam pool merges both
+            self.pivot_vecs = (self.data[self.pivot_ids]
+                               .astype(jnp.float32)
+                               * jnp.float32(self.score_scale))
+        else:
+            self.pivot_vecs = self.data[self.pivot_ids]
         mask = np.zeros(_num_words(n), np.uint32)
         np.bitwise_or.at(mask, pivot_ids >> 5,
                          np.uint32(1) << (pivot_ids.astype(np.uint32) & 31))
@@ -1013,12 +1147,23 @@ class GraphSearchEngine:
     def register_devmem(self) -> None:
         """(Re-)register this snapshot's resident bytes with the memory
         ledger — called at build, and again when DeviceBytesLedger is
-        re-enabled on a warm index (the disable dropped the entries)."""
-        devmem.track("corpus", self,
-                     self.data.nbytes + self.sqnorm.nbytes
-                     + (self.data_score.nbytes
-                        if self.data_score is not None else 0)
-                     + self.deleted.nbytes)
+        re-enabled on a warm index (the disable dropped the entries).
+        A host-tier cascade engine splits the accounting: the int8
+        device corpus under ``int8_blocks`` and the host-RAM fp rows
+        under ``host_corpus`` (host=True — on /debug/memory, excluded
+        from the HBM total the capacity bench reads)."""
+        if self.fp_host is not None:
+            devmem.track("int8_blocks", self,
+                         self.data.nbytes + self.sqnorm.nbytes
+                         + self.deleted.nbytes)
+            devmem.track("host_corpus", self, self.fp_host.nbytes,
+                         host=True)
+        else:
+            devmem.track("corpus", self,
+                         self.data.nbytes + self.sqnorm.nbytes
+                         + (self.data_score.nbytes
+                            if self.data_score is not None else 0)
+                         + self.deleted.nbytes)
         devmem.track("graph", self, self.graph.nbytes)
         devmem.track("tree", self,
                      self.pivot_ids.nbytes + self.pivot_vecs.nbytes
@@ -1031,6 +1176,8 @@ class GraphSearchEngine:
         """Swap only the tombstone mask — mutation path for delete-only
         changes, which must not pay a full snapshot rebuild."""
         self.deleted = jnp.asarray(deleted[:self.n])
+        if self.fp_host is not None:
+            self._deleted_np = np.ascontiguousarray(deleted[:self.n])
 
     def exact_scan(self, queries: np.ndarray, k: int
                    ) -> Tuple[np.ndarray, np.ndarray]:
@@ -1040,7 +1187,17 @@ class GraphSearchEngine:
         .exact_search_batch).  Reuses the engine's already-resident
         data/sqnorm/deleted arrays, so the shadow path costs zero extra
         HBM, and rides the registered `flat.scan` kernel family — its
-        device work is ledger-attributed like every other dispatch."""
+        device work is ledger-attributed like every other dispatch.
+        A host-tier cascade engine has no resident fp corpus: the
+        oracle streams the scan through fixed fp blocks instead
+        (cascade.host_exact_scan — re-uploading the corpus would break
+        the zero-residency contract it is supposed to measure)."""
+        if self.fp_host is not None:
+            from sptag_tpu.ops import cascade as cascade_ops
+
+            return cascade_ops.host_exact_scan(
+                self.fp_host, self._deleted_np, queries,
+                min(k, self.n), int(self.metric), self.base)
         from sptag_tpu.algo.flat import exact_device_scan
 
         return exact_device_scan(self.data, self.sqnorm, self.deleted,
@@ -1121,7 +1278,7 @@ class GraphSearchEngine:
             D=self.data.shape[1], W=_num_words(self.n),
             score_itemsize=self.score_itemsize(),
             merge_bins=self.merge_bins_for(L, B) if L else 0, L=L,
-            N=self.n)
+            N=self.n, score_scale=self.score_scale)
 
     def seed_state(self, queries: jax.Array, L: int,
                    seeds: Optional[jax.Array] = None) -> dict:
@@ -1139,7 +1296,8 @@ class GraphSearchEngine:
         else:
             cand_ids, cand_d, visited = _beam_seed_seeded_kernel(
                 self.data, self.sqnorm, seeds, queries, L,
-                int(self.metric), self.base)
+                int(self.metric), self.base,
+                score_scale=self.score_scale)
             spare_ids = spare_d = None
         cand_ids, cand_d, expanded, visited, no_better, ptr, it = \
             _init_walk_state(cand_ids, cand_d, visited)
@@ -1171,7 +1329,8 @@ class GraphSearchEngine:
             spare_ids=spare_ids, spare_d=state["spare_d"],
             data_score=self.data_score, nbr_vecs=self.nbr_vecs,
             nbr_sq=self.nbr_sq,
-            merge_bins=self.merge_bins_for(L, B))
+            merge_bins=self.merge_bins_for(L, B),
+            score_scale=self.score_scale)
         if sample:
             # dispatch-to-completion wall time: the kernel call returns as
             # soon as XLA enqueues, so only a sampled block_until_ready
@@ -1212,7 +1371,19 @@ class GraphSearchEngine:
     def finalize(self, state: dict, k_eff: int
                  ) -> Tuple[np.ndarray, np.ndarray]:
         """Rerank + tombstone-filter + top-k over the state's pools;
-        identical epilogue to the monolithic kernels."""
+        identical epilogue to the monolithic kernels.  A host-tier
+        cascade engine fetches ONLY the final L-pool's fp rows from
+        host RAM for the exact re-rank (the beyond-HBM contract:
+        the fp corpus never rides the device)."""
+        if self.fp_host is not None:
+            ids_np = np.asarray(state["cand_ids"])
+            safe = np.clip(ids_np, 0, self.fp_host.shape[0] - 1)
+            rows = self.fp_host[safe]
+            dead = self._deleted_np[safe]
+            d, ids = _beam_finalize_gathered_kernel(
+                jnp.asarray(rows), jnp.asarray(dead), state["queries"],
+                state["cand_ids"], k_eff, int(self.metric), self.base)
+            return np.asarray(d), np.asarray(ids)
         rerank = (self.data_score is not None
                   and self.data_score.dtype != self.data.dtype)
         d, ids = _beam_finalize_kernel(
@@ -1294,6 +1465,13 @@ class GraphSearchEngine:
         out_d = np.full((nq, k), np.float32(MAX_DIST), np.float32)
         out_i = np.full((nq, k), -1, np.int32)
         D = queries.shape[1]
+        if self.fp_host is not None and not segment_iters:
+            # host-tier cascade: the finalize's fp rows come from HOST
+            # RAM, which the monolithic fused kernels cannot express —
+            # run the walk as one full-budget segment and finalize
+            # through the host-gather epilogue (bit-identical walk
+            # trajectories either way; DESIGN.md §10's parity contract)
+            segment_iters = T
         if segment_iters:
             d, ids = self._search_segmented(
                 queries, seeds, k_eff, L, B, T, limit, dynamic_pivots,
@@ -1316,7 +1494,8 @@ class GraphSearchEngine:
                     k_eff, L, B, int(self.metric), self.base, limit,
                     inject=dynamic_pivots, data_score=self.data_score,
                     nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
-                    merge_bins=mb, finalize_bins=fb, seed_keep=sk)
+                    merge_bins=mb, finalize_bins=fb, seed_keep=sk,
+                    score_scale=self.score_scale)
             else:
                 s = seeds.astype(np.int32, copy=False)
                 if q_pad != nq:
@@ -1329,7 +1508,8 @@ class GraphSearchEngine:
                     k_eff, L, B, int(self.metric), self.base, limit,
                     data_score=self.data_score,
                     nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
-                    merge_bins=mb, finalize_bins=fb)
+                    merge_bins=mb, finalize_bins=fb,
+                    score_scale=self.score_scale)
             out_d[:, :k_eff] = np.asarray(d)[:nq]
             out_i[:, :k_eff] = np.asarray(ids)[:nq]
             return out_d, out_i
@@ -1350,7 +1530,8 @@ class GraphSearchEngine:
                 k_eff, L, B, int(self.metric), self.base, limit,
                 inject=dynamic_pivots, data_score=self.data_score,
                 nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
-                merge_bins=mb, finalize_bins=fb, seed_keep=sk)
+                merge_bins=mb, finalize_bins=fb, seed_keep=sk,
+                score_scale=self.score_scale)
         else:
             s = seeds.astype(np.int32, copy=False)
             if m * chunk != nq:
@@ -1364,7 +1545,8 @@ class GraphSearchEngine:
                 k_eff, L, B, int(self.metric), self.base, limit,
                 data_score=self.data_score,
                 nbr_vecs=self.nbr_vecs, nbr_sq=self.nbr_sq,
-                merge_bins=mb, finalize_bins=fb)
+                merge_bins=mb, finalize_bins=fb,
+                score_scale=self.score_scale)
         d = np.asarray(d).reshape(m * chunk, -1)
         ids = np.asarray(ids).reshape(m * chunk, -1)
         out_d[:, :k_eff] = d[:nq]

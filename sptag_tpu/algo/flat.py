@@ -34,6 +34,7 @@ from sptag_tpu.core.types import (
     dtype_of,
 )
 from sptag_tpu.io import format as fmt
+from sptag_tpu.ops import cascade
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.ops import topk_bins
 from sptag_tpu.utils import costmodel, devmem, round_up
@@ -112,21 +113,10 @@ def exact_device_scan(data_d, sqnorm_d, invalid_d, queries: np.ndarray,
     return np.asarray(dists)[:q], np.asarray(ids)[:q]
 
 
-def _pack_sign_bits(centered: jax.Array) -> jax.Array:
-    """(R, D) centered values -> (R, W) int32 packed sign bits, W =
-    ceil(D/32).  Bit i of word w = sign(x[32w + i]) > 0; D is zero-padded
-    so query and corpus pads contribute identical bits (XOR = 0)."""
-    r, d = centered.shape
-    w = (d + 31) // 32
-    pad = w * 32 - d
-    bits = (centered > 0)
-    if pad:
-        bits = jnp.concatenate(
-            [bits, jnp.zeros((r, pad), bool)], axis=1)
-    bits = bits.reshape(r, w, 32).astype(jnp.int32)
-    powers = jnp.left_shift(jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
-    return (bits * powers[None, None, :]).sum(axis=2).astype(jnp.int32)
-
+# canonical sketch packer now lives with the tiered cascade (ops/
+# cascade.py, ISSUE 14) — the standalone SketchPrefilter and the
+# cascade's sketch tier must pack identical bits
+_pack_sign_bits = cascade.pack_sign_bits
 
 _PACK_JIT = jax.jit(_pack_sign_bits)    # one wrapper -> shape-keyed cache
 
@@ -269,6 +259,20 @@ class FlatIndex(VectorIndex):
         self._dirty = True
         self._device: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
         self._sketch: Optional[Tuple[jax.Array, jax.Array]] = None
+        # tiered cascade snapshot (ops/cascade.py, ISSUE 14); rebuilt on
+        # mutation like the sketch cache
+        self._cascade: Optional[cascade.CascadeState] = None
+        # persisted SketchRerank calibration (save/load satellite):
+        # (main_rows, num_deleted, cal_r) from sketch_cal.bin — consumed
+        # by _ensure_calibrated iff the corpus is untouched since save
+        self._loaded_cal: Optional[Tuple[int, int, int]] = None
+
+    def _invalidate_derived(self) -> None:
+        """Drop snapshot-derived caches on corpus mutation: the cascade
+        state covers stale rows, and a persisted calibration no longer
+        describes this corpus (the satellite's invalidation contract)."""
+        self._cascade = None
+        self._loaded_cal = None
 
     def _make_params(self) -> FlatParams:
         return FlatParams()
@@ -315,6 +319,7 @@ class FlatIndex(VectorIndex):
         self._deleted = np.zeros(self._n, dtype=bool)
         self._num_deleted = 0
         self._dirty = True
+        self._invalidate_derived()
 
     def _add(self, data: np.ndarray) -> int:
         begin = self._n
@@ -322,6 +327,7 @@ class FlatIndex(VectorIndex):
         self._host[begin:begin + data.shape[0]] = data
         self._n += data.shape[0]
         self._dirty = True
+        self._invalidate_derived()
         return begin
 
     def _delete_id(self, vid: int) -> bool:
@@ -330,6 +336,7 @@ class FlatIndex(VectorIndex):
         self._deleted[vid] = True
         self._num_deleted += 1
         self._dirty = True
+        self._invalidate_derived()
         return True
 
     # ---- delta shard (ISSUE 9) --------------------------------------------
@@ -352,6 +359,7 @@ class FlatIndex(VectorIndex):
         # the rows are already resident in _host; absorbing is just
         # letting the next snapshot cover them
         self._dirty = True
+        self._invalidate_derived()
 
     # ---- device snapshot --------------------------------------------------
 
@@ -368,6 +376,8 @@ class FlatIndex(VectorIndex):
                 packed, mean = self._sketch[1], self._sketch[2]
                 devmem.track("sketch", packed,
                              packed.nbytes + mean.nbytes)
+            if self._cascade is not None:
+                self._cascade.register_devmem()
 
     def _snapshot(self):
         if not self._dirty and self._device is not None:
@@ -466,12 +476,24 @@ class FlatIndex(VectorIndex):
         A FAILED calibration (<8 live rows, kernel error) is cached as a
         -1 sentinel so it is attempted at most once per snapshot — the
         consumer's cal_r<=0 test falls back to the N/32 heuristic without
-        re-paying the exact scan on every search (ADVICE r4)."""
+        re-paying the exact scan on every search (ADVICE r4).
+
+        A calibration PERSISTED with the index blobs (sketch_cal.bin,
+        manifest-checksummed) short-circuits the whole scan on a warm
+        start — valid only while the corpus is untouched since save
+        (`_invalidate_derived` drops it on any mutation, and the
+        (rows, deletes) fingerprint double-checks)."""
         device, packed, mean, cal_r = self._sketch_snapshot()
         if cal_r is not None:
             return device, packed, mean, cal_r
-        data_d, sqnorm_d, invalid_d = device
-        cal_r = self._calibrate(data_d, sqnorm_d, invalid_d, packed, mean)
+        loaded = self._loaded_cal
+        if loaded is not None and loaded[0] == self._main_rows() \
+                and loaded[1] == self._num_deleted and loaded[2] > 0:
+            cal_r = int(loaded[2])
+        else:
+            data_d, sqnorm_d, invalid_d = device
+            cal_r = self._calibrate(data_d, sqnorm_d, invalid_d, packed,
+                                    mean)
         with self._lock:
             if self._sketch is not None and self._sketch[0] is device:
                 self._sketch = (device, packed, mean,
@@ -487,13 +509,26 @@ class FlatIndex(VectorIndex):
         if self._n == 0:
             raise RuntimeError("index is empty")
         del max_check, search_mode      # exact scan: no budget, no modes
-        data_d, sqnorm_d, invalid_d = self._snapshot()
         q = queries.shape[0]
         q_pad = _query_bucket(q)
         if q_pad != q:
             queries = np.concatenate(
                 [queries, np.zeros((q_pad - q, queries.shape[1]),
                                    queries.dtype)], axis=0)
+        if self._cascade_active():
+            # tiered cascade (ops/cascade.py, ISSUE 14): sketch Hamming
+            # scan -> int8 re-rank -> fp exact re-rank, per-tier
+            # budgeted.  Routed BEFORE the snapshot read: with
+            # CorpusTier=host/host_all the fp corpus must never become
+            # device-resident on the serve path
+            st = self._cascade_state()
+            k_eff = min(k, st.n_pad)
+            dists, ids = st.search(
+                np.asarray(queries, np.float32), k_eff,
+                int(getattr(self.params, "tier_budget_sketch", 0)),
+                int(getattr(self.params, "tier_budget_int8", 0)))
+            return self._pad_k(dists[:q], ids[:q], q, k, k_eff)
+        data_d, sqnorm_d, invalid_d = self._snapshot()
         k_eff = min(k, data_d.shape[0])
         if getattr(self.params, "sketch_prefilter", False) \
                 and data_d.shape[0] > 256:
@@ -538,6 +573,10 @@ class FlatIndex(VectorIndex):
                 recall_target=rt, binned_bins=bins)
         dists = np.asarray(dists)[:q]
         ids = np.asarray(ids)[:q]
+        return self._pad_k(dists, ids, q, k, k_eff)
+
+    @staticmethod
+    def _pad_k(dists, ids, q: int, k: int, k_eff: int):
         if k_eff < k:
             pad_d = np.full((q, k - k_eff), MAX_DIST, np.float32)
             pad_i = np.full((q, k - k_eff), -1, np.int32)
@@ -545,11 +584,79 @@ class FlatIndex(VectorIndex):
             ids = np.concatenate([ids, pad_i], axis=1)
         return dists, ids
 
+    # ---- tiered cascade (ops/cascade.py, ISSUE 14) ------------------------
+
+    def _cascade_active(self) -> bool:
+        """CascadeSearch applies to FLOAT value types only — integer
+        corpora are already quantized and keep their documented exact
+        integer distance paths (int16 byte-split exactness included);
+        the knob is an ignored no-op there, same as the graph engines'
+        guard."""
+        return (int(getattr(self.params, "cascade_search", 0)) != 0
+                and np.issubdtype(dtype_of(self.value_type),
+                                  np.floating))
+
+    def _cascade_state(self) -> cascade.CascadeState:
+        """Pinned cascade snapshot, rebuilt on mutation (same epoch
+        semantics as _sketch_snapshot).  Device tier reuses the fp
+        snapshot the oracle already holds (zero extra fp HBM); host
+        tiers build WITHOUT ever calling _snapshot — the fp corpus
+        stays host-side."""
+        tier = cascade.normalize_tier(
+            getattr(self.params, "corpus_tier", "device"))
+        with self._lock:
+            st = self._cascade
+            if st is not None and st.tier == tier:
+                return st
+            n = self._main_rows()
+            st = cascade.CascadeState(
+                np.asarray(self._host[:n], np.float32),
+                self._deleted[:n], tier, int(self.dist_calc_method),
+                self.base,
+                fp_dev=(self._snapshot()[0] if tier == "device"
+                        else None))
+            st.register_devmem()
+            self._cascade = st
+            return st
+
+    def cascade_triage(self, query: np.ndarray, truth_ids,
+                       k: int = 10) -> Optional[dict]:
+        """Quality-monitor triage hook (utils/qualmon.py
+        classify_low_recall): which cascade tier dropped the true
+        neighbors of one sampled low-recall query?  None when the
+        cascade is off — the caller falls back to the legacy verdicts."""
+        if not self._cascade_active():
+            return None
+        st = self._cascade_state()
+        return st.tier_membership(
+            query, truth_ids, k,
+            int(getattr(self.params, "tier_budget_sketch", 0)),
+            int(getattr(self.params, "tier_budget_int8", 0)))
+
     def _exact_scan(self, queries: np.ndarray, k: int
                     ) -> Tuple[np.ndarray, np.ndarray]:
         """Quality-monitor oracle (core/index.py exact_search_batch):
         the cached device snapshot + the exact kernel, bypassing the
-        ApproxTopK / SketchPrefilter serving configuration."""
+        ApproxTopK / SketchPrefilter / CascadeSearch serving
+        configuration.  Host-tier cascade indexes stream the scan
+        through fixed fp blocks instead (cascade.host_exact_scan) — an
+        oracle that re-uploaded the full corpus would break the
+        zero-residency contract the tier exists for."""
+        if self._cascade_active():
+            st = self._cascade_state()
+            if st.fp_host is not None:
+                q = queries.shape[0]
+                q_pad = _query_bucket(q)
+                if q_pad != q:
+                    queries = np.concatenate(
+                        [queries,
+                         np.zeros((q_pad - q, queries.shape[1]),
+                                  queries.dtype)], axis=0)
+                d, ids = cascade.host_exact_scan(
+                    st.fp_host, st.invalid_host, queries,
+                    min(k, st.n_pad), int(self.dist_calc_method),
+                    self.base)
+                return d[:q], ids[:q]
         data_d, sqnorm_d, invalid_d = self._snapshot()
         return exact_device_scan(data_d, sqnorm_d, invalid_d, queries, k,
                                  int(self.dist_calc_method), self.base)
@@ -567,6 +674,7 @@ class FlatIndex(VectorIndex):
         if self._meta_to_vec is not None:
             self.build_meta_mapping()
         self._dirty = True
+        self._invalidate_derived()
 
     def _blob_writers(self):
         return [
@@ -590,6 +698,35 @@ class FlatIndex(VectorIndex):
             (self.params.delete_file, self._load_deletes_stream, True),
         ]
 
+    # SketchRerank calibration persistence (ISSUE 14 satellite).  A
+    # folder-only side blob — NOT part of _blob_writers: the wrapper
+    # blob surface pairs blobs to loaders positionally, and a
+    # conditionally-present blob would shift the metadata blobs.  The
+    # save_index manifest checksums every folder file, this one
+    # included, so a corrupt calibration fails the load like any blob.
+    _CAL_FILE = "sketch_cal.bin"
+    _CAL_MAGIC = b"SPTSCAL1"
+
+    def _cal_payload(self) -> Optional[bytes]:
+        """(rows, deletes, cal_r) of the CURRENT corpus, or None when no
+        valid calibration exists (nothing is written then — default-off
+        saves stay byte-identical file sets)."""
+        import struct
+
+        n, ndel = self._main_rows(), self._num_deleted
+        cal_r = 0
+        with self._lock:
+            if not self._dirty and self._sketch is not None \
+                    and self._sketch[3] and self._sketch[3] > 0:
+                cal_r = int(self._sketch[3])
+        if cal_r <= 0 and self._loaded_cal is not None \
+                and self._loaded_cal[0] == n \
+                and self._loaded_cal[1] == ndel:
+            cal_r = int(self._loaded_cal[2])
+        if cal_r <= 0:
+            return None
+        return struct.pack("<8sqqi", self._CAL_MAGIC, n, ndel, cal_r)
+
     def _save_index_data(self, folder: str) -> None:
         from sptag_tpu.io import atomic
 
@@ -597,8 +734,15 @@ class FlatIndex(VectorIndex):
             with atomic.checked_open(os.path.join(folder, name),
                                      "wb") as f:
                 writer(f)
+        payload = self._cal_payload()
+        if payload is not None:
+            with atomic.checked_open(
+                    os.path.join(folder, self._CAL_FILE), "wb") as f:
+                f.write(payload)
 
     def _load_index_data(self, folder: str) -> None:
+        import struct
+
         for name, loader, optional in self._blob_loaders():
             path = os.path.join(folder, name)
             if not os.path.exists(path):
@@ -607,3 +751,15 @@ class FlatIndex(VectorIndex):
                 raise FileNotFoundError(path)
             with open(path, "rb") as f:
                 loader(f)
+        cal_path = os.path.join(folder, self._CAL_FILE)
+        if os.path.exists(cal_path):
+            try:
+                with open(cal_path, "rb") as f:
+                    magic, n, ndel, cal_r = struct.unpack(
+                        "<8sqqi", f.read(struct.calcsize("<8sqqi")))
+                if magic == self._CAL_MAGIC and cal_r > 0:
+                    # validated again at consume time against the LIVE
+                    # (rows, deletes) fingerprint (_ensure_calibrated)
+                    self._loaded_cal = (int(n), int(ndel), int(cal_r))
+            except Exception:                          # noqa: BLE001
+                self._loaded_cal = None    # corrupt cal -> recalibrate

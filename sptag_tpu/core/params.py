@@ -235,6 +235,25 @@ _COMMON_TAIL_SPECS = [
     # count is structural (>= pool size), not recall-target-sized —
     # see DESIGN.md §19
     _spec("approx_recall_target", float, 0.99, "ApproxRecallTarget"),
+    # tiered corpus cascade (ops/cascade.py, ISSUE 14; DESIGN.md §20).
+    # CascadeSearch=1 arms the sketch -> int8 -> fp pipeline: the dense
+    # engine serves int8-quantized blocks with a budgeted fp exact
+    # re-rank, and the beam walk scores candidates against the int8
+    # quantization (exact fp re-rank at finalize).  Off (default) keeps
+    # every engine byte-identical to the pre-cascade programs.
+    _spec("cascade_search", int, 0, "CascadeSearch"),
+    # per-tier candidate budgets (static kernel-shape parameters,
+    # validated and power-of-two quantized by cascade.resolve_budgets;
+    # 0 = auto).  A budget covering the whole corpus composes that
+    # tier's filtering out of the program entirely.
+    _spec("tier_budget_sketch", int, 0, "TierBudgetSketch"),
+    _spec("tier_budget_int8", int, 0, "TierBudgetInt8"),
+    # fp-corpus residency: "device" keeps all tiers in HBM (speed play);
+    # "host" keeps only sketches + int8 blocks in HBM with the fp corpus
+    # in host RAM, fetched per-shortlist for the exact re-rank;
+    # "host_all" additionally hosts the int8 blocks (FLAT only —
+    # maximum vectors per HBM byte)
+    _spec("corpus_tier", str, "device", "CorpusTier"),
 ] + [
     # live-mutation durability + delta-shard knobs (ISSUE 9).  All
     # default OFF: serve bytes and on-disk layout are unchanged until an
@@ -457,6 +476,17 @@ class FlatParams(ParamSet):
         # would exceed the 8192 cap, recall suffers and the remedy is an
         # explicit SketchRerank or disabling the prefilter
         _spec("sketch_rerank", int, 0, "SketchRerank"),
+        # tiered corpus cascade (ops/cascade.py, ISSUE 14): the composed
+        # sketch -> int8 -> fp device pipeline with per-tier budgets;
+        # see _COMMON_TAIL_SPECS for the shared semantics.  On FLAT the
+        # cascade replaces the whole scan (SketchPrefilter is the
+        # sketch tier's standalone ancestor and is superseded when
+        # CascadeSearch=1); CorpusTier=host/host_all moves the fp (and
+        # int8) corpus to host RAM with zero full-corpus HBM residency
+        _spec("cascade_search", int, 0, "CascadeSearch"),
+        _spec("tier_budget_sketch", int, 0, "TierBudgetSketch"),
+        _spec("tier_budget_int8", int, 0, "TierBudgetInt8"),
+        _spec("corpus_tier", str, "device", "CorpusTier"),
         # roofline/memory/quality observability knobs; see
         # _COMMON_TAIL_SPECS
         _spec("roofline_probe", int, 0, "RooflineProbe"),

@@ -109,12 +109,14 @@ def _mesh_seed_kernel(pivot_ids, pivot_vecs, pivot_mask, queries, L: int,
 @functools.partial(
     jax.jit,
     static_argnames=("k_local", "L", "B", "S", "metric", "base",
-                     "nbp_limit", "inject", "mesh", "merge_bins"))
+                     "nbp_limit", "inject", "mesh", "merge_bins",
+                     "score_scale"))
 def _mesh_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
                          cand_d, expanded, visited, no_better, ptr, it,
                          spare_ids, spare_d, k_local: int, L: int, B: int,
                          S: int, metric: int, base: int, nbp_limit: int,
-                         inject: int, mesh: Mesh, merge_bins: int = 0):
+                         inject: int, mesh: Mesh, merge_bins: int = 0,
+                         score_scale: float = 0.0, data_score=None):
     """Mesh-wide segment step: every shard advances its rows by at most
     S iterations of the SAME `_walk_machine` body the single-chip
     segment kernel runs, over its own slice of the corpus/graph.  No
@@ -125,13 +127,15 @@ def _mesh_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
     shard's row reached the absorbing done state)."""
 
     def local(data_s, sqnorm_s, graph_s, q, tl, ci, cd, ex, vi, nb, pt,
-              itr, si, sd):
+              itr, si, sd, *score_s):
         state = (ci[:, 0], cd[:, 0], ex[:, 0], vi[:, 0], nb[:, 0],
                  pt[:, 0], itr[:, 0])
         body, row_alive = _walk_machine(
             data_s, sqnorm_s, graph_s, q, tl, k_local, L, B, metric,
             base, nbp_limit, spare_ids=si[:, 0], spare_d=sd[:, 0],
-            inject=inject, merge_bins=merge_bins)
+            inject=inject, merge_bins=merge_bins,
+            data_score=score_s[0] if score_s else None,
+            score_scale=score_scale)
 
         def cond(carry):
             seg, st = carry
@@ -146,23 +150,31 @@ def _mesh_segment_kernel(data, sqnorm, graph, queries, t_limit, cand_ids,
             _shardax(row_alive(state)),)
 
     r3 = P(None, SHARD_AXIS, None)
+    # the optional int8 scoring shadow (CascadeSearch) rides as an extra
+    # row-sharded operand, exactly like the monolithic sharded kernel
+    args = (data, sqnorm, graph, queries, t_limit, cand_ids, cand_d,
+            expanded, visited, no_better, ptr, it, spare_ids, spare_d)
+    in_specs = (P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None),
+                P(None, None), P(None)) + _state_specs() + (r3, r3)
+    if data_score is not None:
+        args = args + (data_score,)
+        in_specs = in_specs + (P(SHARD_AXIS, None),)
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None),
-                  P(None, None), P(None)) + _state_specs() + (r3, r3),
+        in_specs=in_specs,
         out_specs=_state_specs() + (P(None, SHARD_AXIS),),
         check_vma=False,
-    )(data, sqnorm, graph, queries, t_limit, cand_ids, cand_d, expanded,
-      visited, no_better, ptr, it, spare_ids, spare_d)
+    )(*args)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("k_local", "k_final", "metric", "base", "mesh",
-                     "binned_bins"))
+                     "binned_bins", "rerank"))
 def _mesh_finalize_kernel(data, sqnorm, deleted, queries, cand_ids,
                           cand_d, k_local: int, k_final: int, metric: int,
-                          base: int, mesh: Mesh, binned_bins: int = 0):
+                          base: int, mesh: Mesh, binned_bins: int = 0,
+                          rerank: bool = False):
     """Retire epilogue: per-shard rerank/tombstone-filter/top-k_local
     (identical to the single-chip finalize), shard-local ids remapped to
     global, then the ICI all-gather + `lax.top_k` global merge — the
@@ -177,7 +189,7 @@ def _mesh_finalize_kernel(data, sqnorm, deleted, queries, cand_ids,
         n_local = data_s.shape[0]
         shard = jax.lax.axis_index(SHARD_AXIS)
         d, ids = _finalize(data_s, sqnorm_s, del_s, q, ci[:, 0], cd[:, 0],
-                           k_local, metric, base, rerank=False,
+                           k_local, metric, base, rerank=rerank,
                            binned_bins=binned_bins)
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
@@ -207,18 +219,20 @@ def _mesh_seed_cost(Q, P, D, L, W, n_dev, **_):
 
 
 def _mesh_segment_cost(Q, X, D, W, n_dev, score_itemsize=4,
-                       merge_bins=0, L=0, N=0, **_):
+                       merge_bins=0, L=0, N=0, score_scale=0, **_):
     f, b = _walk_iter_cost(Q, X, D, W, score_itemsize,
-                           merge_bins=merge_bins, L=L, N=N)
+                           merge_bins=merge_bins, L=L, N=N,
+                           score_scale=score_scale)
     return n_dev * f, n_dev * b
 
 
-def _mesh_finalize_cost(Q, L, D, N, k_local, k_final, n_dev, **_):
+def _mesh_finalize_cost(Q, L, D, N, k_local, k_final, n_dev,
+                        rerank=False, **_):
     # THE one merge-cost formula lives in sharded.py (the monolithic
     # kernels share the same all-gather + replicated-top-k collective)
     from sptag_tpu.parallel.sharded import _sharded_merge_cost
 
-    f, b = _finalize_cost(Q, L, D, N, rerank=False)
+    f, b = _finalize_cost(Q, L, D, N, rerank=rerank)
     mf, mb = _sharded_merge_cost(Q, k_local, k_final, n_dev)
     return n_dev * f + mf, n_dev * b + mb
 
@@ -263,6 +277,12 @@ class MeshGraphEngine:
         self.metric = sharded.metric
         self.base = sharded.base
         self.data = sharded.data
+        # tiered cascade (CascadeSearch): the int8 scoring shadow + its
+        # STATIC dequantization scale come from the sharded placement —
+        # the same values the monolithic _sharded_beam_kernel compiles
+        # with, so the two paths stay id-identical
+        self.data_score = getattr(sharded, "data_score", None)
+        self.score_scale = float(getattr(sharded, "score_scale", 0.0))
         self.sqnorm = sharded.sqnorm
         self.graph = sharded.graph
         self.deleted = sharded.deleted
@@ -323,10 +343,12 @@ class MeshGraphEngine:
                                       self.recall_target)
 
     def score_itemsize(self) -> int:
-        return int(jnp.dtype(self.data.dtype).itemsize)
+        src = self.data_score if self.data_score is not None else self.data
+        return int(jnp.dtype(src.dtype).itemsize)
 
     def score_dtype_name(self) -> str:
-        return ("int8" if jnp.issubdtype(self.data.dtype, jnp.integer)
+        src = self.data_score if self.data_score is not None else self.data
+        return ("int8" if jnp.issubdtype(src.dtype, jnp.integer)
                 else "f32")
 
     def walk_iter_cost(self, rows: int, B: int, L: int = 0):
@@ -340,7 +362,7 @@ class MeshGraphEngine:
             D=self.data.shape[1], W=_num_words(self.n_local),
             n_dev=self.n_shards, score_itemsize=self.score_itemsize(),
             merge_bins=self.merge_bins_for(L, B) if L else 0, L=L,
-            N=self.n_local)
+            N=self.n_local, score_scale=self.score_scale)
 
     def seed_state(self, queries: jax.Array, L: int,
                    seeds: Optional[jax.Array] = None) -> dict:
@@ -368,7 +390,8 @@ class MeshGraphEngine:
             state["it"], state["spare_ids"], state["spare_d"],
             self._k_local(k_eff), L, B, S, int(self.metric), self.base,
             nbp_limit, inject, self.mesh,
-            merge_bins=self.merge_bins_for(L, B))
+            merge_bins=self.merge_bins_for(L, B),
+            score_scale=self.score_scale, data_score=self.data_score)
         new = dict(state)
         (new["cand_ids"], new["cand_d"], new["expanded"], new["visited"],
          new["no_better"], new["ptr"], new["it"], alive) = out
@@ -384,5 +407,9 @@ class MeshGraphEngine:
             k_eff, int(self.metric), self.base, self.mesh,
             binned_bins=self.finalize_bins_for(
                 self._k_local(k_eff),
-                int(state["cand_ids"].shape[-1])))
+                int(state["cand_ids"].shape[-1])),
+            # same rerank predicate as _walk's epilogue: an int8 shadow
+            # demands the exact fp re-rank before the ICI merge
+            rerank=(self.data_score is not None
+                    and self.data_score.dtype != self.data.dtype))
         return np.asarray(d), np.asarray(ids)

@@ -176,13 +176,14 @@ class ShardedFlatIndex:
     jax.jit,
     static_argnames=("k_local", "k_final", "L", "B", "T", "metric", "base",
                      "nbp_limit", "mesh", "merge_bins", "finalize_bins",
-                     "seed_keep"))
+                     "seed_keep", "score_scale"))
 def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
                          pivot_mask, queries, k_local: int, k_final: int,
                          L: int, B: int, T: int,
                          metric: int, base: int, nbp_limit: int, mesh: Mesh,
                          merge_bins: int = 0, finalize_bins: int = 0,
-                         seed_keep: int = 0):
+                         seed_keep: int = 0, score_scale: float = 0.0,
+                         data_score=None):
     """One program: per-shard pivot-seeded beam walk over the shard's OWN
     RNG graph (local ids), then ICI all-gather of each shard's (dist,
     global-id) top-k and a global top-k re-rank.  This subsumes the
@@ -192,7 +193,7 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
     from sptag_tpu.algo.engine import _beam_search_kernel
 
     def local_search(data_s, sqnorm_s, graph_s, deleted_s, pids_s, pvecs_s,
-                     pmask_s, q_s):
+                     pmask_s, q_s, *score_s):
         n_local = data_s.shape[0]
         shard = jax.lax.axis_index(SHARD_AXIS)
         t_limit = jnp.full((q_s.shape[0],), T, jnp.int32)
@@ -200,21 +201,31 @@ def _sharded_beam_kernel(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
             data_s, sqnorm_s, graph_s, deleted_s, pids_s[0], pvecs_s[0],
             pmask_s[0], q_s, t_limit, k_local, L, B, metric, base,
             nbp_limit, merge_bins=merge_bins, finalize_bins=finalize_bins,
-            seed_keep=seed_keep)
+            seed_keep=seed_keep, score_scale=score_scale,
+            data_score=score_s[0] if score_s else None)
         gids = jnp.where(ids >= 0, ids + shard * n_local, -1)
         return _gather_merge(d, gids, k_final)
 
+    # the optional int8 scoring shadow (CascadeSearch, ops/cascade.py)
+    # rides as an extra row-sharded operand; its STATIC score_scale is
+    # resolved by the same shared rule the mesh scheduler engine uses,
+    # which is what keeps scheduler-vs-monolithic id-parity intact
+    args = (data, sqnorm, graph, deleted, pivot_ids, pivot_vecs,
+            pivot_mask, queries)
+    in_specs = (P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None),
+                P(SHARD_AXIS), P(SHARD_AXIS, None),
+                P(SHARD_AXIS, None, None), P(SHARD_AXIS, None),
+                P(None, None))
+    if data_score is not None:
+        args = args + (data_score,)
+        in_specs = in_specs + (P(SHARD_AXIS, None),)
     return shard_map(
         local_search,
         mesh=mesh,
-        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS), P(SHARD_AXIS, None),
-                  P(SHARD_AXIS), P(SHARD_AXIS, None),
-                  P(SHARD_AXIS, None, None), P(SHARD_AXIS, None),
-                  P(None, None)),
+        in_specs=in_specs,
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
-    )(data, sqnorm, graph, deleted, pivot_ids, pivot_vecs, pivot_mask,
-      queries)
+    )(*args)
 
 
 @functools.partial(
@@ -604,6 +615,9 @@ class ShardedBKTIndex:
         self.budget_policy = "full"
         self.budget_guard_overlap = 0.99
         self._guarded_cache: dict = {}
+        # tiered cascade (CascadeSearch): filled by _place when armed
+        self.data_score = None
+        self.score_scale = 0.0
         # mesh-wide continuous batching (ISSUE 11): built on demand by
         # enable_continuous_batching(); retired as a unit on swap
         self._scheduler = None
@@ -1099,6 +1113,31 @@ class ShardedBKTIndex:
         self.pivot_ids = jax.device_put(pivot_ids, rows)
         self.pivot_vecs = jax.device_put(pivot_vecs, rows3)
         self.pivot_mask = jax.device_put(pivot_mask, rows)
+        # tiered cascade (CascadeSearch, ops/cascade.py ISSUE 14): place
+        # the int8 quantization as the walk's scoring shadow (quarter
+        # the gather bytes per shard); the per-shard finalize re-ranks
+        # against the resident fp blocks.  Mesh serving keeps the fp
+        # corpus device-resident — CorpusTier=host is a single-chip
+        # residency feature and is rejected rather than silently
+        # downgraded.
+        self.data_score = None
+        self.score_scale = 0.0
+        if int(getattr(self.params, "cascade_search", 0) or 0) \
+                and np.issubdtype(np.asarray(data).dtype, np.floating):
+            from sptag_tpu.ops import cascade as cascade_ops
+
+            tier = cascade_ops.normalize_tier(
+                getattr(self.params, "corpus_tier", "device"))
+            if tier != "device":
+                raise ValueError(
+                    "CorpusTier=host is a single-chip engine feature; "
+                    "mesh shards keep the fp corpus resident (run the "
+                    "mesh cascade with CorpusTier=device)")
+            int8_np, scale = cascade_ops.quantize_int8(
+                np.asarray(data, np.float32))
+            self.data_score = jax.device_put(int8_np, rows)
+            self.score_scale = cascade_ops.walk_score_scale(
+                True, np.int8, scale)
         # device-memory ledger (ISSUE 11 satellite): the mesh-resident
         # shard blocks, one aggregate entry per placement — a swap's old
         # placement drops off the gauge when it is collected
@@ -1107,6 +1146,8 @@ class ShardedBKTIndex:
                      + self.graph.nbytes + self.deleted.nbytes
                      + self.pivot_ids.nbytes + self.pivot_vecs.nbytes
                      + self.pivot_mask.nbytes)
+        if self.data_score is not None:
+            devmem.track("int8_blocks", self, self.data_score.nbytes)
 
     # ---- per-shard budget policy (VERDICT r3 item 8) ---------------------
 
@@ -1253,5 +1294,7 @@ class ShardedBKTIndex:
             self.pivot_ids, self.pivot_vecs, self.pivot_mask,
             jnp.asarray(queries), k_local, k_final, L, B, T,
             int(self.metric), self.base, limit, self.mesh,
-            merge_bins=mb, finalize_bins=fb, seed_keep=sk)
+            merge_bins=mb, finalize_bins=fb, seed_keep=sk,
+            score_scale=getattr(self, "score_scale", 0.0),
+            data_score=getattr(self, "data_score", None))
         return _pad_to_k(np.asarray(d), np.asarray(ids), k, k_final)

@@ -905,8 +905,21 @@ def _shadow_replay(context: ServiceContext, rid: str, text: str,
         verdict = detail = ""
         floor = qualmon.recall_floor()
         if floor > 0 and recall < floor:
-            verdict, detail = qualmon.classify_low_recall(rid, mode,
-                                                          sketch=sketch)
+            # cascade tier triage (ISSUE 14): re-run the shortlist
+            # stages for this one sampled query so the verdict can name
+            # the starved tier (sketch_budget / int8_budget /
+            # host_fetch_drop).  Sampled + already-below-floor only —
+            # never the serve path; a triage failure degrades to the
+            # legacy verdicts
+            tiers = None
+            triage = getattr(index, "cascade_triage", None)
+            if triage is not None:
+                try:
+                    tiers = triage(vec.reshape(-1), ex_ids[0][:k], k)
+                except Exception:                        # noqa: BLE001
+                    log.debug("cascade triage failed", exc_info=True)
+            verdict, detail = qualmon.classify_low_recall(
+                rid, mode, sketch=sketch, cascade=tiers)
         qualmon.record_sample(mode, name, recall, k, rid=rid,
                               verdict=verdict, detail=detail)
 

@@ -474,9 +474,19 @@ def record_sample(mode: str, shard: str, recall: float, k: int,
 
 
 def classify_low_recall(rid: str, mode: str,
-                        sketch: bool = False) -> Tuple[str, str]:
+                        sketch: bool = False,
+                        cascade: Optional[Dict[str, int]] = None
+                        ) -> Tuple[str, str]:
     """Where was the recall lost?  Returns (verdict code, human detail).
 
+    * cascade (ISSUE 14): `cascade` is the per-tier triage the shadow
+      path measured for THIS query (the index's `cascade_triage` hook
+      re-runs the shortlist stages and counts which tier dropped each
+      true neighbor — ops/cascade.py tier_membership).  The verdict
+      names the STARVED tier: ``host_fetch_drop`` when the host fp
+      fetch dropped rows, else ``sketch_budget`` / ``int8_budget`` by
+      which shortlist lost more true neighbors — so a recall regression
+      is attributable to the one budget knob that fixes it;
     * beam: the scheduler's per-rid stats carry the row's own iteration
       counter and budget (`iters` / `t_budget`) — iters == budget means
       the walk was cut off by MaxCheck ("beam terminated early"), iters
@@ -491,6 +501,33 @@ def classify_low_recall(rid: str, mode: str,
     modes: request ids are client-supplied and reusable, so a dense or
     flat query sharing a rid with an earlier beam query must not
     inherit that query's iteration counters."""
+    if cascade:
+        host = int(cascade.get("host_dropped", 0) or 0)
+        sk = int(cascade.get("sketch_dropped", 0) or 0)
+        i8 = int(cascade.get("int8_dropped", 0) or 0)
+        # measured budget starvation first: the triage re-ran THIS
+        # query's shortlists, while host_dropped is the snapshot's
+        # lifetime fetch-drop counter (a re-run cannot observe a past
+        # fetch) — it decides only when both shortlists kept every true
+        # neighbor, so one historical drop can never mask a budget root
+        # cause
+        if sk or i8:
+            if sk >= i8:
+                return ("sketch_budget",
+                        "sketch tier dropped %d true neighbor(s) "
+                        "(TierBudgetSketch starved; int8 dropped %d)"
+                        % (sk, i8))
+            return ("int8_budget",
+                    "int8 tier dropped %d true neighbor(s) "
+                    "(TierBudgetInt8 starved; sketch dropped %d)"
+                    % (i8, sk))
+        if host > 0:
+            return ("host_fetch_drop",
+                    "host fp fetch dropped %d shortlist row(s) over "
+                    "this snapshot's lifetime" % host)
+        # every true neighbor survived both shortlists and no fetch
+        # ever dropped: the loss is downstream of the cascade — fall
+        # through to the mode verdicts
     st = (flightrec.query_stats(rid) or {}) \
         if mode in ("beam", "auto") else {}
     it = st.get("iters")

@@ -116,6 +116,16 @@ METRICS: List[Metric] = [
     Metric("mesh_serve.speedup", HIGHER, 0.20, 0.15),
     Metric("mesh_serve.recall_at_10", HIGHER, 0.01, 0.005,
            platform_bound=False),
+    # beyond-HBM tiered capacity (ISSUE 14): servable vectors per GB of
+    # HBM at the recall floor (ledger-measured array bytes — platform-
+    # independent), the chosen cascade config's recall line, and its
+    # density ratio over the fp-only path (the stage's reason to exist)
+    Metric("capacity.vectors_per_gb", HIGHER, 0.10, 1000.0,
+           platform_bound=False),
+    Metric("capacity.cascade_recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
+    Metric("capacity.capacity_ratio_vs_fp", HIGHER, 0.10, 0.3,
+           platform_bound=False),
     # roofline %-of-peak per kernel family (ISSUE 6's ledger rows):
     # regressing the fraction of peak is the canary that a "faster in
     # QPS" change actually left device efficiency on the floor

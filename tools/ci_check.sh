@@ -163,6 +163,22 @@ echo "== binned top-k off: parity + golden bytes (standalone) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/test_binned_topk.py -q \
     -p no:cacheprovider -k "off_parity or parity"
 
+# the ISSUE 14 capacity gate, standalone: with CascadeSearch at its
+# default (off) no cascade state is ever built, FLAT results and served
+# wire bytes stay byte-identical, and the parity contracts hold —
+# host-tier fp re-rank bit-identical to device-resident, host-tier
+# beam segmented/scheduler parity, mesh scheduler-vs-monolithic ids
+echo "== cascade off: parity + golden bytes (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_cascade.py -q \
+    -p no:cacheprovider -k "off_parity or parity"
+
+# the ISSUE 14 lint gate, standalone: every new cascade/host-gather
+# kernel is cost-model registered (GL605) with ZERO new baseline
+# entries — a kernel outside the roofline ledger would make the
+# capacity stage's %-of-peak and devmem numbers untrustworthy
+echo "== GL605 cascade kernel coverage (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL605
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within
