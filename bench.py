@@ -1380,7 +1380,16 @@ def _loadgen_measure(index, queries, k, budget_s):
                                deadline_ms=4.0 * slo_ms)
     ctx = ServiceContext(settings)
     ctx.add_index("main", index)
-    server = SearchServer(ctx, batch_window_ms=2.0, max_batch=128)
+    # serving timeline + ground-truth canary (ISSUE 15) ride the stage:
+    # the canary's exact recall + full-path p99 become benchdiff's
+    # loadgen.canary_* lines, and the timeline summary lands in the
+    # artifact (canary traffic is fair-share-exempt, so it never
+    # distorts the admission numbers this stage exists to measure)
+    server = SearchServer(ctx, batch_window_ms=2.0, max_batch=128,
+                          timeline_interval_ms=float(os.environ.get(
+                              "BENCH_TIMELINE_MS", "250")),
+                          canary_interval_ms=float(os.environ.get(
+                              "BENCH_CANARY_MS", "200")))
     holder = {}
     ready = threading.Event()
 
@@ -1601,6 +1610,27 @@ def _loadgen_measure(index, queries, k, budget_s):
         out["counters"] = {
             nm: metrics_mod.counter_value(nm) - base_counters[nm]
             for nm in counter_names}
+        # canary ground-truth lines (ISSUE 15): mean exact recall vs
+        # the oracle-pinned truth + the probe path's p99 — benchdiff's
+        # loadgen.canary_recall_at_10 / loadgen.canary_p99_ms
+        if server._canary is not None:
+            csnap = server._canary.snapshot()
+            recalls = [st["recall_mean"]
+                       for st in csnap["indexes"].values()
+                       if st.get("recall_mean") is not None]
+            if recalls:
+                out["canary_recall_at_10"] = round(
+                    sum(recalls) / len(recalls), 4)
+            ch = metrics_mod.histogram_or_none("canary.latency")
+            if ch is not None and ch.count:
+                out["canary_p99_ms"] = round(
+                    ch.percentile(99) * 1000.0, 3)
+            out["canary"] = csnap
+        from sptag_tpu.utils import timeline as timeline_mod
+
+        out["timeline"] = timeline_mod.summary(
+            prefixes=["canary.", "slo.", "server.request",
+                      "server.responses", "admission."])
     finally:
         try:
             prof = hostprof.snapshot()
@@ -1614,6 +1644,11 @@ def _loadgen_measure(index, queries, k, budget_s):
         except Exception:                                # noqa: BLE001
             pass
         hostprof.reset()
+        # stop the timeline sampler before the next stage (armed by
+        # this stage's server; the reset also clears the canary series)
+        from sptag_tpu.utils import timeline as timeline_mod
+
+        timeline_mod.reset()
         try:
             sock.close()
         except OSError:

@@ -61,6 +61,65 @@ log = logging.getLogger(__name__)
 #: jax in — the scheduler is importable backend-free)
 MAX_DIST = np.float32(3.4e38)
 
+# ---------------------------------------------------------------------------
+# mesh shard-skew telemetry (ISSUE 15): per-shard work from the mesh
+# scheduler's (cap, n_shards) iteration counters, published as labeled
+# series through the shared provider surface so /metrics exposes
+# ``scheduler_shard_iters{shard=}`` and the timeline records its history
+# ---------------------------------------------------------------------------
+
+_skew_lock = locksan.make_lock("scheduler._skew_lock")
+#: shard index -> mean resident iterations per live row (last cycle);
+#: last-writer-wins across pools — one mesh scheduler per host in
+#: practice, and the straggler picture is per-host anyway
+_shard_iters: Dict[int, float] = {}
+
+
+def _publish_shard_skew(pool: "_SlotPool", shards: int) -> None:
+    """Per-shard work + skew gauges from one mesh pool's live rows.
+    Called once per scheduler cycle (never per row) — host-side numpy
+    over at most (cap, n_shards) ints."""
+    live = [i for i, e in enumerate(pool.entries) if e is not None]
+    if not live:
+        return
+    it = np.asarray(pool.state["it"])[live].reshape(len(live), shards)
+    per_shard = it.sum(axis=0).astype(np.float64)
+    mean = float(per_shard.mean())
+    with _skew_lock:
+        _shard_iters.clear()
+        for s in range(shards):
+            _shard_iters[s] = round(float(per_shard[s]) / len(live), 3)
+    if mean > 0:
+        # skew: straggler's excess over the mesh mean (0 = balanced).
+        # The straggler is the shard with the MOST iterations — its
+        # sub-walks converge last, so it holds every slot row hostage
+        metrics.set_gauge("scheduler.shard_skew",
+                          float(per_shard.max()) / mean - 1.0)
+        metrics.set_gauge("scheduler.straggler_shard",
+                          int(per_shard.argmax()))
+
+
+def _shard_iter_families() -> List[metrics.Family]:
+    with _skew_lock:
+        if not _shard_iters:
+            return []
+        fam = metrics.Family(
+            "scheduler.shard_iters",
+            help="mean resident walk iterations per live slot row, "
+                 "per mesh shard (straggler telemetry)")
+        for s, v in sorted(_shard_iters.items()):
+            fam.add(v, {"shard": str(s)})
+    return [fam]
+
+
+def reset_shard_skew() -> None:
+    """Drop the published per-shard series (test isolation)."""
+    with _skew_lock:
+        _shard_iters.clear()
+
+
+metrics.register_family_provider("mesh_skew", _shard_iter_families)
+
 
 class SchedulerStopped(RuntimeError):
     """submit() after stop(), or the worker thread died."""
@@ -145,7 +204,14 @@ class _SlotPool:
         amortization bench.py's roofline row applies)."""
         if self._iter_cost1 is None:
             try:
-                rows = max(int(self.slots), 1)
+                # max_slots, not capacity: the amortization base must be
+                # stable across grow/compact cycles (the cost is cached
+                # once).  A `self.slots` typo here once raised
+                # AttributeError into the broad except below, silently
+                # disabling gflops= attribution forever (ISSUE 15
+                # satellite root-cause; regression-pinned in
+                # tests/test_roofline.py)
+                rows = max(int(self.max_slots), 1)
                 est = self.engine.walk_iter_cost(rows, self.B, self.L)
                 from sptag_tpu.utils.costmodel import CostEstimate
 
@@ -523,6 +589,10 @@ class BeamSlotScheduler:
             # np.array, not asarray: device arrays export as READ-ONLY
             # host views, and blank/insert mutate these in place
             pool.state[name] = np.array(new_state[name])
+        if shards > 1:
+            # mesh skew telemetry (ISSUE 15): per-shard work + straggler
+            # gauges from the fresh (cap, n_shards) iteration counters
+            _publish_shard_skew(pool, shards)
         # ---- retire ------------------------------------------------------
         if done:
             # finalize ONLY the retiring rows, gathered to a bucketed
@@ -581,6 +651,19 @@ class BeamSlotScheduler:
                         slot_wait_ms=round(item.slot_wait * 1000.0, 3),
                         segments=item.segments, refills=item.refills,
                         iters=iters_done[j], t_budget=int(item.t_limit))
+                    if shards > 1:
+                        # per-query shard skew (ISSUE 15): the row's own
+                        # per-shard iteration counters — qualmon's
+                        # classify_low_recall turns a straggler-dominated
+                        # budget exhaustion into a "shard_skew" verdict
+                        # naming the shard
+                        row_it = np.asarray(
+                            pool.state["it"][done[j]]).reshape(-1)
+                        row_mean = float(row_it.mean())
+                        if row_mean > 0:
+                            stats["shard_imbalance"] = round(
+                                float(row_it.max()) / row_mean, 3)
+                            stats["slow_shard"] = int(row_it.argmax())
                     if cost1 is not None:
                         it_n = iters_done[j]
                         exec_s = max(t_done - item.t_enq - item.slot_wait,

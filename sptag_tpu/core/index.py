@@ -261,6 +261,26 @@ class VectorIndex(abc.ABC):
                 # register the live ones so gauges come back without a
                 # rebuild (slot pools re-track on their next resize)
                 self._retrack_devmem()
+        if ok and low in ("timelineintervalms", "timelineevents"):
+            # serving timeline (utils/timeline.py, ISSUE 15): process-
+            # wide, live-applied like the quality knobs — interval > 0
+            # arms + starts the sampler, 0 stops it; the events knob
+            # resizes the per-series rings
+            from sptag_tpu.utils import timeline
+
+            if low == "timelineintervalms":
+                interval = float(getattr(self.params,
+                                         "timeline_interval_ms", 0.0))
+                if interval > 0:
+                    timeline.configure(enabled=True, interval_ms=interval)
+                    timeline.start()
+                else:
+                    timeline.configure(enabled=False)
+                    timeline.stop()
+            else:
+                timeline.configure(
+                    capacity=int(getattr(self.params, "timeline_events",
+                                         0)) or None)
         if ok and low in self._QUALITY_PARAMS:
             from sptag_tpu.utils import qualmon
 

@@ -207,6 +207,16 @@ _COMMON_TAIL_SPECS = [
     _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
     _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
     _spec("quality_window", int, 0, "QualityWindow"),
+    # serving timeline (utils/timeline.py, ISSUE 15).  Process-wide
+    # like the flight-recorder knobs; live-applied via set_parameter on
+    # every index family (offline runs: bench / index_builder /
+    # index_searcher arm the sampler through them) and mirrored as
+    # [Service] ini settings on both serve tiers.  TimelineIntervalMs>0
+    # starts the sampler at that cadence (0 stops it — one flag test on
+    # every other path); TimelineEvents sizes the per-series fine ring
+    # (0 = module default 512).
+    _spec("timeline_interval_ms", float, 0.0, "TimelineIntervalMs"),
+    _spec("timeline_events", int, 0, "TimelineEvents"),
     # in-mesh sharded serving (parallel/sharded.py, ISSUE 11).  All off
     # by default — single-chip indexes ignore them; the mesh build/serve
     # paths read them off the shard params.  MeshServe=1 is the offline
@@ -495,6 +505,9 @@ class FlatParams(ParamSet):
         _spec("quality_recall_floor", float, 0.0, "QualityRecallFloor"),
         _spec("quality_shadow_budget", float, 0.0, "QualityShadowBudget"),
         _spec("quality_window", int, 0, "QualityWindow"),
+        # serving timeline; see _COMMON_TAIL_SPECS
+        _spec("timeline_interval_ms", float, 0.0, "TimelineIntervalMs"),
+        _spec("timeline_events", int, 0, "TimelineEvents"),
         # mutation durability + delta shard; see _COMMON_TAIL_SPECS
         _spec("wal_enabled", int, 0, "WalEnabled"),
         _spec("wal_fsync", int, 1, "WalFsync"),

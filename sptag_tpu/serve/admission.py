@@ -203,11 +203,17 @@ class AdmissionController:
 
     # --------------------------------------------------------------- admit
 
-    def admit(self, client: str) -> str:
+    def admit(self, client: str, canary: bool = False) -> str:
         """One admission decision for a request from `client` (the
         pre-decode connection identity).  Returns ADMIT / DEGRADE /
         SHED; all bookkeeping (state refresh, fair-share accounting,
-        counters) happens here."""
+        counters) happens here.
+
+        `canary=True` (serve/canary.py isolation contract, ISSUE 15):
+        the request still rides the real state ladder — a shed canary
+        IS the availability signal — but is EXCLUDED from fair-share
+        accounting: probe traffic must neither distort tenant shares
+        nor be fairness-shed as the "hot client" on an idle server."""
         now = self._clock()
         self._maybe_refresh(now)
         cfg = self.config
@@ -216,6 +222,11 @@ class AdmissionController:
             if state == 2:
                 metrics.inc("admission.sheds")
                 return SHED
+            if canary:
+                if state == 1:
+                    metrics.inc("admission.degraded_queries")
+                    return DEGRADE
+                return ADMIT
             share = self._charge(client, now)
             if state == 1:
                 # share first (O(1)); the O(clients) active count runs

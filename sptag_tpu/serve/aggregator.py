@@ -40,13 +40,16 @@ import functools
 import logging
 import random
 import time
+import weakref
 from typing import List, Optional, Tuple
 
 from sptag_tpu.serve import admission as admission_mod
+from sptag_tpu.serve import canary as canary_mod
 from sptag_tpu.serve import protocol, wire
+from sptag_tpu.serve import slo as slo_mod
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.utils import (flightrec, hostprof, locksan, metrics, qualmon,
-                             trace)
+                             timeline, trace)
 from sptag_tpu.utils.ini import IniReader
 
 log = logging.getLogger(__name__)
@@ -272,7 +275,21 @@ class AggregatorContext:
                  host_prof_dump_on_slow_query: bool = False,
                  lock_contention_ledger: bool = False,
                  race_sanitizer: bool = False,
-                 racesan_sample_rate: float = 1.0):
+                 racesan_sample_rate: float = 1.0,
+                 timeline_interval_ms: float = 0.0,
+                 timeline_events: int = 0,
+                 slo_availability_target: float = 0.0,
+                 slo_p99_ms: float = 0.0,
+                 slo_recall_floor: float = 0.0,
+                 slo_qps_floor: float = 0.0,
+                 slo_budget: float = 0.05,
+                 slo_fast_window_s: float = 60.0,
+                 slo_slow_window_s: float = 300.0,
+                 slo_warn_burn: float = 1.0,
+                 slo_page_burn: float = 4.0,
+                 canary_interval_ms: float = 0.0,
+                 canary_probe_file: str = "",
+                 canary_k: int = 10):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -350,6 +367,26 @@ class AggregatorContext:
         # race sanitizer (ISSUE 12): [Service] parity with the shard tier
         self.race_sanitizer = race_sanitizer
         self.racesan_sample_rate = racesan_sample_rate
+        # serving timeline + SLO engine + canary (ISSUE 15) — [Service]
+        # parity with the shard tier.  The aggregator has no corpus to
+        # pin ground truth from, so its canary loads probe query lines
+        # from CanaryProbeFile and pins THE FIRST ANSWER as reference
+        # (distance-stability: later drift from the pinned merged top-k
+        # is the silent-degradation signal a merge/topology bug makes).
+        self.timeline_interval_ms = timeline_interval_ms
+        self.timeline_events = timeline_events
+        self.slo_availability_target = slo_availability_target
+        self.slo_p99_ms = slo_p99_ms
+        self.slo_recall_floor = slo_recall_floor
+        self.slo_qps_floor = slo_qps_floor
+        self.slo_budget = slo_budget
+        self.slo_fast_window_s = slo_fast_window_s
+        self.slo_slow_window_s = slo_slow_window_s
+        self.slo_warn_burn = slo_warn_burn
+        self.slo_page_burn = slo_page_burn
+        self.canary_interval_ms = canary_interval_ms
+        self.canary_probe_file = canary_probe_file
+        self.canary_k = canary_k
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -438,6 +475,34 @@ class AggregatorContext:
             ("1", "true", "on", "yes", "strict"),
             racesan_sample_rate=float(reader.get_parameter(
                 "Service", "RaceSanSampleRate", "1")),
+            timeline_interval_ms=float(reader.get_parameter(
+                "Service", "TimelineIntervalMs", "0")),
+            timeline_events=int(reader.get_parameter(
+                "Service", "TimelineEvents", "0")),
+            slo_availability_target=float(reader.get_parameter(
+                "Service", "SloAvailabilityTarget", "0")),
+            slo_p99_ms=float(reader.get_parameter(
+                "Service", "SloP99Ms", "0")),
+            slo_recall_floor=float(reader.get_parameter(
+                "Service", "SloRecallFloor", "0")),
+            slo_qps_floor=float(reader.get_parameter(
+                "Service", "SloQpsFloor", "0")),
+            slo_budget=float(reader.get_parameter(
+                "Service", "SloBudget", "0.05")),
+            slo_fast_window_s=float(reader.get_parameter(
+                "Service", "SloFastWindowS", "60")),
+            slo_slow_window_s=float(reader.get_parameter(
+                "Service", "SloSlowWindowS", "300")),
+            slo_warn_burn=float(reader.get_parameter(
+                "Service", "SloWarnBurn", "1")),
+            slo_page_burn=float(reader.get_parameter(
+                "Service", "SloPageBurn", "4")),
+            canary_interval_ms=float(reader.get_parameter(
+                "Service", "CanaryIntervalMs", "0")),
+            canary_probe_file=reader.get_parameter(
+                "Service", "CanaryProbeFile", ""),
+            canary_k=int(reader.get_parameter(
+                "Service", "CanaryK", "10")),
         )
         if ctx.lock_contention_ledger:
             # arm before any client/connection locks are created (the
@@ -460,6 +525,58 @@ class AggregatorContext:
                 ctx.servers.append(RemoteServer(
                     addr, int(port), replica_group=group or None))
         return ctx
+
+
+# ---------------------------------------------------------------------------
+# cross-host shard-skew telemetry (ISSUE 15): the socket tier's analog
+# of the mesh scheduler's per-shard iteration series — per-backend reply
+# p99 from the existing unregistered latency histograms, published as
+# labeled families so /metrics and the timeline see which shard is the
+# straggler in a fan-out topology (the e2e drill's "skew gauge names
+# the shard" surface)
+# ---------------------------------------------------------------------------
+
+_services: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _backend_skew_families() -> List[metrics.Family]:
+    fams: List[metrics.Family] = []
+    for svc in list(_services):
+        p99 = metrics.Family(
+            "aggregator.backend_p99_ms",
+            help="per-backend reply p99 (the cross-host shard-skew "
+                 "series; the straggler is the max)")
+        rows = []
+        for s in svc.context.servers:
+            if s.latency.count == 0:
+                continue
+            ms = s.latency.percentile(99) * 1000.0
+            rows.append(("%s:%d" % (s.address, s.port), ms))
+            p99.add(round(ms, 3), {"backend": "%s:%d" % (s.address,
+                                                         s.port)})
+        if not rows:
+            continue
+        fams.append(p99)
+        vals = [ms for _b, ms in rows]
+        mean = sum(vals) / len(vals)
+        straggler = max(rows, key=lambda r: r[1])
+        skew = metrics.Family(
+            "aggregator.backend_skew",
+            help="straggler backend's p99 excess over the fleet mean "
+                 "(0 = balanced)")
+        skew.add(round(max(vals) / mean - 1.0, 4) if mean > 0 else 0.0)
+        fams.append(skew)
+        strag = metrics.Family(
+            "aggregator.backend_straggler",
+            help="1 on the backend with the worst reply p99")
+        for b, _ms in rows:
+            strag.add(1 if b == straggler[0] else 0, {"backend": b})
+        fams.append(strag)
+    return fams
+
+
+metrics.register_family_provider("aggregator_skew",
+                                 _backend_skew_families)
 
 
 @locksan.race_track
@@ -488,6 +605,14 @@ class AggregatorService:
         # hedge budget accounting: hedges issued vs fan-out requests seen
         self._fanouts = 0
         self._hedges_issued = 0
+        # connections whose decoded rids identified them as canary
+        # traffic (serve/canary.py): excluded from admission fair-share
+        # accounting from their next request on
+        self._canary_conns: set = set()
+        # serving timeline + SLO engine + canary (ISSUE 15)
+        self._slo: Optional[slo_mod.SloEngine] = None
+        self._canary: Optional[canary_mod.CanaryProber] = None
+        _services.add(self)
 
     def _admission_signals(self) -> dict:
         """Aggregator pressure signals: in-flight fraction of the
@@ -530,6 +655,15 @@ class AggregatorService:
             "aggregator.deadline_drops")
         return out
 
+    def _slo_debug(self) -> dict:
+        """GET /debug/slo payload for this tier (engine + canary)."""
+        out = (self._slo.snapshot() if self._slo is not None
+               else {"enabled": False})
+        out["tier"] = "aggregator"
+        if self._canary is not None:
+            out["canary"] = self._canary.snapshot()
+        return out
+
     async def start(self, host: Optional[str] = None,
                     port: Optional[int] = None):
         if self.context.metrics_port or \
@@ -560,6 +694,23 @@ class AggregatorService:
                 recall_floor=self.context.quality_recall_floor,
                 shadow_budget_gflops=self.context.quality_shadow_budget,
                 window=self.context.quality_window or None)
+        # serving timeline + SLO engine (ISSUE 15): [Service] parity
+        # with the shard tier — declaring any objective arms the
+        # timeline implicitly
+        slo_cfg = slo_mod.config_from_settings(self.context)
+        if self.context.timeline_interval_ms > 0 \
+                or slo_mod.armed(slo_cfg) \
+                or self.context.canary_interval_ms > 0:
+            timeline.configure(
+                enabled=True,
+                interval_ms=(self.context.timeline_interval_ms
+                             if self.context.timeline_interval_ms > 0
+                             else None),
+                capacity=self.context.timeline_events or None)
+            timeline.start()
+        if slo_mod.armed(slo_cfg):
+            self._slo = slo_mod.SloEngine(slo_cfg, tier="aggregator")
+            timeline.add_tick_listener(self._slo.evaluate)
         if self.context.metrics_port:
             # bind first: a metrics-port clash must fail start() before
             # backend connections, the reconnect task, or the listen
@@ -567,7 +718,8 @@ class AggregatorService:
             self._metrics_http = MetricsHttpServer(
                 self.context.metrics_port, health=self._healthz,
                 host=self.context.metrics_host,
-                admission=self._admission_debug)
+                admission=self._admission_debug,
+                slo=self._slo_debug)
             self._metrics_http.start()
         # cross-host demotion advisory (ISSUE 11): with in-mesh serving
         # (parallel/sharded.py + [Service] MeshServe) same-host shards
@@ -595,9 +747,37 @@ class AggregatorService:
                                                   port)
         addr = self._server.sockets[0].getsockname()
         log.info("aggregator listening on %s:%d", addr[0], addr[1])
+        if self.context.canary_interval_ms > 0:
+            # canary on the corpus-less tier (ISSUE 15): probe query
+            # lines from CanaryProbeFile, first answer pinned as the
+            # stability reference; latency/availability feed the SLO
+            # engine either way
+            probes: List[canary_mod.CanaryProbe] = []
+            if self.context.canary_probe_file:
+                try:
+                    probes = canary_mod.probes_from_file(
+                        self.context.canary_probe_file,
+                        k=self.context.canary_k)
+                except OSError:
+                    log.exception("canary probe file unreadable: %s",
+                                  self.context.canary_probe_file)
+            if probes:
+                self._canary = canary_mod.CanaryProber(
+                    addr[0], addr[1], probes,
+                    interval_ms=self.context.canary_interval_ms,
+                    tier="aggregator")
+                self._canary.start()
         return addr[0], addr[1]
 
     async def stop(self) -> None:
+        if self._canary is not None:
+            canary_ref = self._canary
+            self._canary = None
+            await asyncio.get_event_loop().run_in_executor(
+                None, canary_ref.stop)
+        if self._slo is not None:
+            timeline.remove_tick_listener(self._slo.evaluate)
+            self._slo = None
         if self._metrics_http:
             self._metrics_http.shutdown()
             self._metrics_http = None
@@ -749,7 +929,11 @@ class AggregatorService:
                     t0 = time.perf_counter()
                     degraded = False
                     if self._admission is not None:
-                        decision = self._admission.admit("conn-%d" % cid)
+                        # canary isolation: marked at first probe decode
+                        # (below), exempt from fair shares thereafter
+                        decision = self._admission.admit(
+                            "conn-%d" % cid,
+                            canary=cid in self._canary_conns)
                         if decision == admission_mod.SHED:
                             # shed BEFORE the body is decoded or any
                             # backend touched — a distinct status so
@@ -776,6 +960,8 @@ class AggregatorService:
                         body, degraded)
                     if hp:
                         hostprof.clear_stage()
+                    if rid and canary_mod.is_canary_rid(rid):
+                        self._canary_conns.add(cid)
                     if deadline_mono is not None and \
                             time.perf_counter() >= deadline_mono:
                         # budget already spent before any fan-out
@@ -865,6 +1051,7 @@ class AggregatorService:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._canary_conns.discard(cid)
             writer.close()
 
     def _prepare_request(self, body: bytes, degraded: bool = False

@@ -66,7 +66,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from sptag_tpu.utils import (devmem, flightrec, hostprof, locksan, metrics,
-                             qualmon)
+                             qualmon, timeline)
+
+# importing devmem/qualmon/locksan above registered their labeled-series
+# providers with the metrics registry (ISSUE 15 dedupe) — /metrics below
+# renders metrics.render_provider_families() instead of four hand-rolled
+# expositions, and utils/timeline.py samples the same provider surface
 
 log = logging.getLogger(__name__)
 
@@ -101,31 +106,33 @@ def _get_device_trace_lock():
     return lk
 
 
-def publish_flight_gauges() -> None:
-    """Mirror flightrec.counters() into the metrics registry at scrape
-    time — gauges rather than counters because the recorder's numbers
-    reset with configure()/reset() and a Prometheus counter must never
-    go backwards.  Names are literal (GL602)."""
+#: flight-recorder / host-profiler health blocks exposed at scrape time
+#: — gauges rather than counters because both subsystems' numbers reset
+#: with configure()/reset() and a Prometheus counter must never go
+#: backwards.  One provider per subsystem through the shared
+#: labeled-series surface (ISSUE 15: the fourth copy of the hand-rolled
+#: publishing deduped into utils/metrics.py, and the timeline sampler
+#: sees the same families).  Keys are literal and bounded.
+_FLIGHT_KEYS = ("enabled", "recorded", "dropped", "threads",
+                "dump_errors", "dump_ratelimited")
+_HOSTPROF_KEYS = ("enabled", "running", "samples", "overruns",
+                  "folded_overflow")
+
+
+def flight_families() -> List[metrics.Family]:
     c = flightrec.counters()
-    metrics.set_gauge("flight.enabled", c.get("enabled", 0))
-    metrics.set_gauge("flight.recorded", c.get("recorded", 0))
-    metrics.set_gauge("flight.dropped", c.get("dropped", 0))
-    metrics.set_gauge("flight.threads", c.get("threads", 0))
-    metrics.set_gauge("flight.dump_errors", c.get("dump_errors", 0))
-    metrics.set_gauge("flight.dump_ratelimited",
-                      c.get("dump_ratelimited", 0))
+    return [metrics.Family("flight." + key).add(c.get(key, 0))
+            for key in _FLIGHT_KEYS]
 
 
-def publish_hostprof_gauges() -> None:
-    """Host-profiler health counters as gauges at scrape time (the
-    flight-gauge pattern; names literal, GL602)."""
+def hostprof_families() -> List[metrics.Family]:
     c = hostprof.counters()
-    metrics.set_gauge("hostprof.enabled", c.get("enabled", 0))
-    metrics.set_gauge("hostprof.running", c.get("running", 0))
-    metrics.set_gauge("hostprof.samples", c.get("samples", 0))
-    metrics.set_gauge("hostprof.overruns", c.get("overruns", 0))
-    metrics.set_gauge("hostprof.folded_overflow",
-                      c.get("folded_overflow", 0))
+    return [metrics.Family("hostprof." + key).add(c.get(key, 0))
+            for key in _HOSTPROF_KEYS]
+
+
+metrics.register_family_provider("flight", flight_families)
+metrics.register_family_provider("hostprof", hostprof_families)
 
 
 _Route = Callable[[Dict[str, str]], Tuple[bytes, str, int]]
@@ -135,7 +142,8 @@ class MetricsHttpServer:
     def __init__(self, port: int, health: Optional[Callable[[], Dict]] = None,
                  host: str = "127.0.0.1",
                  admission: Optional[Callable[[], Dict]] = None,
-                 mutation: Optional[Callable[[], Dict]] = None):
+                 mutation: Optional[Callable[[], Dict]] = None,
+                 slo: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.health = health
@@ -145,6 +153,9 @@ class MetricsHttpServer:
         # GET /debug/mutation callback (ISSUE 9): per-index swap +
         # durability state (epoch, WAL accounting, delta occupancy)
         self.mutation = mutation
+        # GET /debug/slo callback (serve/slo.py, ISSUE 15): declared
+        # objectives, burn rates and state per objective
+        self.slo = slo
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -158,6 +169,8 @@ class MetricsHttpServer:
             "/debug/mutation": self._route_mutation,
             "/debug/prof": self._route_prof,
             "/debug/devicetrace": self._route_devicetrace,
+            "/debug/timeline": self._route_timeline,
+            "/debug/slo": self._route_slo,
         }
 
     def routes(self) -> List[str]:
@@ -170,16 +183,13 @@ class MetricsHttpServer:
 
     @staticmethod
     def _route_metrics(params: Dict[str, str]) -> Tuple[bytes, str, int]:
-        publish_flight_gauges()
-        publish_hostprof_gauges()
-        # quality windows / memory ledger / lock-contention ledger render
-        # as labeled series the shared registry can't express (the devmem
-        # pattern); each is the empty string when it has nothing, so the
-        # off-path exposition is unchanged
+        # the shared registry plus EVERY registered labeled-series
+        # provider (devmem / qualmon / locksan / flight / hostprof /
+        # slo / mesh-skew …) through the one formatter; an idle
+        # provider renders nothing, so the off-path exposition is
+        # unchanged
         body = (metrics.render_prometheus()
-                + devmem.render_prometheus()
-                + qualmon.render_prometheus()
-                + locksan.render_prometheus()).encode()
+                + metrics.render_provider_families()).encode()
         return body, _PROM, 200
 
     def _route_healthz(self, params: Dict[str, str]
@@ -227,6 +237,35 @@ class MetricsHttpServer:
                      else {"enabled": False})
         except Exception:                                # noqa: BLE001
             log.exception("mutation callback failed")
+            state = {"enabled": False, "error": True}
+        return json.dumps(state).encode(), _JSON, 200
+
+    @staticmethod
+    def _route_timeline(params: Dict[str, str]) -> Tuple[bytes, str, int]:
+        """GET /debug/timeline — the in-process time-series store
+        (utils/timeline.py, ISSUE 15).  ``?window_s=`` bounds the
+        returned points to the trailing window; ``?series=`` filters
+        series by substring; ``?coarse=1`` returns the downsampled
+        long-horizon rings instead of the fine ones."""
+        window_s = None
+        if params.get("window_s"):
+            try:
+                window_s = float(params["window_s"])
+            except ValueError:
+                return (b'{"error": "window_s must be a number"}\n',
+                        _JSON, 400)
+        snap = timeline.snapshot(
+            window_s=window_s,
+            series_filter=params.get("series") or None,
+            coarse=params.get("coarse", "") in ("1", "true", "yes"))
+        return json.dumps(snap).encode(), _JSON, 200
+
+    def _route_slo(self, params: Dict[str, str]
+                   ) -> Tuple[bytes, str, int]:
+        try:
+            state = self.slo() if self.slo else {"enabled": False}
+        except Exception:                                # noqa: BLE001
+            log.exception("slo callback failed")
             state = {"enabled": False, "error": True}
         return json.dumps(state).encode(), _JSON, 200
 

@@ -23,11 +23,13 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from sptag_tpu.serve import admission as admission_mod
+from sptag_tpu.serve import canary as canary_mod
 from sptag_tpu.serve import protocol, wire
+from sptag_tpu.serve import slo as slo_mod
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
 from sptag_tpu.serve.service import SearchExecutor, ServiceContext
 from sptag_tpu.utils import (faultinject, flightrec, hostprof, locksan,
-                             metrics, qualmon, trace)
+                             metrics, qualmon, timeline, trace)
 
 log = logging.getLogger(__name__)
 
@@ -55,7 +57,10 @@ class SearchServer:
                  fault_spec: Optional[str] = None,
                  fault_seed: Optional[int] = None,
                  host_prof_hz: Optional[float] = None,
-                 host_prof_dump_on_slow_query: Optional[bool] = None):
+                 host_prof_dump_on_slow_query: Optional[bool] = None,
+                 timeline_interval_ms: Optional[float] = None,
+                 canary_interval_ms: Optional[float] = None,
+                 slo_config: Optional[slo_mod.SloConfig] = None):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -146,6 +151,26 @@ class SearchServer:
             host_prof_dump_on_slow_query
             if host_prof_dump_on_slow_query is not None
             else context.settings.host_prof_dump_on_slow_query)
+        # serving timeline + SLO engine + canary prober (ISSUE 15): all
+        # process-wide-off by default; ctor overrides are the test
+        # surface, [Service] TimelineIntervalMs/Slo*/Canary* the
+        # deployment one
+        self.timeline_interval_ms = (
+            timeline_interval_ms if timeline_interval_ms is not None
+            else context.settings.timeline_interval_ms)
+        self.canary_interval_ms = (
+            canary_interval_ms if canary_interval_ms is not None
+            else context.settings.canary_interval_ms)
+        self._slo_config = (slo_config if slo_config is not None
+                            else slo_mod.config_from_settings(
+                                context.settings))
+        self._slo: Optional[slo_mod.SloEngine] = None
+        self._canary: Optional[canary_mod.CanaryProber] = None
+        # connections whose decoded rids identified them as canary
+        # traffic: excluded from admission fair shares from their next
+        # request on (the canary keeps one persistent connection, so
+        # only its very first probe is share-charged)
+        self._canary_cids: set = set()
         # default per-request deadline (requests carrying their own —
         # wire trailer or $deadlinems text option — keep it)
         self.deadline_ms = context.settings.deadline_ms
@@ -245,6 +270,22 @@ class SearchServer:
             for name, index in self.context.indexes.items():
                 if hasattr(index, "publish_quality_health"):
                     index.publish_quality_health(shard=name)
+        # serving timeline + SLO engine (ISSUE 15): the SLO engine
+        # needs history, so declaring any objective arms the timeline
+        # implicitly at the default cadence
+        slo_armed = slo_mod.armed(self._slo_config)
+        if self.timeline_interval_ms > 0 or slo_armed \
+                or self.canary_interval_ms > 0:
+            timeline.configure(
+                enabled=True,
+                interval_ms=(self.timeline_interval_ms
+                             if self.timeline_interval_ms > 0 else None),
+                capacity=self.context.settings.timeline_events or None)
+            timeline.start()
+        if slo_armed:
+            self._slo = slo_mod.SloEngine(self._slo_config,
+                                          tier=self.flight_tier)
+            timeline.add_tick_listener(self._slo.evaluate)
         if self.metrics_port:
             # bind the metrics listener FIRST: an EADDRINUSE here must
             # fail start() before the serve socket accepts or the batcher
@@ -253,15 +294,38 @@ class SearchServer:
                 self.metrics_port, health=self._healthz,
                 host=self.context.settings.metrics_host,
                 admission=self._admission_debug,
-                mutation=self._mutation_debug)
+                mutation=self._mutation_debug,
+                slo=self._slo_debug)
             self._metrics_http.start()
         self._server = await asyncio.start_server(self._on_client, host, port)
         self._batcher_task = asyncio.create_task(self._batcher())
         addr = self._server.sockets[0].getsockname()
         log.info("search server listening on %s:%d", addr[0], addr[1])
+        if self.canary_interval_ms > 0:
+            # ground-truth canary (serve/canary.py): probes pinned via
+            # the oracle at (re)start, replayed through THIS server's
+            # own socket — armed after the listen socket exists
+            probes = canary_mod.probes_from_context(
+                self.context, count=self.context.settings.canary_probes,
+                k=self.context.settings.canary_k)
+            self._canary = canary_mod.CanaryProber(
+                addr[0], addr[1], probes,
+                interval_ms=self.canary_interval_ms,
+                tier=self.flight_tier)
+            self._canary.start()
         return addr[0], addr[1]
 
     async def stop(self) -> None:
+        if self._canary is not None:
+            # run the (blocking, up-to-join-timeout) prober shutdown off
+            # the loop thread
+            canary_ref = self._canary
+            self._canary = None
+            await asyncio.get_event_loop().run_in_executor(
+                None, canary_ref.stop)
+        if self._slo is not None:
+            timeline.remove_tick_listener(self._slo.evaluate)
+            self._slo = None
         if self._metrics_http:
             self._metrics_http.shutdown()
             self._metrics_http = None
@@ -308,6 +372,17 @@ class SearchServer:
                               else {"enabled": False})
         out["deadline_drops"] = metrics.counter_value(
             "server.deadline_drops")
+        return out
+
+    def _slo_debug(self) -> dict:
+        """GET /debug/slo payload: the burn-rate engine's objectives
+        plus the canary prober's per-index picture (one page tells the
+        whole judgement story)."""
+        out = (self._slo.snapshot() if self._slo is not None
+               else {"enabled": False})
+        out["tier"] = self.flight_tier
+        if self._canary is not None:
+            out["canary"] = self._canary.snapshot()
         return out
 
     def _mutation_debug(self) -> dict:
@@ -373,6 +448,7 @@ class SearchServer:
             log.exception("cid %d: malformed packet; closing", cid)
         finally:
             self._conns.pop(cid, None)
+            self._canary_cids.discard(cid)
             metrics.set_gauge("server.connections", len(self._conns))
             writer.close()
 
@@ -433,7 +509,12 @@ class SearchServer:
             rec = flightrec.enabled()
             degraded = False
             if self.admission is not None:
-                decision = self.admission.admit(str(cid))
+                # canary isolation (ISSUE 15): admission runs pre-decode
+                # keyed by connection, so canary connections are marked
+                # at their first probe's decode (below) and exempted
+                # from fair-share accounting from then on
+                decision = self.admission.admit(
+                    str(cid), canary=cid in self._canary_cids)
                 if decision == admission_mod.SHED:
                     # reject at the socket edge with a DISTINCT status
                     # BEFORE decode cost is paid — under overload, body
@@ -474,6 +555,10 @@ class SearchServer:
                 # it rides into every log line and response — bound it
                 # like the text channel does
                 query.request_id = query.request_id[:64]
+            if query is not None and query.request_id \
+                    and canary_mod.is_canary_rid(query.request_id) \
+                    and cid not in self._canary_cids:
+                self._canary_cids.add(cid)
             if rec:
                 flightrec.record(
                     self.flight_tier, "decode",
@@ -819,8 +904,13 @@ class SearchServer:
         # the wire — the shadow path never touches serve latency or
         # bytes.  Off = this one flag test; on, the deterministic rate
         # gate picks 1-in-N responses for background exact replay.
+        # canary probes are EXCLUDED from the live quality windows
+        # (they publish their own exact recall; double-counting the
+        # probe set as "live" samples would bias the Wilson window —
+        # the ISSUE 15 isolation contract)
         if qualmon.enabled() and query is not None \
                 and result.status == wire.ResultStatus.Success \
+                and not canary_mod.is_canary_rid(rid) \
                 and qualmon.maybe_sample():
             self._queue_quality_sample(rid, query.query, result)
 

@@ -188,6 +188,37 @@ class ServiceSettings:
     mesh_serve: bool = False
     mesh_serve_slots: int = 0
     mesh_serve_segment_iters: int = 0
+    # serving timeline (utils/timeline.py, ISSUE 15): >0 arms the
+    # in-process time-series sampler at this interval — the metrics
+    # registry + every labeled-series family snapshotted into bounded
+    # rings, served on GET /debug/timeline.  0 (default): no sampler
+    # thread, serve bytes byte-identical.  TimelineEvents sizes the
+    # fine ring (0 = module default 512 samples/series).
+    timeline_interval_ms: float = 0.0
+    timeline_events: int = 0
+    # SLO burn-rate engine (serve/slo.py): declared objectives judged
+    # over the timeline with multi-window burn rates.  Each objective
+    # is off at 0; declaring ANY arms the engine (and the timeline, if
+    # not already armed).  SloBudget is the tolerated violating-sample
+    # fraction for the threshold objectives (latency/recall/qps).
+    slo_availability_target: float = 0.0
+    slo_p99_ms: float = 0.0
+    slo_recall_floor: float = 0.0
+    slo_qps_floor: float = 0.0
+    slo_budget: float = 0.05
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_warn_burn: float = 1.0
+    slo_page_burn: float = 4.0
+    # ground-truth canary prober (serve/canary.py): >0 arms a
+    # background worker replaying oracle-pinned probe queries through
+    # the FULL serve path (loopback client) every this-many ms, feeding
+    # e2e latency + exact recall into the timeline/SLO engine.
+    # CanaryProbes bounds the probe set per index; CanaryK is the
+    # probes' top-k.  0 (default): no probes, no thread.
+    canary_interval_ms: float = 0.0
+    canary_probes: int = 8
+    canary_k: int = 10
 
 
 class ServiceContext:
@@ -299,6 +330,34 @@ class ServiceContext:
                 "Service", "MeshServeSlots", "0")),
             mesh_serve_segment_iters=int(reader.get_parameter(
                 "Service", "MeshServeSegmentIters", "0")),
+            timeline_interval_ms=float(reader.get_parameter(
+                "Service", "TimelineIntervalMs", "0")),
+            timeline_events=int(reader.get_parameter(
+                "Service", "TimelineEvents", "0")),
+            slo_availability_target=float(reader.get_parameter(
+                "Service", "SloAvailabilityTarget", "0")),
+            slo_p99_ms=float(reader.get_parameter(
+                "Service", "SloP99Ms", "0")),
+            slo_recall_floor=float(reader.get_parameter(
+                "Service", "SloRecallFloor", "0")),
+            slo_qps_floor=float(reader.get_parameter(
+                "Service", "SloQpsFloor", "0")),
+            slo_budget=float(reader.get_parameter(
+                "Service", "SloBudget", "0.05")),
+            slo_fast_window_s=float(reader.get_parameter(
+                "Service", "SloFastWindowS", "60")),
+            slo_slow_window_s=float(reader.get_parameter(
+                "Service", "SloSlowWindowS", "300")),
+            slo_warn_burn=float(reader.get_parameter(
+                "Service", "SloWarnBurn", "1")),
+            slo_page_burn=float(reader.get_parameter(
+                "Service", "SloPageBurn", "4")),
+            canary_interval_ms=float(reader.get_parameter(
+                "Service", "CanaryIntervalMs", "0")),
+            canary_probes=int(reader.get_parameter(
+                "Service", "CanaryProbes", "8")),
+            canary_k=int(reader.get_parameter(
+                "Service", "CanaryK", "10")),
         )
         if s.lock_sanitizer:
             # before the indexes load: their writer locks must be created

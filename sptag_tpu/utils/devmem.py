@@ -38,6 +38,8 @@ import threading
 import weakref
 from typing import Dict, Optional
 
+from sptag_tpu.utils import metrics
+
 # RLock, not Lock: weakref.finalize callbacks (_drop_key) can fire from
 # an implicit GC pass triggered INSIDE track()/untrack()/reset() while
 # this same thread already holds the lock — a non-reentrant lock would
@@ -173,28 +175,38 @@ def snapshot(with_live_arrays: bool = True) -> dict:
     return out
 
 
-def render_prometheus(prefix: str = "sptag_tpu") -> str:
-    """``memory.device_bytes{component=…}`` gauge lines in Prometheus
-    text format — appended to the registry exposition by
-    serve/metrics_http.py (the shared registry has no label support;
-    the component label is the whole point here)."""
+def families() -> list:
+    """The ledger as labeled metric families (utils/metrics.py Family)
+    — THE one surface both the /metrics exposition and the timeline
+    sampler consume (ISSUE 15).  The `_ledger` total is DEVICE bytes
+    only, so it agrees with /debug/memory's ledger_device_bytes (and
+    may be compared against HBM capacity); host-resident entries get
+    their own total."""
     comp = component_bytes()
     dev = device_bytes()
-    m = f"{prefix}_memory_device_bytes"
-    lines = [f"# HELP {m} per-component resident bytes; host-side "
-             "components (slot_pool) are included here but excluded "
-             f"from {m}_ledger",
-             f"# TYPE {m} gauge"]
+    fam = metrics.Family(
+        "memory.device_bytes",
+        help="per-component resident bytes; host-side components "
+             "(slot_pool) are included here but excluded from the "
+             "_ledger total")
     for component, nbytes in comp.items():
-        lines.append(f'{m}{{component="{component}"}} {nbytes}')
-    # the _ledger total is DEVICE bytes only, so it agrees with
-    # /debug/memory's ledger_device_bytes (and may be compared against
-    # HBM capacity); host-resident entries get their own total
-    lines.append(f"# TYPE {m}_ledger gauge")
-    lines.append(f"{m}_ledger {dev}")
-    lines.append(f"# TYPE {m}_host gauge")
-    lines.append(f"{m}_host {sum(comp.values()) - dev}")
-    return "\n".join(lines) + "\n"
+        fam.add(nbytes, {"component": component})
+    # the totals render unconditionally (0 with nothing tracked) — the
+    # historical exposition always carried them, and dashboards keyed
+    # on the gauge's presence must not see it vanish on an idle process
+    return [fam,
+            metrics.Family("memory.device_bytes_ledger").add(dev),
+            metrics.Family("memory.device_bytes_host")
+            .add(sum(comp.values()) - dev)]
+
+
+def render_prometheus(prefix: str = "sptag_tpu") -> str:
+    """``memory.device_bytes{component=…}`` gauge lines in Prometheus
+    text format — the families above through the shared formatter."""
+    return metrics.render_families(families(), prefix)
+
+
+metrics.register_family_provider("devmem", families)
 
 
 def reset() -> None:

@@ -401,17 +401,17 @@ def contention_snapshot() -> Dict[str, Dict[str, float]]:
     return out
 
 
-def render_prometheus() -> str:
-    """Self-rendered labeled series for the /metrics exposition (the
-    devmem/qualmon pattern — the shared registry has no labels):
-    ``lock_wait_ms{name=}`` / ``lock_wait_ms_max`` / ``lock_hold_ms`` /
-    ``lock_acquires`` / ``lock_contended``.  Empty string when the
-    ledger is off or has seen nothing, so the default exposition is
-    unchanged."""
+def contention_families() -> List[metrics.Family]:
+    """The contention ledger as labeled metric families (utils/
+    metrics.py Family, ISSUE 15): ``lock_wait_ms{name=}`` /
+    ``lock_wait_ms_max`` / ``lock_hold_ms`` / ``lock_hold_ms_max`` /
+    ``lock_acquires`` / ``lock_contended``.  Bare names
+    (``prefix=""``) — the ledger's historical exposition shape.  Empty
+    when the ledger is off or has seen nothing, so the default
+    exposition is unchanged."""
     snap = contention_snapshot()
     if not snap:
-        return ""
-    lines: List[str] = []
+        return []
     series = (("lock_wait_ms", "wait_ms",
                "total milliseconds threads waited to acquire the lock"),
               ("lock_wait_ms_max", "wait_ms_max",
@@ -423,13 +423,22 @@ def render_prometheus() -> str:
               ("lock_acquires", "acquires", "total acquisitions"),
               ("lock_contended", "contended",
                "acquisitions that found the lock already held"))
+    fams: List[metrics.Family] = []
     for metric, key, help_text in series:
-        lines.append(f"# HELP {metric} {help_text}")
-        lines.append(f"# TYPE {metric} gauge")
+        fam = metrics.Family(metric, help=help_text, prefix="")
         for name in sorted(snap):
-            label = name.replace("\\", "\\\\").replace('"', '\\"')
-            lines.append(f'{metric}{{name="{label}"}} {snap[name][key]}')
-    return "\n".join(lines) + "\n"
+            fam.add(snap[name][key], {"name": name})
+        fams.append(fam)
+    return fams
+
+
+def render_prometheus() -> str:
+    """Self-rendered labeled series for the /metrics exposition — the
+    families above through the shared formatter."""
+    return metrics.render_families(contention_families())
+
+
+metrics.register_family_provider("locksan", contention_families)
 
 
 def reset_contention() -> None:

@@ -36,7 +36,9 @@ import contextvars
 import logging
 import re
 import threading
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 #: histogram bucket growth factor — ~1.3 per bucket bounds any quantile
 #: estimate to within one bucket (≤ 30% relative error) at ~85 buckets
@@ -309,6 +311,142 @@ def render_prometheus(prefix: str = "sptag_tpu") -> str:
         lines.append(f"{m}_sum {_fmt(h.sum)}")
         lines.append(f"{m}_count {h.count}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# labeled series: THE one exposition helper + provider registry
+# ---------------------------------------------------------------------------
+#
+# The shared registry above deliberately has no label support (GL6xx
+# keeps its cardinality bounded by literal names).  Subsystems whose
+# series ARE labeled — the device-memory ledger's per-component bytes,
+# the quality windows' (mode, shard) gauges, the lock-contention
+# ledger's per-lock counters, the flight/hostprof health blocks — used
+# to each carry a private copy of the Prometheus text-formatting rules
+# (one TYPE line per name or the parser rejects the whole scrape, label
+# escaping, counter `_total` suffixes).  `Family` + `render_families`
+# is that logic exactly once, and `register_family_provider` is the
+# discovery surface: serve/metrics_http.py renders every registered
+# provider into /metrics, and utils/timeline.py samples the SAME
+# provider output into its time-series rings — one unified surface, two
+# consumers (ISSUE 15 satellite).
+
+
+class Family:
+    """One labeled metric family: a metric name, its TYPE, optional
+    HELP, and `samples` = [(labels_dict_or_None, value), ...].  A None
+    (or empty) labels dict renders the unlabeled aggregate sample.
+    `prefix=None` uses the renderer's default; the contention ledger
+    passes `prefix=""` to keep its historical bare `lock_*` names."""
+
+    __slots__ = ("name", "kind", "help", "samples", "prefix")
+
+    def __init__(self, name: str, kind: str = "gauge",
+                 help: str = "",                          # noqa: A002
+                 samples: Optional[List[Tuple[Optional[Dict[str, str]],
+                                              float]]] = None,
+                 prefix: Optional[str] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.samples = samples if samples is not None else []
+        self.prefix = prefix
+
+    def add(self, value: float,
+            labels: Optional[Dict[str, str]] = None) -> "Family":
+        self.samples.append((labels, value))
+        return self
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_labels(labels: Optional[Dict[str, str]]) -> str:
+    """`{k="v",...}` in insertion order with Prometheus escaping; the
+    empty string for the unlabeled sample."""
+    if not labels:
+        return ""
+    inner = ",".join('%s="%s"' % (k, _escape_label(v))
+                     for k, v in labels.items())
+    return "{%s}" % inner
+
+
+def render_families(families: List[Family], prefix: str = "sptag_tpu"
+                    ) -> str:
+    """Prometheus text exposition for labeled families: ONE TYPE line
+    per metric name with every label set under it (a second TYPE line
+    for the same name is an invalid exposition and Prometheus rejects
+    the WHOLE scrape), HELP when provided, counters suffixed `_total`.
+    `prefix=""` renders bare names (the lock-contention ledger's
+    historical shape).  Empty families render nothing, so an idle
+    subsystem leaves the exposition byte-identical.
+
+    Same-name families MERGE into one group before rendering: multi-
+    instance providers (two SLO engines — one per tier — in one
+    process, several canary probers) each return their own Family for
+    the same metric, and emitting a TYPE line per instance would be
+    exactly the invalid exposition this helper exists to prevent."""
+    merged: List[Family] = []
+    by_key: Dict[tuple, Family] = {}
+    for fam in families:
+        if not fam.samples:
+            continue
+        key = (fam.name, fam.kind, fam.prefix)
+        prior = by_key.get(key)
+        if prior is None:
+            prior = Family(fam.name, fam.kind, fam.help, prefix=fam.prefix)
+            by_key[key] = prior
+            merged.append(prior)
+        prior.help = prior.help or fam.help
+        prior.samples.extend(fam.samples)
+    lines: List[str] = []
+    for fam in merged:
+        p = fam.prefix if fam.prefix is not None else prefix
+        m = _metric_name(p, fam.name) if p else _NAME_RE.sub("_", fam.name)
+        if fam.kind == "counter":
+            m += "_total"
+        if fam.help:
+            lines.append(f"# HELP {m} {fam.help}")
+        lines.append(f"# TYPE {m} {fam.kind}")
+        for labels, value in fam.samples:
+            lines.append(f"{m}{format_labels(labels)} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: key -> zero-arg callable returning List[Family].  Structural (which
+#: subsystems exist), not statistical — reset() leaves it alone; each
+#: provider renders empty when its subsystem has nothing.
+_family_providers: Dict[str, Callable[[], List[Family]]] = {}
+
+
+def register_family_provider(key: str,
+                             fn: Callable[[], List[Family]]) -> None:
+    """Idempotent by key (module re-import replaces, never duplicates)."""
+    with _reg_lock:
+        _family_providers[key] = fn
+
+
+def collect_families() -> List[Family]:
+    """Every registered provider's families, provider-key order.  A
+    broken provider is skipped (logged) — one subsystem must never
+    break the scrape or the timeline sampler."""
+    with _reg_lock:
+        providers = sorted(_family_providers.items())
+    out: List[Family] = []
+    for key, fn in providers:
+        try:
+            out.extend(fn() or [])
+        except Exception:                                # noqa: BLE001
+            log.exception("family provider %s failed", key)
+    return out
+
+
+def render_provider_families(prefix: str = "sptag_tpu") -> str:
+    """The /metrics tail: every provider family rendered through the
+    one formatter (per-family prefix overrides honored)."""
+    return render_families(collect_families(), prefix)
 
 
 # ---------------------------------------------------------------------------

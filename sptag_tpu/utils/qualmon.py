@@ -56,7 +56,6 @@ import collections
 import logging
 import math
 import queue
-import re
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -77,6 +76,12 @@ DEFAULT_QUEUE_CAP = 64
 #: definition — a few-ULP spread between heterogeneous backends scoring
 #: the same vector (the merge_top_k rel_tol rationale)
 DEFAULT_DIST_TOL = 1e-5
+
+#: per-query shard iteration imbalance (max/mean over the mesh's shard
+#: axis) at or above which a budget-exhausted low-recall sample is
+#: triaged ``shard_skew`` instead of ``beam_budget`` — the straggler
+#: shard, not the budget knob, is the root cause (ISSUE 15)
+SHARD_SKEW_IMBALANCE = 1.5
 
 _lock = threading.Lock()
 _sample_rate = 0.0
@@ -532,6 +537,18 @@ def classify_low_recall(rid: str, mode: str,
         if mode in ("beam", "auto") else {}
     it = st.get("iters")
     budget = st.get("t_budget")
+    # mesh shard skew (ISSUE 15): the mesh scheduler stamps per-query
+    # per-shard iteration counters at retire — when one shard's walk
+    # ran far past the mesh mean AND the query still exhausted its
+    # budget, the straggler shard (an unbalanced slice, a slow chip)
+    # explains the loss better than the budget knob does
+    imb = st.get("shard_imbalance")
+    if imb is not None and imb >= SHARD_SKEW_IMBALANCE \
+            and it is not None and budget and it >= budget:
+        return ("shard_skew",
+                "straggler shard %s ran %.2fx the mesh mean iters "
+                "(it=%d budget=%d)" % (st.get("slow_shard", "?"), imb,
+                                       it, budget))
     if it is not None and budget and it >= budget:
         return ("beam_budget",
                 "beam terminated early: it=%d budget=%d" % (it, budget))
@@ -690,53 +707,51 @@ def snapshot() -> dict:
             "gauges": gauges, "quality_counters": cnts}
 
 
-_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
-
-
-def render_prometheus(prefix: str = "sptag_tpu") -> str:
-    """Labeled quality series in Prometheus text format, appended to the
-    registry exposition by serve/metrics_http.py (the devmem pattern —
-    the shared registry has no label support and the mode/shard labels
-    are the point here).  Empty string when nothing was ever recorded,
+def families() -> List[metrics.Family]:
+    """The quality exposition as labeled metric families (utils/
+    metrics.py Family, ISSUE 15): the (mode, shard) recall windows with
+    the unlabeled all-windows aggregate, the literal-name gauges
+    grouped one family per name (a second TYPE line for the same name
+    is an invalid exposition and Prometheus' parser rejects the WHOLE
+    scrape), and the counters.  Empty when nothing was ever recorded,
     so the off-path exposition is byte-identical."""
-    lines: List[str] = []
+    fams: List[metrics.Family] = []
     ws = window_stats()
     if ws:
-        m = f"{prefix}_quality_recall_at_k"
         agg = aggregate_stats()
-        # one group per metric name: TYPE once, every label set under
-        # it, the unlabeled sample carrying the all-windows aggregate
         for suffix, field, aggval in (
                 ("", "recall", agg["recall"]), ("_lo", "lo", agg["lo"]),
                 ("_hi", "hi", agg["hi"]),
                 ("_samples", "samples", None)):
-            lines.append(f"# TYPE {m}{suffix} gauge")
+            fam = metrics.Family("quality.recall_at_k" + suffix)
             for st in ws.values():
-                lbl = '{mode="%s",shard="%s"}' % (st["mode"], st["shard"])
-                lines.append(f"{m}{suffix}{lbl} {st[field]}")
+                fam.add(st[field], {"mode": st["mode"],
+                                    "shard": st["shard"]})
             if aggval is not None:
-                lines.append(f"{m}{suffix} {aggval}")
+                fam.add(aggval)
+            fams.append(fam)
     with _lock:
         gauges = sorted(_gauges.items())
         cnts = sorted(_counters.items())
-    # ONE TYPE line per metric name, then every label set under it: a
-    # second TYPE line for the same name is an invalid exposition and
-    # Prometheus' parser rejects the WHOLE scrape (every metric, not
-    # just quality) — with two shards publishing the same health gauge
-    # the per-entry form did exactly that
     by_name: Dict[str, List[Tuple[str, str, float]]] = {}
     for (name, mode, shard), value in gauges:
         by_name.setdefault(name, []).append((mode, shard, value))
     for name, entries in sorted(by_name.items()):
-        m = f"{prefix}_quality_{_NAME_RE.sub('_', name)}"
-        lines.append(f"# TYPE {m} gauge")
+        fam = metrics.Family("quality." + name)
         for mode, shard, value in entries:
-            lbl = ""
-            if mode or shard:
-                lbl = '{mode="%s",shard="%s"}' % (mode, shard)
-            lines.append(f"{m}{lbl} {value}")
+            fam.add(value, {"mode": mode, "shard": shard}
+                    if (mode or shard) else None)
+        fams.append(fam)
     for name, value in cnts:
-        m = f"{prefix}_quality_{_NAME_RE.sub('_', name)}_total"
-        lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {value}")
-    return "\n".join(lines) + ("\n" if lines else "")
+        fams.append(metrics.Family("quality." + name,
+                                   kind="counter").add(value))
+    return fams
+
+
+def render_prometheus(prefix: str = "sptag_tpu") -> str:
+    """Labeled quality series in Prometheus text format — the families
+    above through the shared formatter (the devmem pattern)."""
+    return metrics.render_families(families(), prefix)
+
+
+metrics.register_family_provider("qualmon", families)

@@ -146,8 +146,10 @@ def _reset_telemetry_registries():
     registries — all are process-global, so without this a span/counter/
     event assertion in one test would see every earlier test's serving
     traffic (and the suite's pass/fail would depend on execution order)."""
+    from sptag_tpu.algo import scheduler
     from sptag_tpu.utils import (devmem, faultinject, flightrec, hostprof,
-                                 locksan, metrics, qualmon, trace)
+                                 locksan, metrics, qualmon, timeline,
+                                 trace)
 
     trace.reset()
     metrics.reset()
@@ -156,6 +158,8 @@ def _reset_telemetry_registries():
     qualmon.reset()
     faultinject.reset()
     hostprof.reset()
+    timeline.reset()
+    scheduler.reset_shard_skew()
     locksan.reset_contention()
     locksan.reset_racesan()
     yield

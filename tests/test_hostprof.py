@@ -330,8 +330,8 @@ def test_debug_devicetrace_bounded(standalone_http, tmp_path):
 
 EXPECTED_ROUTES = ["/debug/admission", "/debug/devicetrace",
                    "/debug/flight", "/debug/memory", "/debug/mutation",
-                   "/debug/prof", "/debug/quality", "/healthz",
-                   "/metrics"]
+                   "/debug/prof", "/debug/quality", "/debug/slo",
+                   "/debug/timeline", "/healthz", "/metrics"]
 
 
 @pytest.fixture(scope="module")

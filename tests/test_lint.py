@@ -684,6 +684,75 @@ def test_gl606_out_of_family_qualmon_calls_clean():
 
 
 # ---------------------------------------------------------------------------
+# GL608 timeline-series names (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_gl608_dynamic_timeline_name_flagged():
+    """Timeline series names are the cardinality-bounded surface
+    (ISSUE 15): the store keys fixed-size rings off them and never
+    expires one — f-strings, concatenation and per-call variables are
+    flagged like GL601/602/603/606/607."""
+    src = (
+        "from sptag_tpu.utils import timeline\n"
+        "def publish(objective, value):\n"
+        "    timeline.record(f'slo.{objective}', value)\n"
+        "def feed(series, value):\n"
+        "    timeline.record(series, value)\n"
+    )
+    found = lint_one(src, select=["GL608"])
+    assert rules_of(found) == ["GL608"]
+    assert len(found) == 2
+    assert "string literal" in found[0].message
+
+
+def test_gl608_literal_name_and_dynamic_label_clean():
+    """Literal / module-constant names pass; the `label` argument is
+    out of scope (deployment-bounded — the qualmon shard-label
+    rationale), as are keyword/from-import forms and the read-path
+    calls that only LOOK UP series."""
+    src = (
+        "from sptag_tpu.utils import timeline\n"
+        "from sptag_tpu.utils.timeline import record\n"
+        "SERIES = 'canary.latency_ms'\n"
+        "def publish(idx_label, value, name):\n"
+        "    timeline.record('canary.recall', value, label=idx_label)\n"
+        "    timeline.record(SERIES, value)\n"
+        "    record(name='canary.ok', value=value)\n"
+        "    timeline.window_values(name, 60.0)\n"
+        "    timeline.latest(name)\n"
+    )
+    assert lint_one(src, select=["GL608"]) == []
+    dirty = (
+        "from sptag_tpu.utils.timeline import record\n"
+        "def publish(name, value):\n"
+        "    record(name, value)\n"
+    )
+    assert rules_of(lint_one(dirty, select=["GL608"])) == ["GL608"]
+
+
+def test_issue15_timeline_slo_canary_names_are_literals():
+    """ISSUE 15 CI satellite: GL601/602/603/608 coverage extends to the
+    timeline store, the SLO engine, the canary prober and the skew
+    publishers, with NO new baseline entries (the files lint clean with
+    no baseline applied at all)."""
+    paths = [
+        "sptag_tpu/utils/timeline.py",
+        "sptag_tpu/serve/slo.py",
+        "sptag_tpu/serve/canary.py",
+        "sptag_tpu/serve/metrics_http.py",
+        "sptag_tpu/algo/scheduler.py",
+        "sptag_tpu/serve/aggregator.py",
+    ]
+    srcs = {}
+    for p in paths:
+        with open(os.path.join(REPO, p), encoding="utf-8") as fh:
+            srcs[p] = fh.read()
+    found = lint_sources(srcs, select=["GL601", "GL602", "GL603",
+                                       "GL608"])
+    assert found == [], "\n".join(f.format() for f in found)
+
+
+# ---------------------------------------------------------------------------
 # GL605 cost-ledger coverage (ISSUE 6)
 # ---------------------------------------------------------------------------
 

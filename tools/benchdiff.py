@@ -103,6 +103,13 @@ METRICS: List[Metric] = [
     Metric("loadgen.qps_at_slo", HIGHER, 0.20, 16.0),
     Metric("loadgen.p50_ms", LOWER, 0.20, 5.0),
     Metric("loadgen.p99_ms", LOWER, 0.20, 10.0),
+    # ground-truth canary lines (ISSUE 15): exact recall vs the pinned
+    # oracle truth is platform-independent — the canary answering worse
+    # is a correctness regression whatever host measured it; canary p99
+    # is the full-serve-path latency at probe (near-idle) load
+    Metric("loadgen.canary_recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
+    Metric("loadgen.canary_p99_ms", LOWER, 0.25, 10.0),
     # mutation-under-load stage (ISSUE 9)
     Metric("mutate.read_qps", HIGHER, 0.20, 25.0),
     Metric("mutate.p99_steady_ms", LOWER, 0.25, 10.0),

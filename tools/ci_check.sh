@@ -179,6 +179,21 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_cascade.py -q \
 echo "== GL605 cascade kernel coverage (standalone) =="
 python -m tools.graftlint sptag_tpu/ --select GL605
 
+# the ISSUE 15 observability gate, standalone: with the serving
+# timeline, SLO engine and canary prober at their defaults (all off)
+# the serve tier's wire bytes stay byte-identical, no sampler/prober
+# thread exists and the timeline counters read zero
+echo "== timeline/SLO/canary off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_timeline.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 15 lint gate, standalone: timeline/SLO/canary series names
+# are string literals (GL608, the GL6xx cardinality family) with ZERO
+# baseline entries — a dynamic series name would grow the bounded
+# time-series store without limit
+echo "== GL608 timeline-series name lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL608
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

@@ -38,6 +38,14 @@ Rules:
   are a bounded taxonomy (decode/queue/execute/encode/merge) by the
   same cardinality argument.  The `rid` argument is out of scope —
   rid attribution is a bounded LRU by design.
+* GL608 — the name argument of a timeline series record
+  (`timeline.record(name, value, ...)`) is not a string literal or
+  module-level string constant: the time-series store keys fixed-size
+  rings off the name and never expires one, so the series taxonomy
+  (timeline/SLO/canary series alike — the SLO engine and canary
+  prober both publish through this call) must be bounded.  The
+  `label` argument is out of scope — labels are deployment-bounded
+  (index names, objective names), the qualmon shard-label rationale.
 
 Calls are resolved through import aliases (`from sptag_tpu.utils import
 trace` / `import sptag_tpu.utils.metrics as metrics` / from-imports of the
@@ -64,6 +72,8 @@ RULES = {
              "dynamic names make the quality exposition unbounded",
     "GL607": "host-profiler stage name is not a string literal — "
              "dynamic stages make the folded-stack taxonomy unbounded",
+    "GL608": "timeline series name is not a string literal — dynamic "
+             "names make the time-series store unbounded",
 }
 
 _TRACE_MODULE = "sptag_tpu.utils.trace"
@@ -71,6 +81,7 @@ _METRICS_MODULE = "sptag_tpu.utils.metrics"
 _FLIGHT_MODULE = "sptag_tpu.utils.flightrec"
 _QUALMON_MODULE = "sptag_tpu.utils.qualmon"
 _HOSTPROF_MODULE = "sptag_tpu.utils.hostprof"
+_TIMELINE_MODULE = "sptag_tpu.utils.timeline"
 
 _TRACE_FNS = {"span", "record"}
 _METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
@@ -78,12 +89,13 @@ _METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
 _FLIGHT_FNS = {"record", "span"}
 _QUALMON_FNS = {"gauge", "inc"}
 _HOSTPROF_FNS = {"set_stage", "stage"}
+_TIMELINE_FNS = {"record"}
 
 #: per-rule (positional index, keyword name) of the argument that must
 #: be a bounded string — GL60x's lint surface
 _NAME_ARG = {"GL601": (0, "name"), "GL602": (0, "name"),
              "GL603": (1, "kind"), "GL606": (0, "name"),
-             "GL607": (0, "stage")}
+             "GL607": (0, "stage"), "GL608": (0, "name")}
 
 
 def _module_str_constants(mod: ModuleInfo) -> Set[str]:
@@ -116,6 +128,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL606"
         if full == _HOSTPROF_MODULE and func.attr in _HOSTPROF_FNS:
             return "GL607"
+        if full == _TIMELINE_MODULE and func.attr in _TIMELINE_FNS:
+            return "GL608"
         return None
     if isinstance(func, ast.Name):
         target = mod.from_imports.get(func.id, "")
@@ -130,6 +144,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL606"
         if modpath == _HOSTPROF_MODULE and sym in _HOSTPROF_FNS:
             return "GL607"
+        if modpath == _TIMELINE_MODULE and sym in _TIMELINE_FNS:
+            return "GL608"
     return None
 
 
