@@ -672,7 +672,7 @@ def run_bench():
         enable_compile_cache()
 
         import sptag_tpu as sp
-        from sptag_tpu.utils import costmodel, trace
+        from sptag_tpu.utils import costmodel, recompile_guard, trace
 
         # 4096 queries: the tunneled backend costs ~60 ms per synced round
         # trip, so throughput is only visible with enough queries in flight
@@ -687,7 +687,8 @@ def run_bench():
         # long before the BKT build finishes.  Exactness is asserted
         # against a 50-query exact-topk sample rather than the full truth
         # (which may itself be minutes of CPU when the disk cache is cold).
-        with trace.span("bench.flat_quick"):
+        with trace.span("bench.flat_quick"), \
+                recompile_guard.track_compiles("bench.flat_quick"):
             flat = sp.create_instance("FLAT", "Float")
             flat.set_parameter("DistCalcMethod", "L2")
             flat.build(data)
@@ -717,7 +718,8 @@ def run_bench():
         # full ground truth from the same code path (disk-cached)
         truth = l2_truth(data, queries, k)
 
-        with trace.span("bench.build_or_load"):
+        with trace.span("bench.build_or_load"), \
+                recompile_guard.track_compiles("bench.build_or_load"):
             index, build_s, cached = build_or_load(
                 f"bkt_f32_n{n}", lambda: build_headline_f32(n, data),
                 budget_s)
@@ -725,7 +727,8 @@ def run_bench():
         # grouped probing at union_factor 2 measured recall 0.824 vs 0.967
         # ungrouped — probe sharing is too weak.  int8 below opts in (its
         # tighter clusters measured recall UP at union_factor 4).
-        with trace.span("bench.sweep"):
+        with trace.span("bench.sweep"), \
+                recompile_guard.track_compiles("bench.sweep"):
             ids_all, qps, batch_times = timed_sweep(index, queries, k, batch,
                                                     budget_s)
         recall = recall_at_k(ids_all, truth, k)
@@ -926,7 +929,8 @@ def run_bench():
                 # and the exact-walk reference pass below anchors its
                 # recall inside a Wilson CI
                 beam_index.set_parameter("BinnedTopK", "on")
-                with trace.span("bench.beam_sweep"):
+                with trace.span("bench.beam_sweep"), \
+                        recompile_guard.track_compiles("bench.beam_sweep"):
                     ids_b, qps_b, _ = timed_sweep(
                         beam_index, queries[:qcount], k,
                         min(batch, qcount), sb_beam, repeats=1)
@@ -955,7 +959,8 @@ def run_bench():
                     from sptag_tpu.utils import qualmon as _qm
 
                     beam_index.set_parameter("BinnedTopK", "off")
-                    with trace.span("bench.beam_exact_ref"):
+                    with trace.span("bench.beam_exact_ref"), \
+                            recompile_guard.track_compiles("bench.beam_exact_ref"):
                         beam_index.search_batch(queries[:qcount], k)
                         t0 = time.perf_counter()
                         _, ids_e = beam_index.search_batch(
@@ -2169,7 +2174,7 @@ def _beam_cb_measure(beam_index, queries, k, budget_s):
     path when per-query convergence variance lets retired slots skip
     work."""
     from sptag_tpu.algo.scheduler import BeamSlotScheduler
-    from sptag_tpu.utils import trace
+    from sptag_tpu.utils import recompile_guard, trace
 
     eng = beam_index._get_engine()
     budgets = (512, 2048)
@@ -2180,7 +2185,8 @@ def _beam_cb_measure(beam_index, queries, k, budget_s):
     rows_by_mc = {mc: [i for i, b in mixed if b == mc] for mc in budgets}
 
     def measure(dp):
-        with trace.span("bench.beam_cb_mono"):
+        with trace.span("bench.beam_cb_mono"), \
+                recompile_guard.track_compiles("bench.beam_cb_mono"):
             for mc in budgets:      # compile outside the timed run
                 eng.search(qs[rows_by_mc[mc]], k, max_check=mc,
                            beam_width=bw, pool_size=pool,
@@ -2194,7 +2200,8 @@ def _beam_cb_measure(beam_index, queries, k, budget_s):
                 lat_mono[rows] = time.perf_counter() - t0
             mono_wall = time.perf_counter() - t0
 
-        with trace.span("bench.beam_cb_sched"):
+        with trace.span("bench.beam_cb_sched"), \
+                recompile_guard.track_compiles("bench.beam_cb_sched"):
             sched = BeamSlotScheduler(eng, slots=256, segment_iters=0)
             try:
                 warm = [sched.submit(qs[i], k, mc, beam_width=bw,

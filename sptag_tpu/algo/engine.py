@@ -69,7 +69,7 @@ from sptag_tpu.core.types import DistCalcMethod
 from sptag_tpu.ops import distance as dist_ops
 from sptag_tpu.ops import topk_bins
 from sptag_tpu.utils import (costmodel, devmem, flightrec, metrics,
-                             query_bucket, roofline)
+                             query_bucket, recompile_guard, roofline)
 
 MAX_DIST = np.float32(3.4e38)   # plain scalar: module import must NOT init a backend
 
@@ -1376,14 +1376,19 @@ class GraphSearchEngine:
         host RAM for the exact re-rank (the beyond-HBM contract:
         the fp corpus never rides the device)."""
         if self.fp_host is not None:
-            ids_np = np.asarray(state["cand_ids"])
+            # device_get: the ONE sanctioned mid-walk readback — the
+            # host-tier gather needs the pool ids on the host by design
+            # (the trace sentinel blesses it; np.asarray here would trip
+            # GL902 and, on real accelerators, the transfer guard)
+            ids_np = recompile_guard.device_get(state["cand_ids"])
             safe = np.clip(ids_np, 0, self.fp_host.shape[0] - 1)
             rows = self.fp_host[safe]
             dead = self._deleted_np[safe]
             d, ids = _beam_finalize_gathered_kernel(
                 jnp.asarray(rows), jnp.asarray(dead), state["queries"],
                 state["cand_ids"], k_eff, int(self.metric), self.base)
-            return np.asarray(d), np.asarray(ids)
+            return (recompile_guard.device_get(d),
+                    recompile_guard.device_get(ids))
         rerank = (self.data_score is not None
                   and self.data_score.dtype != self.data.dtype)
         d, ids = _beam_finalize_kernel(
@@ -1392,7 +1397,8 @@ class GraphSearchEngine:
             self.base, rerank,
             binned_bins=self.finalize_bins_for(
                 k_eff, int(state["cand_ids"].shape[1])))
-        return np.asarray(d), np.asarray(ids)
+        return (recompile_guard.device_get(d),
+                recompile_guard.device_get(ids))
 
     def _search_segmented(self, queries: np.ndarray,
                           seeds: Optional[np.ndarray], k_eff: int, L: int,
@@ -1428,7 +1434,9 @@ class GraphSearchEngine:
             while True:
                 state, alive = self.run_segment(state, t_limit, k_eff, L,
                                                 B, limit, S, inject=inject)
-                if not bool(np.asarray(jnp.any(alive))):
+                # explicit readback: the segment loop's continue-flag is
+                # the intended per-segment sync point
+                if not bool(recompile_guard.device_get(jnp.any(alive))):
                     break
             d, ids = self.finalize(state, k_eff)
             out_d[start:start + nqc] = d[:nqc]

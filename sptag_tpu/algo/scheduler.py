@@ -53,7 +53,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sptag_tpu.utils import (devmem, flightrec, hostprof, locksan, metrics,
-                             query_bucket)
+                             query_bucket, recompile_guard)
 
 log = logging.getLogger(__name__)
 
@@ -555,12 +555,24 @@ class BeamSlotScheduler:
         if not pool.live_count():
             return
         # ---- one segment on device --------------------------------------
+        # hot_section: the trace sentinel's guarded region — implicit
+        # device->host readbacks in here are violations, and every XLA
+        # compile is charged to the "scheduler.cycle" budget (zero after
+        # warmup: pools key on (k_eff, L, B, limit), t_limit is traced)
         t_seg0 = time.monotonic_ns() if rec else 0
-        state = {name: (jnp.asarray(arr) if arr is not None else None)
-                 for name, arr in pool.state.items()}
-        new_state, alive = engine.run_segment(
-            state, jnp.asarray(pool.t_limit), pool.k_eff, pool.L, pool.B,
-            pool.nbp_limit, pool.seg_iters, inject=pool.inject)
+        seg_guard = recompile_guard.hot_section("scheduler.cycle")
+        with seg_guard:
+            state = {name: (jnp.asarray(arr) if arr is not None else None)
+                     for name, arr in pool.state.items()}
+            new_state, alive = engine.run_segment(
+                state, jnp.asarray(pool.t_limit), pool.k_eff, pool.L,
+                pool.B, pool.nbp_limit, pool.seg_iters,
+                inject=pool.inject)
+            alive_host = recompile_guard.device_get(alive)
+            host_state = {
+                name: np.array(recompile_guard.device_get(new_state[name]))
+                for name in ("cand_ids", "cand_d", "expanded", "visited",
+                             "no_better", "ptr", "it")}
         metrics.inc("scheduler.segments")
         # shard-axis accounting (mesh engines, parallel/mesh_engine.py):
         # one mesh segment advances the walk on EVERY shard at once, so
@@ -581,14 +593,15 @@ class BeamSlotScheduler:
                              dur_ns=time.monotonic_ns() - t_seg0,
                              payload={"live": live_now,
                                       "capacity": pool.capacity})
-        alive_np = np.asarray(alive)
+        alive_np = alive_host
         done = [i for i, e in enumerate(pool.entries)
                 if e is not None and not alive_np[i]]
         for name in ("cand_ids", "cand_d", "expanded", "visited",
                      "no_better", "ptr", "it"):
-            # np.array, not asarray: device arrays export as READ-ONLY
-            # host views, and blank/insert mutate these in place
-            pool.state[name] = np.array(new_state[name])
+            # np.array (in host_state above), not a bare device_get:
+            # device arrays export as READ-ONLY host views, and
+            # blank/insert mutate these in place
+            pool.state[name] = host_state[name]
         if shards > 1:
             # mesh skew telemetry (ISSUE 15): per-shard work + straggler
             # gauges from the fresh (cap, n_shards) iteration counters
@@ -600,9 +613,10 @@ class BeamSlotScheduler:
             # capacity every cycle was the dominant per-cycle overhead
             Rb = query_bucket(len(done), pool.capacity)
             rows = np.asarray(done + [done[0]] * (Rb - len(done)))
-            sub = {name: jnp.asarray(pool.state[name][rows])
-                   for name in ("queries", "cand_ids", "cand_d")}
-            d, ids = engine.finalize(sub, pool.k_eff)
+            with recompile_guard.hot_section("scheduler.finalize"):
+                sub = {name: jnp.asarray(pool.state[name][rows])
+                       for name in ("queries", "cand_ids", "cand_d")}
+                d, ids = engine.finalize(sub, pool.k_eff)
             t_done = time.perf_counter()
             items = [pool.entries[i] for i in done]
             # per-query roofline attribution (ISSUE 6 satellite): the
@@ -707,10 +721,14 @@ class BeamSlotScheduler:
             seeds = np.full((Rb, pool.seed_width), -1, np.int32)
             for i, item in enumerate(incoming):
                 seeds[i] = item.seeds
-            seeds = jnp.asarray(seeds)
-        seeded = engine.seed_state(jnp.asarray(q), pool.L, seeds=seeds)
-        return {name: (np.array(arr) if arr is not None else None)
-                for name, arr in seeded.items()}
+        with recompile_guard.hot_section("scheduler.seed"):
+            if seeds is not None:
+                seeds = jnp.asarray(seeds)
+            seeded = engine.seed_state(jnp.asarray(q), pool.L, seeds=seeds)
+            # np.array: seeded rows are mutated in place by _insert
+            return {name: (np.array(recompile_guard.device_get(arr))
+                           if arr is not None else None)
+                    for name, arr in seeded.items()}
 
     @staticmethod
     def _insert(pool: _SlotPool, incoming: List[_Item],

@@ -55,7 +55,7 @@ from sptag_tpu.algo.engine import (
 )
 from sptag_tpu.ops import topk_bins
 from sptag_tpu.parallel._compat import shard_map
-from sptag_tpu.utils import costmodel, roofline
+from sptag_tpu.utils import costmodel, recompile_guard, roofline
 
 SHARD_AXIS = "shard"
 
@@ -412,4 +412,5 @@ class MeshGraphEngine:
             # demands the exact fp re-rank before the ICI merge
             rerank=(self.data_score is not None
                     and self.data_score.dtype != self.data.dtype))
-        return np.asarray(d), np.asarray(ids)
+        return (recompile_guard.device_get(d),
+                recompile_guard.device_get(ids))

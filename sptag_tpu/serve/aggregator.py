@@ -276,6 +276,8 @@ class AggregatorContext:
                  lock_contention_ledger: bool = False,
                  race_sanitizer: bool = False,
                  racesan_sample_rate: float = 1.0,
+                 trace_sanitizer: bool = False,
+                 tracesan_compile_budget: int = 0,
                  timeline_interval_ms: float = 0.0,
                  timeline_events: int = 0,
                  slo_availability_target: float = 0.0,
@@ -367,6 +369,12 @@ class AggregatorContext:
         # race sanitizer (ISSUE 12): [Service] parity with the shard tier
         self.race_sanitizer = race_sanitizer
         self.racesan_sample_rate = racesan_sample_rate
+        # trace/transfer sentinel (ISSUE 16): [Service] parity with the
+        # shard tier — the aggregator itself dispatches no device work,
+        # but arming here keeps one ini fragment valid for both tiers
+        # (and bites if a future merge path grows a device stage)
+        self.trace_sanitizer = trace_sanitizer
+        self.tracesan_compile_budget = tracesan_compile_budget
         # serving timeline + SLO engine + canary (ISSUE 15) — [Service]
         # parity with the shard tier.  The aggregator has no corpus to
         # pin ground truth from, so its canary loads probe query lines
@@ -475,6 +483,11 @@ class AggregatorContext:
             ("1", "true", "on", "yes", "strict"),
             racesan_sample_rate=float(reader.get_parameter(
                 "Service", "RaceSanSampleRate", "1")),
+            trace_sanitizer=reader.get_parameter(
+                "Service", "TraceSanitizer", "0").lower() in
+            ("1", "true", "on", "yes", "strict"),
+            tracesan_compile_budget=int(reader.get_parameter(
+                "Service", "TraceSanCompileBudget", "0")),
             timeline_interval_ms=float(reader.get_parameter(
                 "Service", "TimelineIntervalMs", "0")),
             timeline_events=int(reader.get_parameter(
@@ -515,6 +528,12 @@ class AggregatorContext:
                 strict=(reader.get_parameter(
                     "Service", "RaceSanitizer", "0").lower() == "strict"),
                 sample_rate=ctx.racesan_sample_rate)
+        if ctx.trace_sanitizer:
+            from sptag_tpu.utils import recompile_guard
+            recompile_guard.enable_tracesan(
+                strict=(reader.get_parameter(
+                    "Service", "TraceSanitizer", "0").lower() == "strict"),
+                compile_budget=(ctx.tracesan_compile_budget or None))
         count = int(reader.get_parameter("Servers", "Number", "0"))
         for i in range(count):
             section = f"Server_{i}"
@@ -679,6 +698,11 @@ class AggregatorService:
         if self.context.race_sanitizer:
             locksan.enable_racesan(
                 sample_rate=self.context.racesan_sample_rate)
+        if self.context.trace_sanitizer:
+            from sptag_tpu.utils import recompile_guard
+            recompile_guard.enable_tracesan(
+                compile_budget=(self.context.tracesan_compile_budget
+                                or None))
         if self.context.host_prof_hz > 0:
             # host sampler (utils/hostprof.py, ISSUE 10): process-wide;
             # never started at the default HostProfHz=0

@@ -176,6 +176,15 @@ class ServiceSettings:
     # fraction of tracked attribute writes the sanitizer records
     # (deterministic per-thread 1-in-round(1/rate)); 1.0 = every write
     racesan_sample_rate: float = 1.0
+    # trace/transfer sentinel (utils/recompile_guard.py, ISSUE 16):
+    # when on, the engine/scheduler hot sections flag implicit
+    # device->host readbacks and charge XLA compiles to per-family
+    # budgets ("strict" raises TransferSyncError/CompileBudgetError).
+    # Off (default): hot_section is one flag test, no ArrayImpl shims
+    # are installed, serve bytes stay byte-identical.
+    trace_sanitizer: bool = False
+    # default per-family XLA compile budget while armed; 0 = unlimited
+    tracesan_compile_budget: int = 0
     # in-mesh sharded serving (parallel/sharded.py, ISSUE 11): with
     # MeshServe=1 every registered mesh index (ServingAdapter) arms its
     # mesh-wide continuous-batching spine at server start — one pjit
@@ -323,6 +332,11 @@ class ServiceContext:
             ("1", "true", "on", "yes", "strict"),
             racesan_sample_rate=float(reader.get_parameter(
                 "Service", "RaceSanSampleRate", "1")),
+            trace_sanitizer=reader.get_parameter(
+                "Service", "TraceSanitizer", "0").lower() in
+            ("1", "true", "on", "yes", "strict"),
+            tracesan_compile_budget=int(reader.get_parameter(
+                "Service", "TraceSanCompileBudget", "0")),
             mesh_serve=reader.get_parameter(
                 "Service", "MeshServe", "0").lower() in
             ("1", "true", "on", "yes"),
@@ -382,6 +396,15 @@ class ServiceContext:
                 strict=(reader.get_parameter(
                     "Service", "RaceSanitizer", "0").lower() == "strict"),
                 sample_rate=s.racesan_sample_rate)
+        if s.trace_sanitizer:
+            # arm BEFORE index load, mirroring the other sanitizers: the
+            # warmup searches load_index runs must already be charged to
+            # their hot-section compile families
+            from sptag_tpu.utils import recompile_guard
+            recompile_guard.enable_tracesan(
+                strict=(reader.get_parameter(
+                    "Service", "TraceSanitizer", "0").lower() == "strict"),
+                compile_budget=(s.tracesan_compile_budget or None))
         ctx = cls(s)
         index_list = reader.get_parameter("Index", "List", "")
         for name in (t.strip() for t in index_list.split(",")):

@@ -176,3 +176,57 @@ def test_resolve_dotted_paths():
 def test_judge_matrix(base, cur, direction, expect):
     m = benchdiff.Metric("m", direction, 0.20, 10.0)
     assert benchdiff.judge(m, base, cur).status == expect
+
+
+def test_backend_compile_count_regression_fails(tmp_path, capsys):
+    """ISSUE 16: per-stage `xla.backend_compile[bench.X]` span counts
+    become direction-adjusted `<stage>.backend_compiles` lines — a
+    stage minting MORE XLA programs than the baseline is a recompile
+    regression even when QPS looks flat."""
+    base = _artifact(trace={
+        "xla.backend_compile[bench.sweep]": {"count": 4,
+                                             "total_s": 2.0},
+        "xla.backend_compile[bench.flat_quick]": {"count": 2,
+                                                  "total_s": 0.5},
+        "bench.sweep": {"count": 1, "total_s": 9.0}})
+    cur = copy.deepcopy(base)
+    cur["trace"]["xla.backend_compile[bench.sweep]"]["count"] = 12
+    bp = _write(tmp_path, "b.json", base)
+    cp = _write(tmp_path, "c.json", cur)
+    assert benchdiff.main([bp, cp]) == 1
+    out = capsys.readouterr().out
+    assert "bench.sweep.backend_compiles" in out and "REGRESSED" in out
+    # the steady stage stays quiet; plain spans never synthesize a line
+    assert "bench.flat_quick.backend_compiles REGRESSED" not in out
+
+
+def test_backend_compile_counts_equal_pass_and_fewer_improve(tmp_path,
+                                                             capsys):
+    base = _artifact(trace={
+        "xla.backend_compile[bench.sweep]": {"count": 8,
+                                             "total_s": 2.0}})
+    cur = copy.deepcopy(base)
+    bp = _write(tmp_path, "b.json", base)
+    cp = _write(tmp_path, "c.json", cur)
+    assert benchdiff.main([bp, cp]) == 0
+    cur["trace"]["xla.backend_compile[bench.sweep]"]["count"] = 3
+    cp = _write(tmp_path, "c2.json", cur)
+    assert benchdiff.main([bp, cp]) == 0      # fewer compiles: improved
+    # label present on only one side is skipped, not failed
+    del cur["trace"]["xla.backend_compile[bench.sweep]"]
+    cp = _write(tmp_path, "c3.json", cur)
+    assert benchdiff.main([bp, cp]) == 0
+
+
+def test_backend_compile_lines_are_platform_bound(tmp_path, capsys):
+    base = _artifact(trace={
+        "xla.backend_compile[bench.sweep]": {"count": 2,
+                                             "total_s": 1.0}})
+    cur = copy.deepcopy(base)
+    cur["platform"] = "tpu"
+    cur["trace"]["xla.backend_compile[bench.sweep]"]["count"] = 40
+    bp = _write(tmp_path, "b.json", base)
+    cp = _write(tmp_path, "c.json", cur)
+    assert benchdiff.main([bp, cp]) == 0
+    out = capsys.readouterr().out
+    assert "platform mismatch" in out

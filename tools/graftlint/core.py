@@ -246,7 +246,8 @@ def _mark_jit_roots(mod: ModuleInfo) -> None:
                     fn.is_jit_root = True
                     fn.static_args |= _resolve_static(
                         fn, _static_args_from_call(dec))
-    # call forms: jax.jit(f, ...) / shard_map(f, ...) anywhere in the module
+    # call forms: jax.jit(f, ...) / shard_map(f, ...) / pallas_call(f, ...)
+    # anywhere in the module
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
@@ -260,6 +261,11 @@ def _mark_jit_roots(mod: ModuleInfo) -> None:
                     fn, _static_args_from_call(node))
             elif _is_shard_map(node.func, mod):
                 fn.is_shard_root = True
+            elif (_dotted(node.func) or "").split(".")[-1] == \
+                    "pallas_call":
+                # a Pallas kernel body is traced/compiled like a jit
+                # root (device program; host syncs inside are fatal)
+                fn.is_jit_root = True
 
 
 # ---------------------------------------------------------------------------
@@ -305,6 +311,12 @@ class Project:
         self.package_root = package_root
         self.modules: Dict[str, ModuleInfo] = {}
         self.errors: List[Finding] = []
+        #: shared per-pass memo store: checkers that build expensive
+        #: derived models (lock topology, guarded-by pass, the class
+        #: attribute registry, the trace-contract call model) key them
+        #: here so every registered pass shares ONE parse + call graph
+        #: per lint invocation instead of rebuilding its own
+        self.cache: Dict[str, object] = {}
         for relpath, src in sorted(sources.items()):
             try:
                 self.modules[relpath] = ModuleInfo(relpath, src)

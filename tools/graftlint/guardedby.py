@@ -615,10 +615,10 @@ class _Pass:
 # ---------------------------------------------------------------------------
 
 def _get_pass(project: Project) -> _Pass:
-    p = getattr(project, "_gl8_pass", None)
+    p = project.cache.get("guardedby.pass")
     if p is None or p.project is not project:
         p = _Pass(project)
-        project._gl8_pass = p
+        project.cache["guardedby.pass"] = p
     return p
 
 

@@ -491,10 +491,10 @@ def get_model(project: Project) -> "LockModel":
     """Memoized LockModel for a Project — lockgraph and asyncrules both
     run per lint invocation, and class-topology + attr-type inference
     over every module is the expensive part; build it once."""
-    model = getattr(project, "_gl7_lock_model", None)
+    model = project.cache.get("lockgraph.model")
     if model is None or model.project is not project:
         model = LockModel(project)
-        project._gl7_lock_model = model
+        project.cache["lockgraph.model"] = model
     return model
 
 

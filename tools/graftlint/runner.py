@@ -11,18 +11,20 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from tools.graftlint import (asyncrules, concurrency, costrules,
+from tools.graftlint import (asyncrules, attrmodel, concurrency, costrules,
                              dtype_parity, errorpath, guardedby, hostsync,
-                             lockgraph, obsnames, persistrules, retrace)
+                             lockgraph, obsnames, persistrules, retrace,
+                             tracecontract)
 from tools.graftlint.baseline import (BaselineError, Suppression,
                                       apply_baseline, load_baseline)
 from tools.graftlint.core import Finding, Project
 
 CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity,
             obsnames, lockgraph, asyncrules, costrules, persistrules,
-            guardedby)
+            guardedby, tracecontract, attrmodel)
 
 #: rule id -> one-line description, collected from every checker module
 ALL_RULES: Dict[str, str] = {}
@@ -38,6 +40,10 @@ def run_checkers(project: Project,
     restricts to rule-id prefixes (e.g. ["GL3"] or ["GL301"])."""
     findings: List[Finding] = list(project.errors)
     for checker in CHECKERS:
+        if select and not any(rule.startswith(s)
+                              for rule in checker.RULES
+                              for s in select):
+            continue          # no selected rule — skip the whole pass
         findings.extend(checker.check(project))
     if select:
         findings = [f for f in findings
@@ -107,6 +113,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # combined findings — per-root application would double-load the
     # suppressions and misreport entries satisfied by another root as
     # stale
+    t0 = time.monotonic()
     findings: List[Finding] = []
     for root in (args.paths or ["sptag_tpu"]):
         if not os.path.isdir(root):
@@ -139,7 +146,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"({s.rule} {s.path} {s.symbol or '*'}) matched nothing — "
               "prune it", file=sys.stderr)
     n = len(total_unsuppressed)
+    elapsed = time.monotonic() - t0
     print(f"graftlint: {n} finding(s), {total_suppressed} "
           f"baseline-suppressed, {len(stale)} stale baseline entr"
-          f"{'y' if len(stale) == 1 else 'ies'}", file=sys.stderr)
+          f"{'y' if len(stale) == 1 else 'ies'} in {elapsed:.2f}s",
+          file=sys.stderr)
     return 1 if total_unsuppressed else 0

@@ -1,0 +1,789 @@
+"""GL9xx — device-program contract analysis (jit / shard_map / Pallas).
+
+The ROADMAP's next tentpoles (Pallas segment-body fusion, multi-host
+mesh serving) churn exactly the surface where this codebase's bugs are
+silent: a per-call-varying value in a static argument recompiles on
+every query, an implicit host transfer stalls the segment loop between
+dispatches, a wrong collective axis name produces plausible-but-partial
+merges.  This pass builds ONE project-wide model of every
+`jax.jit`/`pjit`/`shard_map`/`pallas_call` site (shared through
+`project.cache` with the other passes) and checks the contracts:
+
+* GL901 — recompile hazard: a static_argnums/static_argnames position
+  fed a float-derived or per-call-varying (device-tainted) value, a
+  static spec that is not a literal, a static name missing from the
+  wrapped signature, or a float-typed static parameter.  Extends GL2xx
+  from the root's own signature to its CALL SITES.
+* GL902 — implicit host sync/transfer reachable inside the
+  walk/segment/scheduler hot path: interprocedural device-value taint
+  through the call graph flags `.item()` / `float()` / `int()` /
+  `np.asarray` / implicit `__bool__` on device values in HOST driver
+  code (the scheduler cycle, segment dispatch, finalize) — the region
+  GL1xx cannot see because these functions are not jit-reachable.
+  `jax.device_get` (and utils.recompile_guard.device_get, the runtime
+  sentinel's blessed readback) is the sanctioned explicit readback and
+  KILLS the taint.
+* GL903 — shard_map spec contract: literal in_specs arity vs the
+  wrapped function's positional signature, literal out_specs arity vs a
+  literal tuple return, and every PartitionSpec axis name against the
+  mesh axes declared in the project (Mesh((...,)) literals, *_AXIS
+  module constants, axis_name= call sites).
+* GL904 — collective axis misuse: `psum`/`all_gather`/`ppermute`/
+  `axis_index`/... whose axis name is not a declared mesh axis, or
+  which executes in a function never wrapped by shard_map (unbound
+  axis: a runtime NameError on the mesh, or silently wrong under a
+  future pmap).
+
+The runtime complement lives in sptag_tpu/utils/recompile_guard.py
+(the trace/transfer sentinel); tests/test_tracesan.py cross-checks that
+every runtime-observed transfer site is named by a GL901/GL902 finding
+or a justified baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import (Finding, FunctionInfo, ModuleInfo,
+                                  Project, _dotted, _is_jax_jit,
+                                  _is_shard_map, body_nodes,
+                                  tracer_taint)
+
+RULES = {
+    "GL901": "static jit argument fed a per-call-varying / float-derived "
+             "value, or a non-literal/unknown static spec (recompile "
+             "per call)",
+    "GL902": "implicit host sync/transfer on a device value inside the "
+             "scheduler/segment hot path (use jax.device_get / "
+             "recompile_guard.device_get)",
+    "GL903": "shard_map in_specs/out_specs disagree with the wrapped "
+             "signature or name an undeclared mesh axis",
+    "GL904": "collective axis name unbound by any enclosing "
+             "shard_map/mesh declaration",
+}
+
+#: host driver functions that ARE the serving hot path (continuous
+#: batching cycle, segment dispatch, finalize) — not jit-reachable, so
+#: GL1xx never sees them; GL902 owns them.  Matched by simple name in
+#: algo/ and parallel/ modules, then propagated over the call graph.
+HOT_ROOT_NAMES = {"_cycle", "_seed_bucket", "run_segment", "seed_state",
+                  "finalize", "_search_segmented"}
+HOT_ROOT_DIRS = ("algo/", "parallel/")
+
+#: explicit, sanctioned device->host readbacks (kill device taint)
+_BLESSED_READBACKS = {"device_get"}
+
+_NP_SYNC = {"asarray", "array", "copy", "frombuffer",
+            "ascontiguousarray"}
+
+#: collective -> index of its positional axis-name argument
+_COLLECTIVES = {"psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+                "all_gather": 1, "ppermute": 1, "all_to_all": 1,
+                "psum_scatter": 1, "axis_index": 0}
+
+
+# ---------------------------------------------------------------------------
+# shared model (project.cache)
+# ---------------------------------------------------------------------------
+
+class ContractModel:
+    """Project-wide facts every GL9xx rule shares: module string
+    constants, declared mesh axes, device-returning function names,
+    hot-path reachability, shard-map reachability."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.module_strs: Dict[ModuleInfo, Dict[str, str]] = {
+            mod: _module_str_constants(mod)
+            for mod in project.modules.values()}
+        self.declared_axes = self._collect_axes()
+        self.device_returning = self._device_returning_fixpoint()
+        self.hot = self._hot_reachable()
+        self.shard_reachable = self._shard_reachable()
+
+    # -- mesh axis declarations --------------------------------------------
+
+    def _collect_axes(self) -> Set[str]:
+        axes: Set[str] = set()
+        for mod, consts in self.module_strs.items():
+            for name, value in consts.items():
+                if name.endswith("_AXIS"):
+                    axes.add(value)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func) or ""
+                tail = d.split(".")[-1]
+                if tail == "Mesh" and len(node.args) >= 2:
+                    for el in ast.walk(node.args[1]):
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            axes.add(el.value)
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names"):
+                        for el in ast.walk(kw.value):
+                            if isinstance(el, ast.Constant) and \
+                                    isinstance(el.value, str):
+                                axes.add(el.value)
+        return axes
+
+    def resolve_axis(self, node: ast.AST,
+                     mod: ModuleInfo) -> Optional[str]:
+        """A collective/PartitionSpec axis argument -> its string, when
+        statically known (literal or module string constant, local or
+        imported)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        d = _dotted(node)
+        if d is None:
+            return None
+        name = d.split(".")[-1]
+        consts = self.module_strs.get(mod, {})
+        if name in consts:
+            return consts[name]
+        target = mod.from_imports.get(name)
+        if target and target.startswith(self.project.package_root):
+            modpath, _, sym = target.rpartition(".")
+            tmod = self.project.by_modpath.get(modpath)
+            if tmod is not None:
+                return self.module_strs.get(tmod, {}).get(sym)
+        return None
+
+    # -- device-returning functions ----------------------------------------
+
+    def _device_returning_fixpoint(self) -> Set[str]:
+        """Simple names of project functions whose return value holds
+        device arrays.  Seeded with every jit/shard root (their outputs
+        are device arrays by construction), then a fixpoint over host
+        functions whose return expressions taint as device values —
+        this is what carries GL902's taint ACROSS calls."""
+        names: Set[str] = set()
+        fns: List[FunctionInfo] = []
+        for mod in self.project.modules.values():
+            for fn in mod.functions:
+                fns.append(fn)
+                if fn.is_jit_root or fn.is_shard_root:
+                    names.add(fn.name)
+        for _ in range(4):                       # small fixpoint
+            grew = False
+            for fn in fns:
+                if fn.name in names:
+                    continue
+                _, expr_tainted = _device_taint(fn, names)
+                for node in body_nodes(fn):
+                    if isinstance(node, ast.Return) and \
+                            node.value is not None and \
+                            expr_tainted(node.value):
+                        names.add(fn.name)
+                        grew = True
+                        break
+            if not grew:
+                break
+        return names
+
+    # -- hot-path reachability ---------------------------------------------
+
+    def _hot_reachable(self) -> Set[int]:
+        seeds = []
+        for mod in self.project.modules.values():
+            if not any(d in mod.relpath for d in HOT_ROOT_DIRS):
+                continue
+            for fn in mod.functions:
+                if fn.name in HOT_ROOT_NAMES and not fn.is_jit_root \
+                        and not fn.is_shard_root:
+                    seeds.append(fn)
+        return self._propagate(seeds, stop_at_jit=True)
+
+    def _shard_reachable(self) -> Set[int]:
+        seeds = [fn for mod in self.project.modules.values()
+                 for fn in mod.functions if fn.is_shard_root]
+        return self._propagate(seeds, stop_at_jit=False)
+
+    def _propagate(self, seeds: List[FunctionInfo],
+                   stop_at_jit: bool) -> Set[int]:
+        from tools.graftlint.core import _called_names
+        seen = {id(f) for f in seeds}
+        queue = list(seeds)
+        while queue:
+            fn = queue.pop()
+            for child in fn.module.functions:
+                if child.parent is fn and id(child) not in seen:
+                    seen.add(id(child))
+                    queue.append(child)
+            for name, alias in _called_names(fn):
+                for callee in self.project._resolve_call(
+                        fn.module, name, alias):
+                    if id(callee) in seen:
+                        continue
+                    if stop_at_jit and (callee.is_jit_root
+                                        or callee.is_shard_root):
+                        continue       # device side: GL1xx territory
+                    if stop_at_jit and "utils/" in callee.module.relpath:
+                        # telemetry/sentinel infrastructure is not the
+                        # dispatch path (the sentinel itself handles
+                        # jax objects by design)
+                        continue
+                    seen.add(id(callee))
+                    queue.append(callee)
+        return seen
+
+
+def _module_str_constants(mod: ModuleInfo) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+    return out
+
+
+def get_model(project: Project) -> ContractModel:
+    model = project.cache.get("tracecontract.model")
+    if model is None or model.project is not project:
+        model = ContractModel(project)
+        project.cache["tracecontract.model"] = model
+    return model
+
+
+# ---------------------------------------------------------------------------
+# device-value taint for HOST functions (GL902's evaluator)
+# ---------------------------------------------------------------------------
+
+def _is_blessed_readback(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return d is not None and d.split(".")[-1] in _BLESSED_READBACKS
+
+
+def _device_taint(fn: FunctionInfo, device_returning: Set[str]):
+    """(tainted_names, expr_tainted) for a HOST function: which local
+    names hold device arrays.  Seeds are jnp./jax. producing calls and
+    calls to device-returning project functions (by simple name — this
+    is what makes the analysis interprocedural: `engine.run_segment`
+    taints even though `engine` is a local object the alias table
+    cannot resolve).  `jax.device_get(...)` is host."""
+    mod = fn.module
+    tainted: Set[str] = set()
+
+    def call_taints(node: ast.Call) -> bool:
+        if _is_blessed_readback(node):
+            return False
+        d = _dotted(node.func)
+        if d is not None:
+            head = d.split(".")[0]
+            tail = d.split(".")[-1]
+            full = mod.resolve_head(head)
+            if full is not None:
+                base = full.split(".")[0]
+                if base == "numpy":
+                    return False           # host result
+                if base == "jax":
+                    from tools.graftlint.core import \
+                        _is_jax_producing_call
+                    return _is_jax_producing_call(node, mod)
+            if tail in device_returning:
+                return True
+            if head == "len" or tail == "len":
+                return False
+        return any(expr_tainted(a) for a in node.args) or \
+            any(expr_tainted(k.value) for k in node.keywords)
+
+    def expr_tainted(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            from tools.graftlint.core import STATIC_ATTRS
+            if node.attr in STATIC_ATTRS:
+                return False
+            return expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            return call_taints(node)
+        if isinstance(node, ast.BinOp):
+            return expr_tainted(node.left) or expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return expr_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return expr_tainted(node.left) or \
+                any(expr_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return expr_tainted(node.value)
+        if isinstance(node, ast.IfExp):
+            return expr_tainted(node.body) or expr_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(v is not None and expr_tainted(v)
+                       for v in node.values)
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp)):
+            return expr_tainted(node.elt)
+        if isinstance(node, ast.DictComp):
+            return expr_tainted(node.value)
+        if isinstance(node, ast.Starred):
+            return expr_tainted(node.value)
+        return False
+
+    nested = {f.node for f in mod.functions if f.parent is fn}
+
+    def bind(tgt: ast.AST, is_tainted: bool) -> None:
+        # only the names being BOUND change state: a subscript or
+        # attribute store mutates an existing container (a numpy
+        # out-buffer filled from a device value stays numpy), and
+        # index expressions inside the target are reads, not binds
+        if isinstance(tgt, ast.Name):
+            if is_tainted:
+                tainted.add(tgt.id)
+            else:
+                tainted.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                bind(el, is_tainted)
+        elif isinstance(tgt, ast.Starred):
+            bind(tgt.value, is_tainted)
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if child in nested:
+                continue
+            if isinstance(child, ast.Assign):
+                t = expr_tainted(child.value)
+                for tgt in child.targets:
+                    bind(tgt, t)
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)) and \
+                    child.value is not None and \
+                    expr_tainted(child.value):
+                bind(child.target, True)
+            visit(child)
+
+    # two forward passes: the scheduler's cycle assigns through dicts
+    # and tuple unpacking where one pass misses loop-carried names
+    visit(fn.node)
+    visit(fn.node)
+    return tainted, expr_tainted
+
+
+# ---------------------------------------------------------------------------
+# GL901 — recompile hazards at jit sites and their call sites
+# ---------------------------------------------------------------------------
+
+def _static_spec_issues(call: ast.Call) -> List[str]:
+    """Non-literal static_argnames/static_argnums specs (core's
+    extractor silently ignores them, so nothing downstream would ever
+    know the spec existed)."""
+    issues = []
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and \
+                isinstance(v.value, (str, int)):
+            continue
+        if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            bad = [e for e in v.elts
+                   if not (isinstance(e, ast.Constant)
+                           and isinstance(e.value, (str, int)))]
+            if bad:
+                issues.append(
+                    f"{kw.arg} contains non-literal entries — the "
+                    "static set cannot be checked (or reproduced) "
+                    "statically")
+            continue
+        issues.append(
+            f"{kw.arg} is not a literal — the static set cannot be "
+            "checked statically")
+    return issues
+
+
+def _float_static_params(fn: FunctionInfo) -> List[Tuple[str, str]]:
+    """(param, why) for static params that are float-typed: every
+    distinct float mints a new executable (GL2xx quantizes ints; floats
+    have no ladder)."""
+    out = []
+    a = fn.node.args
+    params = a.posonlyargs + a.args + a.kwonlyargs
+    defaults = list(a.defaults)
+    dmap: Dict[str, ast.expr] = {}
+    pos = a.posonlyargs + a.args
+    for p, dflt in zip(pos[len(pos) - len(defaults):], defaults):
+        dmap[p.arg] = dflt
+    for p, dflt in zip(a.kwonlyargs, a.kw_defaults):
+        if dflt is not None:
+            dmap[p.arg] = dflt
+    for p in params:
+        if p.arg not in fn.static_args:
+            continue
+        ann = getattr(p, "annotation", None)
+        if ann is not None and (_dotted(ann) or "") == "float":
+            out.append((p.arg, "annotated `float`"))
+            continue
+        d = dmap.get(p.arg)
+        if isinstance(d, ast.Constant) and isinstance(d.value, float):
+            out.append((p.arg, "float default"))
+    return out
+
+
+def _float_derived(node: ast.AST) -> bool:
+    """Is this call-site argument float-derived (a fresh float per
+    call)?  Literal floats are fine — they are the SAME value every
+    call; what recompiles is arithmetic minting a new float."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+        return True
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func) or ""
+        if d.split(".")[0] == "time" or d.split(".")[-1] == "float":
+            return True
+    return False
+
+
+def _check_gl901(project: Project, model: ContractModel
+                 ) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        path = mod.relpath
+        # enclosing-function attribution
+        fn_of: Dict[int, str] = {}
+        for fn in mod.functions:
+            for n in ast.walk(fn.node):
+                fn_of.setdefault(id(n), fn.qualname)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_jit_call = _is_jax_jit(node.func, mod) or (
+                (_dotted(node.func) or "") in
+                ("functools.partial", "partial") and node.args
+                and _is_jax_jit(node.args[0], mod))
+            if not is_jit_call:
+                continue
+            for issue in _static_spec_issues(node):
+                out.append(Finding("GL901", path, node.lineno, issue,
+                                   fn_of.get(id(node), "")))
+    # static names vs signatures, float-typed static params
+    for mod in project.modules.values():
+        for fn in mod.functions:
+            if not fn.is_jit_root or not fn.static_args:
+                continue
+            params = set(fn.param_names())
+            for name in sorted(fn.static_args - params):
+                out.append(Finding(
+                    "GL901", mod.relpath, fn.line,
+                    f"static arg {name!r} is not a parameter of "
+                    f"{fn.name} — the spec silently binds nothing",
+                    fn.qualname))
+            for pname, why in _float_static_params(fn):
+                out.append(Finding(
+                    "GL901", mod.relpath, fn.line,
+                    f"static param {pname!r} is float-typed ({why}): "
+                    "every distinct value compiles a new executable "
+                    "(quantize to an int ladder, or pass it traced)",
+                    fn.qualname))
+    # call sites feeding static positions
+    for mod in project.modules.values():
+        for caller in mod.functions:
+            taint_expr = None
+            for node in body_nodes(caller):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name):
+                    name, alias = f.id, None
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name):
+                    name, alias = f.attr, f.value.id
+                else:
+                    continue
+                for callee in project._resolve_call(mod, name, alias):
+                    if not callee.is_jit_root or not callee.static_args:
+                        continue
+                    params = callee.param_names()
+                    feeds: List[Tuple[str, ast.AST]] = []
+                    for i, arg in enumerate(node.args):
+                        if i < len(params) and \
+                                params[i] in callee.static_args:
+                            feeds.append((params[i], arg))
+                    for kw in node.keywords:
+                        if kw.arg in callee.static_args:
+                            feeds.append((kw.arg, kw.value))
+                    for pname, arg in feeds:
+                        if isinstance(arg, (ast.List, ast.Dict,
+                                            ast.Set)):
+                            out.append(Finding(
+                                "GL901", mod.relpath, node.lineno,
+                                f"static arg {pname!r} of "
+                                f"{callee.name} fed a mutable "
+                                "list/dict/set literal (unhashable; "
+                                "and mutation would not retrigger a "
+                                "trace)", caller.qualname))
+                            continue
+                        if _float_derived(arg):
+                            out.append(Finding(
+                                "GL901", mod.relpath, node.lineno,
+                                f"static arg {pname!r} of "
+                                f"{callee.name} fed a float-derived "
+                                "value — a fresh float per call means "
+                                "a fresh compile per call",
+                                caller.qualname))
+                            continue
+                        if taint_expr is None:
+                            if caller.jit_reachable:
+                                tracer_taint(caller)
+                                taint_expr = caller._taint_expr
+                            else:
+                                _, taint_expr = _device_taint(
+                                    caller, model.device_returning)
+                        if taint_expr(arg):
+                            out.append(Finding(
+                                "GL901", mod.relpath, node.lineno,
+                                f"static arg {pname!r} of "
+                                f"{callee.name} fed a device value — "
+                                "it varies per call, so every call "
+                                "re-traces (pass it traced, or read "
+                                "it back explicitly once)",
+                                caller.qualname))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL902 — implicit host sync in the hot path
+# ---------------------------------------------------------------------------
+
+def _np_alias_heads(mod: ModuleInfo) -> Set[str]:
+    return {alias for alias, full in mod.import_aliases.items()
+            if full.split(".")[0] == "numpy"}
+
+
+def _check_gl902(project: Project, model: ContractModel
+                 ) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        np_heads = _np_alias_heads(mod)
+        for fn in mod.functions:
+            if id(fn) not in model.hot or fn.jit_reachable:
+                continue
+            _, expr_tainted = _device_taint(fn, model.device_returning)
+            path = mod.relpath
+            for node in body_nodes(fn):
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr == "item" and not node.args and \
+                            expr_tainted(f.value):
+                        out.append(Finding(
+                            "GL902", path, node.lineno,
+                            "`.item()` on a device value inside the "
+                            "hot path blocks the dispatch pipeline "
+                            "(read back explicitly with "
+                            "jax.device_get outside the loop)",
+                            fn.qualname))
+                    elif isinstance(f, ast.Name) and \
+                            f.id in ("float", "int") and \
+                            len(node.args) == 1 and \
+                            expr_tainted(node.args[0]):
+                        out.append(Finding(
+                            "GL902", path, node.lineno,
+                            f"`{f.id}()` on a device value inside the "
+                            "hot path forces a blocking sync per call",
+                            fn.qualname))
+                    elif isinstance(f, ast.Attribute) and \
+                            f.attr in _NP_SYNC and \
+                            isinstance(f.value, ast.Name) and \
+                            f.value.id in np_heads and node.args and \
+                            expr_tainted(node.args[0]):
+                        out.append(Finding(
+                            "GL902", path, node.lineno,
+                            f"`{f.value.id}.{f.attr}()` on a device "
+                            "value inside the hot path is an IMPLICIT "
+                            "device->host transfer — use "
+                            "jax.device_get (the sanctioned explicit "
+                            "readback the transfer sentinel allows)",
+                            fn.qualname))
+                elif isinstance(node, (ast.If, ast.While)) and \
+                        expr_tainted(node.test):
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    out.append(Finding(
+                        "GL902", path, node.lineno,
+                        f"`{kw}` on a device value inside the hot "
+                        "path implicitly syncs per iteration "
+                        "(device_get the flag once, or fold the "
+                        "branch into the kernel)", fn.qualname))
+                elif isinstance(node, ast.BoolOp) and \
+                        any(expr_tainted(v) for v in node.values):
+                    out.append(Finding(
+                        "GL902", path, node.lineno,
+                        "`and`/`or` on a device value inside the hot "
+                        "path coerces it to bool (a blocking sync)",
+                        fn.qualname))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GL903 / GL904 — shard_map spec + collective axis contracts
+# ---------------------------------------------------------------------------
+
+def _pspec_axes(spec_node: ast.AST, mod: ModuleInfo,
+                model: ContractModel) -> List[Tuple[str, int]]:
+    """(axis, lineno) for every axis name inside PartitionSpec calls in
+    a spec expression."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(spec_node):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = (_dotted(node.func) or "").split(".")[-1]
+        if tail not in ("P", "PartitionSpec"):
+            continue
+        for arg in node.args:
+            elts = arg.elts if isinstance(arg, ast.Tuple) else [arg]
+            for el in elts:
+                if isinstance(el, ast.Constant) and el.value is None:
+                    continue
+                axis = model.resolve_axis(el, mod)
+                if axis is not None:
+                    out.append((axis, node.lineno))
+    return out
+
+
+def _positional_param_count(fn: FunctionInfo) -> int:
+    a = fn.node.args
+    n = len(a.posonlyargs) + len(a.args)
+    if a.posonlyargs and a.posonlyargs[0].arg == "self":
+        n -= 1
+    elif a.args and a.args[0].arg == "self":
+        n -= 1
+    return n
+
+
+def _check_gl903(project: Project, model: ContractModel
+                 ) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        fn_of: Dict[int, str] = {}
+        for fn in mod.functions:
+            for n in ast.walk(fn.node):
+                fn_of.setdefault(id(n), fn.qualname)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_shard_map(node.func, mod) and node.args):
+                continue
+            sym = fn_of.get(id(node), "")
+            kw = {k.arg: k.value for k in node.keywords}
+            in_specs = kw.get("in_specs")
+            out_specs = kw.get("out_specs")
+            if in_specs is None and len(node.args) >= 3:
+                in_specs = node.args[2]
+            if out_specs is None and len(node.args) >= 4:
+                out_specs = node.args[3]
+            wrapped = None
+            if isinstance(node.args[0], ast.Name):
+                wname = node.args[0].id
+                cands = mod.functions_named(wname)
+                # several kernels each nest a `local` — bind to the one
+                # scoped under the ENCLOSING function, not the first
+                scoped = [c for c in cands
+                          if sym and c.qualname == f"{sym}.{wname}"]
+                if scoped:
+                    wrapped = scoped[0]
+                elif len(cands) == 1:
+                    wrapped = cands[0]
+            if wrapped is not None and \
+                    isinstance(in_specs, ast.Tuple):
+                want = _positional_param_count(wrapped)
+                got = len(in_specs.elts)
+                if got != want:
+                    out.append(Finding(
+                        "GL903", mod.relpath, node.lineno,
+                        f"in_specs has {got} spec(s) but "
+                        f"{wrapped.name} takes {want} positional "
+                        "argument(s) — the mapping is misaligned",
+                        sym))
+            if wrapped is not None and \
+                    isinstance(out_specs, ast.Tuple):
+                rets = [n2 for n2 in body_nodes(wrapped)
+                        if isinstance(n2, ast.Return)
+                        and n2.value is not None]
+                tuple_lens = {len(r.value.elts) for r in rets
+                              if isinstance(r.value, ast.Tuple)}
+                if len(rets) and len(tuple_lens) == 1 and \
+                        all(isinstance(r.value, ast.Tuple)
+                            for r in rets):
+                    want = tuple_lens.pop()
+                    got = len(out_specs.elts)
+                    if got != want:
+                        out.append(Finding(
+                            "GL903", mod.relpath, node.lineno,
+                            f"out_specs has {got} spec(s) but "
+                            f"{wrapped.name} returns {want} value(s)",
+                            sym))
+            if model.declared_axes:
+                for spec in (in_specs, out_specs):
+                    if spec is None:
+                        continue
+                    for axis, line in _pspec_axes(spec, mod, model):
+                        if axis not in model.declared_axes:
+                            out.append(Finding(
+                                "GL903", mod.relpath, line,
+                                f"PartitionSpec axis {axis!r} is not "
+                                "a declared mesh axis "
+                                f"({sorted(model.declared_axes)})",
+                                sym))
+    return out
+
+
+def _check_gl904(project: Project, model: ContractModel
+                 ) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in project.modules.values():
+        for fn in mod.functions:
+            for node in body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d is None:
+                    continue
+                tail = d.split(".")[-1]
+                if tail not in _COLLECTIVES:
+                    continue
+                head = d.split(".")[0]
+                full = mod.resolve_head(head) or head
+                if not (full.split(".")[0] == "jax" or head in
+                        ("lax", "jax") or
+                        (mod.from_imports.get(tail, "")
+                         .startswith("jax"))):
+                    continue
+                axis_node = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_node = kw.value
+                idx = _COLLECTIVES[tail]
+                if axis_node is None and len(node.args) > idx:
+                    axis_node = node.args[idx]
+                axis = (model.resolve_axis(axis_node, mod)
+                        if axis_node is not None else None)
+                if id(fn) not in model.shard_reachable:
+                    out.append(Finding(
+                        "GL904", mod.relpath, node.lineno,
+                        f"collective `{tail}` executes in a function "
+                        "never wrapped by shard_map — its axis "
+                        f"{axis!r} is unbound at trace time",
+                        fn.qualname))
+                elif axis is not None and model.declared_axes and \
+                        axis not in model.declared_axes:
+                    out.append(Finding(
+                        "GL904", mod.relpath, node.lineno,
+                        f"collective `{tail}` names axis {axis!r}, "
+                        "which no mesh declaration binds "
+                        f"({sorted(model.declared_axes)})",
+                        fn.qualname))
+    return out
+
+
+def check(project: Project) -> List[Finding]:
+    model = get_model(project)
+    return (_check_gl901(project, model)
+            + _check_gl902(project, model)
+            + _check_gl903(project, model)
+            + _check_gl904(project, model))
