@@ -1070,6 +1070,10 @@ def run_bench():
                             "recall_at_10": round(rec, 4),
                             "ci": [round(lo, 4), round(hi, 4)],
                             "queries": qn,
+                            # reproducibility stamp (ISSUE 17): the
+                            # index config this row was measured under
+                            "non_default_params": dict(
+                                idx_p.params.non_default_items()),
                         })
                     except Exception as e:               # noqa: BLE001
                         result.setdefault("pareto_errors", {})[
@@ -1107,6 +1111,8 @@ def run_bench():
                         "recall_at_10": round(rec, 4),
                         "ci": [round(lo, 4), round(hi, 4)],
                         "queries": qn,
+                        "non_default_params": dict(
+                            flat_a.params.non_default_items()),
                     })
                 if rt_rows:
                     pareto["flat_approx"] = rt_rows
@@ -1144,6 +1150,8 @@ def run_bench():
                         "recall_at_10": round(rec, 4),
                         "ci": [round(lo, 4), round(hi, 4)],
                         "queries": qn,
+                        "non_default_params": dict(
+                            flat_c.params.non_default_items()),
                     })
                 if cs_rows:
                     pareto["flat_cascade"] = cs_rows
@@ -1182,6 +1190,21 @@ def run_bench():
                     index, queries, k, sb_load)
             except Exception as e:                       # noqa: BLE001
                 result["loadgen_error"] = repr(e)[:300]
+            checkpoint()
+
+        # offline-autotuner replay (ISSUE 17 satellite): sweep the
+        # headline index with tools/autotune.py, emit the config
+        # artifact, re-apply it through the serve-path helper and
+        # measure at the chosen operating point — benchdiff watches
+        # autotune.qps_at_slo / autotune.recall_at_10, so "the tuner
+        # started choosing worse points" is a gated regression
+        sb_at = _stage_budget(result, "autotune", budget_s, 90.0, 30.0)
+        if sb_at is not None:
+            try:
+                result["autotune"] = _autotune_measure(
+                    index, queries, truth, k, sb_at)
+            except Exception as e:                       # noqa: BLE001
+                result["autotune_error"] = repr(e)[:300]
             checkpoint()
 
         # mixed read/write mutation stage (ISSUE 9): 95/5 reads vs a
@@ -1340,6 +1363,58 @@ def _capacity_measure(data, queries, k, budget_s):
     return out
 
 
+def _autotune_measure(index, queries, truth, k, budget_s):
+    """Offline-autotuner replay stage (ISSUE 17): run the tools/autotune
+    sweep + Pareto choice on the headline index, emit the INI+JSON
+    artifact into the run directory, apply it back through the
+    serve-path helper (the exact code [Service] AutotuneConfig= runs at
+    server start) and report the operating point actually delivered.
+    The index's pre-stage MaxCheck is restored afterwards — later
+    stages must measure the configured index, not the tuned one."""
+    import tempfile
+
+    from tools import autotune as autotune_mod
+
+    grid = [int(t) for t in os.environ.get(
+        "BENCH_AUTOTUNE_MAXCHECKS", "256,512,1024,2048,4096").split(",")]
+    target = float(os.environ.get("BENCH_AUTOTUNE_RECALL_TARGET", "0.9"))
+    prior_max_check = index.params.get_param("MaxCheck")
+    deadline = time.monotonic() + max(_remaining(budget_s), 10.0)
+    out = {"grid": grid, "recall_target": target}
+    try:
+        points, dropped = autotune_mod.sweep(
+            index, queries, truth, k, grid, deadline=deadline)
+        frontier, dominated = autotune_mod.pareto_frontier(points)
+        chosen, gated_out = autotune_mod.choose(frontier, target)
+        if chosen is None:
+            out["error"] = "no measurable points"
+            return out
+        art_dir = tempfile.mkdtemp(prefix="bench-autotune-")
+        paths = autotune_mod.emit(
+            art_dir, chosen, frontier, dominated + gated_out, target,
+            autotune_mod.fingerprint_array(queries),
+            extra={"k": k, "grid": grid, "grid_dropped": dropped})
+        rep = autotune_mod.replay(index, queries, truth, k,
+                                  paths["ini"])
+        out.update({
+            "chosen": chosen,
+            "frontier_points": len(frontier),
+            "rejected_points": len(dominated) + len(gated_out),
+            "grid_dropped": dropped,
+            "artifact": paths,
+            # the benchdiff lines: capacity at the recall-SLO operating
+            # point, and the recall actually delivered there
+            "qps_at_slo": rep["qps"],
+            "recall_at_10": rep["recall_at_10"],
+            "ci": rep["ci"],
+            "applied_params": rep["applied_params"],
+        })
+        return out
+    finally:
+        if prior_max_check is not None:
+            index.set_parameter("MaxCheck", prior_max_check)
+
+
 def _loadgen_measure(index, queries, k, budget_s):
     """Open-loop load-generator stage (ISSUE 8 satellite): drive a real
     SearchServer (admission control ON, a default deadline armed) over
@@ -1369,7 +1444,11 @@ def _loadgen_measure(index, queries, k, budget_s):
     start_qps = float(os.environ.get("BENCH_LOADGEN_START_QPS", "64"))
     max_qps = float(os.environ.get("BENCH_LOADGEN_MAX_QPS", "8192"))
     out = {"slo_ms": slo_ms, "step_s": step_s, "steps": [],
-           "steps_dropped": []}
+           "steps_dropped": [],
+           # reproducibility stamp (ISSUE 17): the served index's
+           # active non-default params — autotuner baselines need to
+           # know what config the capacity number was measured under
+           "non_default_params": dict(index.params.non_default_items())}
     from sptag_tpu.utils import hostprof
 
     counter_names = ("server.admission_sheds", "admission.sheds",

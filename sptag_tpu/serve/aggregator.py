@@ -45,6 +45,7 @@ from typing import List, Optional, Tuple
 
 from sptag_tpu.serve import admission as admission_mod
 from sptag_tpu.serve import canary as canary_mod
+from sptag_tpu.serve import controller as controller_mod
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve import slo as slo_mod
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
@@ -291,7 +292,13 @@ class AggregatorContext:
                  slo_page_burn: float = 4.0,
                  canary_interval_ms: float = 0.0,
                  canary_probe_file: str = "",
-                 canary_k: int = 10):
+                 canary_k: int = 10,
+                 controller: bool = False,
+                 controller_cooldown_ms: float = 10000.0,
+                 controller_hold_ms: float = 30000.0,
+                 controller_revert_window_ms: float = 15000.0,
+                 controller_max_check_floor: int = 256,
+                 controller_recall_floor: float = 0.0):
         self.listen_addr = listen_addr
         self.listen_port = listen_port
         self.search_timeout_s = search_timeout_s
@@ -395,6 +402,15 @@ class AggregatorContext:
         self.canary_interval_ms = canary_interval_ms
         self.canary_probe_file = canary_probe_file
         self.canary_k = canary_k
+        # online controller (serve/controller.py, ISSUE 17): on this
+        # tier the actuators are the hedge percentile and the admission
+        # degrade floor — [Service] parity with the shard tier
+        self.controller = controller
+        self.controller_cooldown_ms = controller_cooldown_ms
+        self.controller_hold_ms = controller_hold_ms
+        self.controller_revert_window_ms = controller_revert_window_ms
+        self.controller_max_check_floor = controller_max_check_floor
+        self.controller_recall_floor = controller_recall_floor
         self.servers: List[RemoteServer] = []
 
     @classmethod
@@ -516,6 +532,19 @@ class AggregatorContext:
                 "Service", "CanaryProbeFile", ""),
             canary_k=int(reader.get_parameter(
                 "Service", "CanaryK", "10")),
+            controller=reader.get_parameter(
+                "Service", "Controller", "0").lower() in
+            ("1", "true", "on", "yes"),
+            controller_cooldown_ms=float(reader.get_parameter(
+                "Service", "ControllerCooldownMs", "10000")),
+            controller_hold_ms=float(reader.get_parameter(
+                "Service", "ControllerHoldMs", "30000")),
+            controller_revert_window_ms=float(reader.get_parameter(
+                "Service", "ControllerRevertWindowMs", "15000")),
+            controller_max_check_floor=int(reader.get_parameter(
+                "Service", "ControllerMaxCheckFloor", "256")),
+            controller_recall_floor=float(reader.get_parameter(
+                "Service", "ControllerRecallFloor", "0")),
         )
         if ctx.lock_contention_ledger:
             # arm before any client/connection locks are created (the
@@ -631,6 +660,8 @@ class AggregatorService:
         # serving timeline + SLO engine + canary (ISSUE 15)
         self._slo: Optional[slo_mod.SloEngine] = None
         self._canary: Optional[canary_mod.CanaryProber] = None
+        # closed loop (ISSUE 17)
+        self._controller: Optional[controller_mod.Controller] = None
         _services.add(self)
 
     def _admission_signals(self) -> dict:
@@ -682,6 +713,12 @@ class AggregatorService:
         if self._canary is not None:
             out["canary"] = self._canary.snapshot()
         return out
+
+    def _controller_debug(self) -> dict:
+        """GET /debug/controller payload for this tier."""
+        if self._controller is None:
+            return {"enabled": False, "tier": "aggregator"}
+        return self._controller.snapshot()
 
     async def start(self, host: Optional[str] = None,
                     port: Optional[int] = None):
@@ -735,6 +772,34 @@ class AggregatorService:
         if slo_mod.armed(slo_cfg):
             self._slo = slo_mod.SloEngine(slo_cfg, tier="aggregator")
             timeline.add_tick_listener(self._slo.evaluate)
+        ctl_cfg = controller_mod.config_from_settings(self.context)
+        if controller_mod.armed(ctl_cfg):
+            # closed loop (ISSUE 17): this tier has no MaxCheck — its
+            # actuators are the admission degrade floor and the hedge
+            # trigger percentile (lower = hedge sooner, shorter tail at
+            # more duplicate work), all via the live-actuation registry
+            if self._slo is None:
+                log.warning("Controller=1 but no SLO objective "
+                            "declared; controller stays off")
+            else:
+                self._controller = controller_mod.Controller(
+                    ctl_cfg, tier="aggregator")
+                self._controller.bind_slo(self._slo)
+                if self._admission is not None:
+                    adm_cfg = self._admission.config
+                    self._controller.bind_tier_knob(
+                        "DegradeMaxCheckFloor",
+                        read=lambda c=adm_cfg: float(
+                            c.degrade_max_check_floor),
+                        apply=lambda v, c=adm_cfg: setattr(
+                            c, "degrade_max_check_floor", int(v)))
+                ctx = self.context
+                self._controller.bind_tier_knob(
+                    "HedgePercentile",
+                    read=lambda: float(ctx.hedge_percentile),
+                    apply=lambda v: setattr(ctx, "hedge_percentile",
+                                            float(v)))
+                timeline.add_tick_listener(self._controller.evaluate)
         if self.context.metrics_port:
             # bind first: a metrics-port clash must fail start() before
             # backend connections, the reconnect task, or the listen
@@ -743,7 +808,8 @@ class AggregatorService:
                 self.context.metrics_port, health=self._healthz,
                 host=self.context.metrics_host,
                 admission=self._admission_debug,
-                slo=self._slo_debug)
+                slo=self._slo_debug,
+                controller=self._controller_debug)
             self._metrics_http.start()
         # cross-host demotion advisory (ISSUE 11): with in-mesh serving
         # (parallel/sharded.py + [Service] MeshServe) same-host shards
@@ -799,6 +865,9 @@ class AggregatorService:
             self._canary = None
             await asyncio.get_event_loop().run_in_executor(
                 None, canary_ref.stop)
+        if self._controller is not None:
+            timeline.remove_tick_listener(self._controller.evaluate)
+            self._controller = None
         if self._slo is not None:
             timeline.remove_tick_listener(self._slo.evaluate)
             self._slo = None
@@ -1063,13 +1132,17 @@ class AggregatorService:
                                 result.status).name
                         except ValueError:
                             status_name = str(result.status)
+                        cepoch = ("" if self._controller is None
+                                  else " cepoch=%d"
+                                  % self._controller.epoch)
                         token = metrics.set_request_id(rid)
                         try:
                             log.warning(
                                 "slow query rid=%s total=%.2fms status=%s "
-                                "results=%d", rid or "-", total * 1000.0,
+                                "results=%d%s", rid or "-", total * 1000.0,
                                 status_name,
-                                sum(len(r.ids) for r in result.results))
+                                sum(len(r.ids) for r in result.results),
+                                cepoch)
                         finally:
                             metrics.reset_request_id(token)
         except (asyncio.IncompleteReadError, ConnectionResetError):

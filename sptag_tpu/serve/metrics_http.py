@@ -143,7 +143,8 @@ class MetricsHttpServer:
                  host: str = "127.0.0.1",
                  admission: Optional[Callable[[], Dict]] = None,
                  mutation: Optional[Callable[[], Dict]] = None,
-                 slo: Optional[Callable[[], Dict]] = None):
+                 slo: Optional[Callable[[], Dict]] = None,
+                 controller: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.health = health
@@ -156,6 +157,10 @@ class MetricsHttpServer:
         # GET /debug/slo callback (serve/slo.py, ISSUE 15): declared
         # objectives, burn rates and state per objective
         self.slo = slo
+        # GET /debug/controller callback (serve/controller.py, ISSUE
+        # 17): the control loop's inputs, actuator positions and the
+        # bounded decision-audit ring
+        self.controller = controller
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -171,6 +176,7 @@ class MetricsHttpServer:
             "/debug/devicetrace": self._route_devicetrace,
             "/debug/timeline": self._route_timeline,
             "/debug/slo": self._route_slo,
+            "/debug/controller": self._route_controller,
         }
 
     def routes(self) -> List[str]:
@@ -266,6 +272,16 @@ class MetricsHttpServer:
             state = self.slo() if self.slo else {"enabled": False}
         except Exception:                                # noqa: BLE001
             log.exception("slo callback failed")
+            state = {"enabled": False, "error": True}
+        return json.dumps(state).encode(), _JSON, 200
+
+    def _route_controller(self, params: Dict[str, str]
+                          ) -> Tuple[bytes, str, int]:
+        try:
+            state = (self.controller() if self.controller
+                     else {"enabled": False})
+        except Exception:                                # noqa: BLE001
+            log.exception("controller callback failed")
             state = {"enabled": False, "error": True}
         return json.dumps(state).encode(), _JSON, 200
 

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 
 from sptag_tpu.serve import admission as admission_mod
 from sptag_tpu.serve import canary as canary_mod
+from sptag_tpu.serve import controller as controller_mod
 from sptag_tpu.serve import protocol, wire
 from sptag_tpu.serve import slo as slo_mod
 from sptag_tpu.serve.metrics_http import MetricsHttpServer
@@ -60,7 +61,9 @@ class SearchServer:
                  host_prof_dump_on_slow_query: Optional[bool] = None,
                  timeline_interval_ms: Optional[float] = None,
                  canary_interval_ms: Optional[float] = None,
-                 slo_config: Optional[slo_mod.SloConfig] = None):
+                 slo_config: Optional[slo_mod.SloConfig] = None,
+                 controller_config: Optional[
+                     controller_mod.ControllerConfig] = None):
         self.context = context
         self.executor = SearchExecutor(context)
         self.batch_window = batch_window_ms / 1000.0
@@ -164,6 +167,10 @@ class SearchServer:
         self._slo_config = (slo_config if slo_config is not None
                             else slo_mod.config_from_settings(
                                 context.settings))
+        self._controller_config = (
+            controller_config if controller_config is not None
+            else controller_mod.config_from_settings(context.settings))
+        self._controller: Optional[controller_mod.Controller] = None
         self._slo: Optional[slo_mod.SloEngine] = None
         self._canary: Optional[canary_mod.CanaryProber] = None
         # connections whose decoded rids identified them as canary
@@ -286,6 +293,29 @@ class SearchServer:
             self._slo = slo_mod.SloEngine(self._slo_config,
                                           tier=self.flight_tier)
             timeline.add_tick_listener(self._slo.evaluate)
+        if controller_mod.armed(self._controller_config):
+            # closed loop (ISSUE 17): the controller acts on the SLO
+            # engine's judgement — with no declared objective there is
+            # nothing to act on, so the loop stays open rather than
+            # actuating blind
+            if self._slo is None:
+                log.warning("Controller=1 but no SLO objective "
+                            "declared; controller stays off")
+            else:
+                self._controller = controller_mod.Controller(
+                    self._controller_config, tier=self.flight_tier)
+                self._controller.bind_slo(self._slo)
+                for name, index in self.context.indexes.items():
+                    self._controller.bind_index(name, index)
+                if self.admission is not None:
+                    adm_cfg = self.admission.config
+                    self._controller.bind_tier_knob(
+                        "DegradeMaxCheckFloor",
+                        read=lambda c=adm_cfg: float(
+                            c.degrade_max_check_floor),
+                        apply=lambda v, c=adm_cfg: setattr(
+                            c, "degrade_max_check_floor", int(v)))
+                timeline.add_tick_listener(self._controller.evaluate)
         if self.metrics_port:
             # bind the metrics listener FIRST: an EADDRINUSE here must
             # fail start() before the serve socket accepts or the batcher
@@ -295,7 +325,8 @@ class SearchServer:
                 host=self.context.settings.metrics_host,
                 admission=self._admission_debug,
                 mutation=self._mutation_debug,
-                slo=self._slo_debug)
+                slo=self._slo_debug,
+                controller=self._controller_debug)
             self._metrics_http.start()
         self._server = await asyncio.start_server(self._on_client, host, port)
         self._batcher_task = asyncio.create_task(self._batcher())
@@ -323,6 +354,9 @@ class SearchServer:
             self._canary = None
             await asyncio.get_event_loop().run_in_executor(
                 None, canary_ref.stop)
+        if self._controller is not None:
+            timeline.remove_tick_listener(self._controller.evaluate)
+            self._controller = None
         if self._slo is not None:
             timeline.remove_tick_listener(self._slo.evaluate)
             self._slo = None
@@ -384,6 +418,14 @@ class SearchServer:
         if self._canary is not None:
             out["canary"] = self._canary.snapshot()
         return out
+
+    def _controller_debug(self) -> dict:
+        """GET /debug/controller payload: the control loop's full
+        decision picture — current inputs, actuator positions vs
+        baselines, and the audit ring."""
+        if self._controller is None:
+            return {"enabled": False, "tier": self.flight_tier}
+        return self._controller.snapshot()
 
     def _mutation_debug(self) -> dict:
         """GET /debug/mutation payload: per-index swap/durability state
@@ -881,6 +923,11 @@ class SearchServer:
                 sched += " gflops=%.2f" % st["gflops"]
                 if "pct_peak" in st:
                     sched += " pct_peak=%.3f" % st["pct_peak"]
+            if self._controller is not None:
+                # ISSUE 17: which controller state served this query —
+                # lines up a slow query against the actuation history
+                # at /debug/controller by epoch
+                sched += " cepoch=%d" % self._controller.epoch
             token = metrics.set_request_id(rid)
             try:
                 log.warning(

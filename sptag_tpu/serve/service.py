@@ -228,6 +228,24 @@ class ServiceSettings:
     canary_interval_ms: float = 0.0
     canary_probes: int = 8
     canary_k: int = 10
+    # online controller (serve/controller.py, ISSUE 17): Controller=1
+    # arms the SLO-driven closed loop — burn-rate state + canary recall
+    # drive bounded, reversible, fully audited live actuations of the
+    # knobs in the core/params live-actuation registry.  Requires
+    # declared SLO objectives (the controller's judgement input).  Off
+    # (default): no controller, no tick listener, serve bytes
+    # byte-identical.
+    controller: bool = False
+    controller_cooldown_ms: float = 10000.0
+    controller_hold_ms: float = 30000.0
+    controller_revert_window_ms: float = 15000.0
+    controller_max_check_floor: int = 256
+    controller_recall_floor: float = 0.0
+    # offline autotuner artifact (tools/autotune.py): path to the
+    # emitted INI fragment, applied to every loaded index at start
+    # through set_parameter (unknown keys logged and skipped).  "" =
+    # no artifact.
+    autotune_config: str = ""
 
 
 class ServiceContext:
@@ -372,6 +390,21 @@ class ServiceContext:
                 "Service", "CanaryProbes", "8")),
             canary_k=int(reader.get_parameter(
                 "Service", "CanaryK", "10")),
+            controller=reader.get_parameter(
+                "Service", "Controller", "0").lower() in
+            ("1", "true", "on", "yes"),
+            controller_cooldown_ms=float(reader.get_parameter(
+                "Service", "ControllerCooldownMs", "10000")),
+            controller_hold_ms=float(reader.get_parameter(
+                "Service", "ControllerHoldMs", "30000")),
+            controller_revert_window_ms=float(reader.get_parameter(
+                "Service", "ControllerRevertWindowMs", "15000")),
+            controller_max_check_floor=int(reader.get_parameter(
+                "Service", "ControllerMaxCheckFloor", "256")),
+            controller_recall_floor=float(reader.get_parameter(
+                "Service", "ControllerRecallFloor", "0")),
+            autotune_config=reader.get_parameter(
+                "Service", "AutotuneConfig", ""),
         )
         if s.lock_sanitizer:
             # before the indexes load: their writer locks must be created
@@ -418,10 +451,54 @@ class ServiceContext:
                 log.info("loaded index %s from %s", name, folder)
             except Exception:
                 log.exception("Failed loading index: %s", name)
+        if s.autotune_config:
+            apply_autotune_artifact(ctx, s.autotune_config)
         return ctx
 
     def add_index(self, name: str, index: VectorIndex) -> None:
         self.indexes[name] = index
+
+
+def apply_autotune_artifact(ctx: ServiceContext, path: str) -> int:
+    """Apply an autotuner-emitted INI fragment (tools/autotune.py) to
+    the loaded indexes at start: ``[Index]`` keys go to every index,
+    ``[Index_<name>]`` keys to that index only.  Values flow through
+    `set_parameter` — the same live-apply path the online controller
+    uses — so an artifact can only change what an operator could.
+    Returns the number of applied (index, key) pairs; unknown keys and
+    missing index names are logged and skipped (an artifact from a
+    newer build must not take down an older server)."""
+    try:
+        reader = IniReader.load(path)
+    except OSError:
+        log.exception("autotune artifact unreadable: %s", path)
+        return 0
+    applied = 0
+    for section in reader.sections():
+        low = section.lower()
+        if low == "index":
+            targets = list(ctx.indexes.items())
+        elif low.startswith("index_"):
+            name = section[len("index_"):]
+            if name not in ctx.indexes:
+                log.warning("autotune artifact names unknown index %s",
+                            name)
+                continue
+            targets = [(name, ctx.indexes[name])]
+        else:
+            continue
+        for key, value in reader.section_items(section).items():
+            for name, index in targets:
+                if index.set_parameter(key, value):
+                    applied += 1
+                    log.info("autotune apply index=%s %s=%s",
+                             name, key, value)
+                else:
+                    log.warning("autotune artifact key %s rejected by "
+                                "index %s", key, name)
+    if applied:
+        metrics.inc("autotune.applied_params", applied)
+    return applied
 
 
 class SearchExecutor:

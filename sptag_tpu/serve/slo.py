@@ -244,6 +244,18 @@ class SloEngine:
 
     # ------------------------------------------------------------- surface
 
+    def worst(self) -> "tuple[str, str, float]":
+        """(state, objective_name, burn_fast) of the worst objective —
+        the online controller's primary input.  Worst = highest state
+        code, burn_fast breaking ties, so the controller always reacts
+        to the objective that is actually paging."""
+        with self._lock:
+            if not self._objectives:
+                return OK, "", 0.0
+            o = max(self._objectives,
+                    key=lambda o: (_STATE_CODE[o.state], o.burn_fast))
+            return o.state, o.name, o.burn_fast
+
     def snapshot(self) -> dict:
         """The /debug/slo payload."""
         c = self.config

@@ -156,11 +156,13 @@ def _reset_telemetry_registries():
     event assertion in one test would see every earlier test's serving
     traffic (and the suite's pass/fail would depend on execution order)."""
     from sptag_tpu.algo import scheduler
+    from sptag_tpu.serve import ctlaudit
     from sptag_tpu.utils import (devmem, faultinject, flightrec, hostprof,
                                  locksan, metrics, qualmon,
                                  recompile_guard, timeline, trace)
 
     trace.reset()
+    ctlaudit.reset()
     metrics.reset()
     flightrec.reset()
     devmem.reset()

@@ -1,0 +1,237 @@
+"""Offline autotuner (tools/autotune.py, ISSUE 17 tentpole a).
+
+Units: Pareto-frontier split with dominated-by reasons, the Wilson-CI
+recall gate in choose() (including the no-point-clears fallback and its
+gate_met=False honesty bit), deadline drops recorded by sweep(), the
+corpus fingerprint, registry validation at emit(), and the benchdiff
+regression gate in both directions.
+
+E2e: a real sweep -> emit -> replay round trip on a tiny FLAT corpus,
+where replay applies the artifact through service.apply_autotune_artifact
+— the EXACT code path a server start with [Service] AutotuneConfig= runs
+— plus the full CLI.
+"""
+
+import configparser
+import json
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.core import params as core_params
+from tools import autotune
+
+
+def _point(max_check, qps, recall, ci_lo=None):
+    return {"max_check": max_check, "qps": qps, "recall_at_10": recall,
+            "ci": [recall if ci_lo is None else ci_lo,
+                   min(recall + 0.02, 1.0)],
+            "queries": 64, "non_default_params": {}}
+
+
+def _flat_corpus(n=300, dim=8, n_queries=32, k=5, seed=3):
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((n, dim)).astype(np.float32)
+    queries = rng.standard_normal((n_queries, dim)).astype(np.float32)
+    index = sp.create_instance("FLAT", "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    _, truth = index.exact_search_batch(queries, k)
+    return index, data, queries, np.asarray(truth)
+
+
+# ---------------------------------------------------------------------------
+# frontier + choice units
+# ---------------------------------------------------------------------------
+
+def test_pareto_frontier_rejects_dominated_with_reason():
+    pts = [_point(256, 900.0, 0.80),
+           _point(512, 500.0, 0.90),
+           _point(1024, 450.0, 0.85),    # dominated by 512 on both axes
+           _point(2048, 200.0, 0.97)]
+    frontier, rejected = autotune.pareto_frontier(pts)
+    assert [p["max_check"] for p in frontier] == [256, 512, 2048]
+    assert len(rejected) == 1
+    assert rejected[0]["max_check"] == 1024
+    assert rejected[0]["reason"] == "dominated by max_check=512"
+
+
+def test_choose_gates_on_wilson_lower_bound_not_point_estimate():
+    """A point whose recall POINT estimate clears the target but whose
+    CI lower bound does not is rejected — thin query sets cannot fake
+    health."""
+    frontier = [_point(256, 900.0, 0.91, ci_lo=0.86),
+                _point(512, 500.0, 0.95, ci_lo=0.92)]
+    chosen, rejected = autotune.choose(frontier, recall_target=0.90)
+    assert chosen["max_check"] == 512 and chosen["gate_met"] is True
+    assert len(rejected) == 1 and rejected[0]["max_check"] == 256
+    assert "ci_lo" in rejected[0]["reason"]
+    assert "recall target" in rejected[0]["reason"]
+
+
+def test_choose_highest_qps_among_gate_clearing_points():
+    frontier = [_point(512, 500.0, 0.95, ci_lo=0.93),
+                _point(2048, 200.0, 0.99, ci_lo=0.97)]
+    chosen, rejected = autotune.choose(frontier, recall_target=0.90)
+    assert chosen["max_check"] == 512      # fastest point that clears
+    assert rejected == []
+
+
+def test_choose_fallback_admits_it_missed_the_gate():
+    """No point clears the target -> highest recall wins but the
+    artifact says gate_met=False (a tuner that silently under-delivers
+    recall is worse than no tuner)."""
+    frontier = [_point(256, 900.0, 0.80, ci_lo=0.75),
+                _point(512, 500.0, 0.90, ci_lo=0.87)]
+    chosen, _rejected = autotune.choose(frontier, recall_target=0.95)
+    assert chosen["max_check"] == 512
+    assert chosen["gate_met"] is False
+
+
+def test_choose_empty_frontier():
+    chosen, rejected = autotune.choose([], recall_target=0.9)
+    assert chosen is None and rejected == []
+
+
+def test_fingerprint_binds_to_the_data():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    assert autotune.fingerprint_array(a) == autotune.fingerprint_array(
+        a.copy())
+    b = a.copy()
+    b[0, 0] += 1.0
+    assert autotune.fingerprint_array(a) != autotune.fingerprint_array(b)
+    assert autotune.fingerprint_array(a) != autotune.fingerprint_array(
+        a.astype(np.float64))
+
+
+# ---------------------------------------------------------------------------
+# sweep: bounded grid, recorded drops
+# ---------------------------------------------------------------------------
+
+def test_sweep_records_deadline_drops_never_silent():
+    index, _data, queries, truth = _flat_corpus()
+    import time
+
+    points, dropped = autotune.sweep(
+        index, queries, truth, 5, [64, 128, 256],
+        deadline=time.monotonic() - 1.0)
+    assert points == []
+    assert dropped == [64, 128, 256]
+
+
+def test_sweep_bounds_grid_through_registry():
+    index, _data, queries, truth = _flat_corpus()
+    points, dropped = autotune.sweep(index, queries, truth, 5, [1, 100])
+    assert dropped == []
+    # 1 clamps up to the registry lo (64); 100 quantizes down to 64
+    assert [p["max_check"] for p in points] == [64, 64]
+    assert all("non_default_params" in p for p in points)
+
+
+# ---------------------------------------------------------------------------
+# emit -> replay round trip (the serve-path application)
+# ---------------------------------------------------------------------------
+
+def test_emit_replay_roundtrip_and_provenance(tmp_path):
+    index, data, queries, truth = _flat_corpus()
+    chosen = _point(512, 500.0, 0.95, ci_lo=0.93)
+    chosen["gate_met"] = True
+    rejected = [dict(_point(1024, 450.0, 0.85),
+                     reason="dominated by max_check=512")]
+    paths = autotune.emit(
+        str(tmp_path), chosen, [chosen], rejected,
+        recall_target=0.9,
+        corpus_fingerprint=autotune.fingerprint_array(data),
+        extra={"algo": "FLAT", "k": 5})
+    # the INI fragment is a plain [Index] section a server can apply
+    cp = configparser.ConfigParser()
+    cp.read(paths["ini"])
+    assert cp["Index"]["MaxCheck"] == "512"
+    # full provenance in the JSON twin
+    prov = json.loads(open(paths["json"]).read())
+    assert prov["schema_version"] == autotune.SCHEMA_VERSION
+    assert prov["git_rev"]
+    assert prov["corpus_fingerprint"] == autotune.fingerprint_array(data)
+    assert prov["knobs"] == {"MaxCheck": 512}
+    assert prov["chosen"]["gate_met"] is True
+    assert prov["rejected"][0]["reason"] == "dominated by max_check=512"
+    assert prov["algo"] == "FLAT"
+    # replay applies through service.apply_autotune_artifact (the real
+    # server-start path) and measures AS CONFIGURED
+    assert index.params.max_check != 512
+    rep = autotune.replay(index, queries, truth, 5, paths["ini"])
+    assert index.params.max_check == 512
+    assert rep["applied_params"] == 1
+    assert rep["qps"] > 0
+    assert "max_check" not in rep          # measured as-configured
+
+
+def test_emit_validates_knobs_against_registry(tmp_path):
+    chosen = _point(512, 500.0, 0.95)
+    chosen["knobs"] = {"BKTKmeansK": 32}   # not a live knob
+    with pytest.raises(core_params.UnknownActuationError):
+        autotune.emit(str(tmp_path), chosen, [chosen], [], 0.9, "abc")
+
+
+# ---------------------------------------------------------------------------
+# the benchdiff regression gate
+# ---------------------------------------------------------------------------
+
+def test_gate_flags_qps_regression_and_passes_parity(tmp_path):
+    baseline = tmp_path / "autotune.json"
+    baseline.write_text(json.dumps({
+        "schema_version": 1,
+        "chosen": {"qps": 100.0, "recall_at_10": 0.95}}))
+    ok, lines = autotune.gate({"qps": 40.0, "recall_at_10": 0.95},
+                              str(baseline))
+    assert not ok
+    assert any("REGRESSED" in ln for ln in lines)
+    ok, lines = autotune.gate({"qps": 101.0, "recall_at_10": 0.95},
+                              str(baseline))
+    assert ok
+    assert any("autotune.qps_at_slo" in ln for ln in lines)
+    assert any("autotune.recall_at_10" in ln for ln in lines)
+
+
+def test_gate_flags_recall_regression(tmp_path):
+    baseline = tmp_path / "autotune.json"
+    baseline.write_text(json.dumps({
+        "schema_version": 1,
+        "chosen": {"qps": 100.0, "recall_at_10": 0.95}}))
+    ok, _lines = autotune.gate({"qps": 100.0, "recall_at_10": 0.80},
+                               str(baseline))
+    assert not ok
+
+
+# ---------------------------------------------------------------------------
+# CLI e2e on a tiny corpus
+# ---------------------------------------------------------------------------
+
+def test_cli_end_to_end_emits_and_self_gates(tmp_path, capsys):
+    out = tmp_path / "art"
+    rc = autotune.main([
+        "--out", str(out), "--algo", "FLAT", "--corpus", "400",
+        "--dim", "8", "--queries", "32", "--k", "5",
+        "--grid", "64,128", "--recall-target", "0.5",
+        "--budget-s", "60"])
+    assert rc == 0
+    assert (out / autotune.ARTIFACT_INI).exists()
+    prov = json.loads((out / autotune.ARTIFACT_JSON).read_text())
+    assert prov["chosen"]["max_check"] in (64, 128)
+    assert prov["grid"] == [64, 128]
+    assert prov["grid_dropped"] == []
+    # gate this run against its own artifact: parity must pass
+    rc = autotune.main([
+        "--out", str(tmp_path / "art2"), "--algo", "FLAT",
+        "--corpus", "400", "--dim", "8", "--queries", "32", "--k", "5",
+        "--grid", "64,128", "--recall-target", "0.5",
+        "--budget-s", "60",
+        "--gate", str(out / autotune.ARTIFACT_JSON)])
+    captured = capsys.readouterr()
+    assert "autotune: chose MaxCheck=" in captured.out
+    # qps on a tiny CPU corpus is noisy; the gate verdict itself is
+    # exercised deterministically in test_gate_* — here we only require
+    # the CLI to have run the gate and rendered its lines
+    assert "autotune.recall_at_10" in captured.out
+    assert rc in (0, 1)

@@ -1,0 +1,526 @@
+"""Closed-loop serving controller + live-actuation registry (ISSUE 17).
+
+Units: registry clamp/pow2-quantize/unknown-knob behavior; the
+fake-clock controller state machine — warn -> bounded step-down ->
+rate-limit hold -> recovery hold -> restore, worse-after-actuation
+auto-revert, the inviolable canary recall floor (including
+no-data-counts-as-below-floor), recall rescue bypassing the cooldown,
+at-floor holds, and tier-knob binding — plus the bounded ctlaudit ring.
+
+E2e: THE ISSUE 17 acceptance drill — a latency storm (index latency
+proportional to the live MaxCheck) drives the latency objective to
+``page`` and the controller autonomously lowers MaxCheck (pow2, never
+below the floor) until the tier returns to ``ok``, canary recall never
+dips below the floor, and the full decision trail is visible in the
+/debug/controller audit ring, flightrec ``controller_actuation``
+events, the ``controller.knob`` timeline series and cepoch= slow-query
+stamps.
+
+Off-parity: with Controller=0 (the default) the serve wire bytes are
+byte-identical, no controller object or audit entries exist
+(the ci_check.sh standalone pass).
+"""
+
+import json
+import logging
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sptag_tpu as sp
+from sptag_tpu.core import params as core_params
+from sptag_tpu.serve import ctlaudit, slo, wire
+from sptag_tpu.serve.controller import Controller, ControllerConfig
+from sptag_tpu.serve.server import SearchServer
+from sptag_tpu.serve.service import (SearchExecutor, ServiceContext,
+                                     ServiceSettings)
+from sptag_tpu.utils import metrics, timeline
+
+from conftest import ServerThread
+
+
+def _http_get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    return resp.status, body
+
+
+def _flat_index(n=50, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    idx = sp.create_instance("FLAT", "Float")
+    idx.set_parameter("DistCalcMethod", "L2")
+    idx.build(data)
+    return idx, data
+
+
+# ---------------------------------------------------------------------------
+# live-actuation registry (core/params.py)
+# ---------------------------------------------------------------------------
+
+def test_registry_clamp_bounds_and_pow2_quantize():
+    """Registry clamps to [lo, hi]; pow2 knobs quantize DOWN to a power
+    of two (static kernel shapes — never exceed the requested cost)."""
+    assert core_params.clamp_actuation("MaxCheck", 3000) == 2048.0
+    assert core_params.clamp_actuation("MaxCheck", 4096) == 4096.0
+    assert core_params.clamp_actuation("MaxCheck", 1) == 64.0      # lo
+    assert core_params.clamp_actuation("MaxCheck", 1 << 30) == float(1 << 20)
+    # non-pow2 knob passes through, bounded only
+    assert core_params.clamp_actuation("HedgePercentile", 120.0) == 99.9
+    assert core_params.clamp_actuation("HedgePercentile", 10.0) == 50.0
+    assert core_params.clamp_actuation("ApproxRecallTarget", 0.93) == 0.93
+    # TierBudget knobs keep 0 (= auto) reachable below the pow2 branch
+    assert core_params.clamp_actuation("TierBudgetSketch", 0) == 0.0
+
+
+def test_registry_unknown_knob_raises_never_noops():
+    """Actuating outside the registry is a control-plane bug: it
+    raises, it does not silently no-op (the ISSUE 17 satellite
+    contract)."""
+    with pytest.raises(core_params.UnknownActuationError):
+        core_params.actuation_spec("BKTKmeansK")
+    with pytest.raises(core_params.UnknownActuationError):
+        core_params.clamp_actuation("NumberOfThreads", 4)
+    idx, _ = _flat_index(n=10)
+    with pytest.raises(core_params.UnknownActuationError):
+        core_params.actuate_index(idx, "DistCalcMethod", 1)
+
+
+def test_actuate_index_applies_clamped_and_rejects_tier_scope():
+    """actuate_index goes through the index's own set_parameter (so
+    existing invalidation hooks fire) with the clamped value; tier-
+    scoped knobs are rejected at this surface."""
+    idx, _ = _flat_index(n=10)
+    applied = core_params.actuate_index(idx, "MaxCheck", 3000)
+    assert applied == 2048.0
+    assert idx.params.max_check == 2048
+    with pytest.raises(ValueError):
+        core_params.actuate_index(idx, "DegradeMaxCheckFloor", 512)
+
+
+# ---------------------------------------------------------------------------
+# fake-clock state machine
+# ---------------------------------------------------------------------------
+
+class _StubSlo:
+    """Duck-typed SloEngine: worst() is the controller's only read."""
+
+    def __init__(self):
+        self.state, self.objective, self.burn = slo.OK, "latency_p99", 0.0
+
+    def worst(self):
+        return self.state, self.objective, self.burn
+
+
+class _StubIndex:
+    """A real ParamSet behind the VectorIndex set_parameter surface."""
+
+    def __init__(self, max_check=8192):
+        self.params = core_params.FlatParams()
+        assert self.params.set_param("MaxCheck", str(max_check))
+
+    def set_parameter(self, name, value):
+        return self.params.set_param(name, value)
+
+
+def _mk(recall=None, **overrides):
+    cfg = ControllerConfig(
+        enabled=True, cooldown_ms=1000.0, hold_ms=2000.0,
+        revert_window_ms=500.0, recall_floor=0.0, max_check_floor=256)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    eng = _StubSlo()
+    idx = _StubIndex(8192)
+    ctl = Controller(cfg, tier="server",
+                     canary_recall=(recall or (lambda: None)))
+    ctl.bind_slo(eng)
+    ctl.bind_index("main", idx)
+    return ctl, eng, idx
+
+
+def _rules(outcome=None):
+    snap = ctlaudit.snapshot()
+    return [(e["rule"], e["outcome"]) for e in snap["entries"]
+            if outcome is None or e["outcome"] == outcome]
+
+
+def test_warn_steps_down_bounded_and_audited():
+    """WARN fires one pow2 step-down, bounded by the registry and the
+    tier floor, with a full audit entry and an epoch bump."""
+    ctl, eng, idx = _mk()
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)
+    assert idx.params.max_check == 4096
+    assert ctl.epoch == 1
+    snap = ctl.snapshot()
+    assert snap["pending_revert_check"] is True
+    assert snap["actuators"]["main.MaxCheck"]["current"] == 4096.0
+    assert snap["actuators"]["main.MaxCheck"]["baseline"] == 8192.0
+    assert snap["actuators"]["main.MaxCheck"]["floor"] == 256.0
+    (entry,) = ctlaudit.snapshot()["entries"]
+    assert entry["rule"] == "burn_step_down"
+    assert entry["outcome"] == "applied"
+    assert (entry["old"], entry["new"]) == (8192.0, 4096.0)
+    assert entry["inputs"]["slo"] == slo.WARN
+    assert entry["inputs"]["burn_fast"] == 2.0
+    assert entry["epoch"] == 1
+
+
+def test_rate_limit_one_actuation_per_cooldown():
+    """A second WARN tick inside the cooldown records a rate_limited
+    hold instead of moving the knob; after the cooldown the next step
+    fires."""
+    ctl, eng, idx = _mk()
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)                   # 8192 -> 4096
+    ctl.evaluate(now=0.6)                   # pending kept; cooldown holds
+    assert idx.params.max_check == 4096
+    assert ("rate_limit_hold", "rate_limited") in _rules()
+    ctl.evaluate(now=1.2)                   # cooldown elapsed
+    assert idx.params.max_check == 2048
+    assert ctl.epoch == 2
+
+
+def test_warn_actuate_hold_recover_restore_cycle():
+    """THE state-machine arc: warn -> step down; ok -> the pending
+    check lands `kept`; `hold_ms` of continuous calm then restores the
+    knob to baseline one step at a time."""
+    ctl, eng, idx = _mk()
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)
+    assert idx.params.max_check == 4096
+    eng.state, eng.burn = slo.OK, 0.0
+    ctl.evaluate(now=2.0)                   # resolves pending -> kept
+    assert _rules() == [("burn_step_down", "kept")]
+    ctl.evaluate(now=3.0)                   # calm for 1s < hold_ms
+    assert idx.params.max_check == 4096
+    ctl.evaluate(now=4.1)                   # calm 2.1s >= hold_ms
+    assert idx.params.max_check == 8192
+    assert _rules()[-1] == ("calm_step_up", "restored")
+    ctl.evaluate(now=6.2)                   # at baseline: nothing to do
+    assert ctlaudit.counters() == {"kept": 1, "restored": 1}
+    assert ctl.snapshot()["pending_revert_check"] is False
+
+
+def test_revert_on_worse_snaps_back_and_flips_verdict():
+    """If the driving burn grew past worse_ratio x while the revert
+    window was open, the knob snaps back: the original entry's verdict
+    flips to `reverted` and the undo is its own audited actuation."""
+    ctl, eng, idx = _mk()
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)
+    assert idx.params.max_check == 4096
+    eng.burn = 5.0                          # > 2.0 * worse_ratio(1.25)
+    ctl.evaluate(now=1.0)                   # window closed at 0.5
+    assert idx.params.max_check == 8192
+    rules = _rules()
+    assert ("burn_step_down", "reverted") in rules
+    assert ("revert_on_worse", "applied") in rules
+    assert ctl.epoch == 2
+    assert ctl.snapshot()["pending_revert_check"] is False
+
+
+def test_pending_window_judges_kept_when_not_worse():
+    """Same burn after the window -> the experiment is `kept` (no
+    revert churn on a step that did no harm)."""
+    ctl, eng, idx = _mk()
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)
+    ctl.evaluate(now=0.6)                   # still warn, burn unchanged
+    assert idx.params.max_check == 4096
+    assert ("burn_step_down", "kept") in _rules()
+
+
+def test_canary_floor_vetoes_step_down_and_no_data_counts_as_below():
+    """The recall floor is inviolable: a PAGE cannot buy latency with
+    recall below the floor — and a missing canary reading is treated
+    as below-floor, not as permission.  Held vetoes are throttled to
+    one audit entry per cooldown (ring hygiene)."""
+    reading = {"v": 0.5}
+    ctl, eng, idx = _mk(recall=lambda: reading["v"], recall_floor=0.9)
+    eng.state, eng.burn = slo.PAGE, 9.0
+    ctl.evaluate(now=0.0)
+    ctl.evaluate(now=0.1)                   # throttled: no second entry
+    assert idx.params.max_check == 8192     # never moved
+    assert ctl.epoch == 0
+    assert _rules() == [("canary_floor_veto", "vetoed")]
+    reading["v"] = None                     # prober dead: still vetoed
+    ctl.evaluate(now=2.0)
+    assert idx.params.max_check == 8192
+    assert _rules() == [("canary_floor_veto", "vetoed")] * 2
+    reading["v"] = 0.95                     # above floor: step proceeds
+    ctl.evaluate(now=4.0)
+    assert idx.params.max_check == 4096
+
+
+def test_recall_rescue_bypasses_cooldown():
+    """Recall under the floor while a knob sits below baseline fires an
+    immediate step back toward baseline — no cooldown, no hold."""
+    reading = {"v": 0.95}
+    ctl, eng, idx = _mk(recall=lambda: reading["v"], recall_floor=0.9)
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)
+    assert idx.params.max_check == 4096
+    eng.state, eng.burn = slo.OK, 0.0
+    reading["v"] = 0.5                      # the step cost too much
+    ctl.evaluate(now=0.1)                   # inside the 1000ms cooldown
+    assert idx.params.max_check == 8192
+    assert _rules()[-1] == ("recall_rescue", "restored")
+
+
+def test_at_floor_hold_when_no_relief_remains():
+    """At the floor with the tier still burning there is nothing left
+    to actuate: the controller says so (a `held` entry), it does not
+    spin."""
+    ctl, eng, idx = _mk(cooldown_ms=100.0, revert_window_ms=50.0,
+                        max_check_floor=4096)
+    eng.state, eng.burn = slo.WARN, 2.0
+    ctl.evaluate(now=0.0)                   # 8192 -> 4096 (the floor)
+    ctl.evaluate(now=1.0)                   # pending kept; at floor
+    assert idx.params.max_check == 4096
+    assert _rules()[-1] == ("at_floor_hold", "held")
+
+
+def test_bind_tier_knob_scope_and_stepping():
+    """Tier knobs bind through the registry too: index-scoped names are
+    rejected, tier steps are bounded by spec bounds and stepped in
+    quarters of the baseline->floor span (non-pow2)."""
+    box = {"v": 95.0}
+    ctl, eng, idx = _mk(max_check_floor=4096)
+    with pytest.raises(ValueError):
+        ctl.bind_tier_knob("MaxCheck", read=lambda: box["v"],
+                           apply=lambda v: box.update(v=v))
+    ctl.bind_tier_knob("HedgePercentile", read=lambda: box["v"],
+                       apply=lambda v: box.update(v=v))
+    eng.state, eng.burn = slo.WARN, 2.0
+    # down-steps go to the FIRST bound knob with relief left (bind
+    # order = priority): MaxCheck until its floor, then the hedge knob
+    ctl.evaluate(now=0.0)
+    assert idx.params.max_check == 4096 and box["v"] == 95.0
+    ctl.evaluate(now=1.2)
+    assert box["v"] == pytest.approx(95.0 - (95.0 - 50.0) / 4.0)
+    assert box["v"] >= 50.0                 # spec.lo
+
+
+def test_ctlaudit_ring_is_bounded():
+    """The audit ring never grows past its capacity; counters keep the
+    full tally."""
+    ctlaudit.configure(capacity=4)
+    for i in range(10):
+        ctlaudit.record("at_floor_hold", outcome="held", now=float(i))
+    snap = ctlaudit.snapshot()
+    assert snap["capacity"] == 4
+    assert len(snap["entries"]) == 4
+    assert snap["entries"][0]["t"] == 6.0   # oldest surviving
+    assert snap["counters"] == {"held": 10}
+    assert snap["decisions"] == 10
+
+
+def test_set_outcome_amends_entry_and_counters():
+    e = ctlaudit.record("burn_step_down", knob="main.MaxCheck",
+                        old=8192, new=4096, outcome="applied")
+    assert ctlaudit.counters() == {"applied": 1}
+    ctlaudit.set_outcome(e["id"], "kept")
+    assert ctlaudit.counters() == {"kept": 1}
+    assert ctlaudit.snapshot()["entries"][0]["outcome"] == "kept"
+    assert ctlaudit.epoch() == 1            # the actuation still counted
+
+
+# ---------------------------------------------------------------------------
+# off-parity: Controller=0 (default) == byte-identical + zero machinery
+# ---------------------------------------------------------------------------
+
+def test_controller_off_parity_serve_bytes_and_no_state():
+    """With Controller=0 (the default) the serve path produces
+    byte-identical wire responses and no controller object, audit
+    entry or decision counter exists (the ci_check.sh standalone
+    parity pass)."""
+    idx, data = _flat_index(n=50, d=8)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("main", idx)
+    server = SearchServer(ctx, batch_window_ms=1.0)
+    t = ServerThread(server)
+    t.start()
+    host, port = t.wait_ready(60)
+    try:
+        assert server._controller is None
+        assert not timeline.enabled()
+        qtext = "|".join(str(x) for x in data[7])
+        expected_result = SearchExecutor(ctx).execute(qtext)
+        expected_result.request_id = ""
+        expected_body = expected_result.pack()
+        expected = wire.PacketHeader(
+            wire.PacketType.SearchResponse, wire.PacketProcessStatus.Ok,
+            len(expected_body), 1, 77).pack() + expected_body
+
+        body = wire.RemoteQuery(qtext).pack()
+        s = socket.create_connection((host, port), timeout=10)
+        s.sendall(wire.PacketHeader(
+            wire.PacketType.SearchRequest, wire.PacketProcessStatus.Ok,
+            len(body), 0, 77).pack() + body)
+        s.settimeout(10)
+        got = b""
+        while len(got) < len(expected):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        s.close()
+        assert got == expected
+        assert ctlaudit.epoch() == 0
+        assert ctlaudit.counters() == {}
+        assert ctlaudit.snapshot()["entries"] == []
+        assert metrics.counter_value("controller.decisions") == 0
+        assert idx.params.max_check == 8192  # untouched
+    finally:
+        t.stop()
+
+
+def test_controller_without_slo_objective_stays_off(caplog):
+    """Controller=1 with no declared objective leaves the loop open
+    (nothing to act on) and says so."""
+    idx, _data = _flat_index(n=20, d=8)
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("main", idx)
+    server = SearchServer(
+        ctx, batch_window_ms=1.0,
+        controller_config=ControllerConfig(enabled=True))
+    t = ServerThread(server)
+    t.start()
+    t.wait_ready(60)
+    try:
+        assert server._controller is None
+        assert server._controller_debug() == {"enabled": False,
+                                              "tier": "server"}
+    finally:
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill: latency storm -> page -> controller -> ok
+# ---------------------------------------------------------------------------
+
+class _SlowIndex:
+    """Latency proportional to the LIVE MaxCheck: the knob the
+    controller lowers is exactly the knob that makes requests slow —
+    the closed loop has something real to close over.  Everything else
+    (params, set_parameter, exact_search oracle for canary probes)
+    delegates to a real FLAT index."""
+
+    def __init__(self, inner, s_per_check):
+        self.__dict__["_inner"] = inner
+        self.__dict__["_s_per_check"] = s_per_check
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def search(self, *args, **kwargs):
+        time.sleep(float(self._inner.params.max_check) * self._s_per_check)
+        return self._inner.search(*args, **kwargs)
+
+
+@pytest.mark.locksan_ok
+def test_e2e_drill_latency_storm_controller_restores_ok(caplog):
+    """ISSUE 17 acceptance: a latency storm drives the latency
+    objective to page; the controller lowers MaxCheck (pow2, bounded,
+    never below the floor) until the tier is back to ok; canary recall
+    never dips below the floor; and every decision is reconstructable
+    from /debug/controller, flightrec and the timeline."""
+    inner, _data = _flat_index(n=40, d=8)
+    idx = _SlowIndex(inner, s_per_check=8e-6)   # 8192 checks -> ~65ms
+    ctx = ServiceContext(ServiceSettings(default_max_result=5))
+    ctx.add_index("main", idx)
+    server = SearchServer(
+        ctx, batch_window_ms=1.0, metrics_port=-1,
+        flight_recorder=True, slow_query_threshold_ms=1.0,
+        timeline_interval_ms=50.0, canary_interval_ms=30.0,
+        slo_config=slo.SloConfig(
+            p99_ms=40.0, budget=0.05, fast_window_s=1.0,
+            slow_window_s=2.5, warn_burn=1.0, page_burn=4.0,
+            min_samples=3),
+        controller_config=ControllerConfig(
+            enabled=True, cooldown_ms=300.0, hold_ms=60000.0,
+            revert_window_ms=150.0, recall_floor=0.5,
+            max_check_floor=256))
+    t = ServerThread(server)
+    caplog.set_level(logging.WARNING)
+    t.start()
+    t.wait_ready(60)
+    mport = server._metrics_http.port
+    try:
+        assert server._controller is not None
+        # phase 1: the storm pages
+        deadline = time.time() + 30
+        paged = False
+        while time.time() < deadline:
+            status, body = _http_get(mport, "/debug/slo")
+            assert status == 200
+            st = json.loads(body).get("objectives", {}).get(
+                "latency_p99", {}).get("state", "")
+            if st == "page":
+                paged = True
+                break
+            time.sleep(0.05)
+        assert paged, "latency storm never paged"
+        # phase 2: the controller brings the tier back to ok on its own
+        deadline = time.time() + 30
+        state = ""
+        while time.time() < deadline:
+            status, body = _http_get(mport, "/debug/slo")
+            snap = json.loads(body)
+            state = snap.get("objectives", {}).get(
+                "latency_p99", {}).get("state", "")
+            if state == "ok" and server._controller.epoch >= 1:
+                break
+            time.sleep(0.05)
+        assert state == "ok", snap
+        # the actuation is bounded: pow2, below baseline, >= floor
+        mc = int(inner.params.max_check)
+        assert mc < 8192
+        assert mc >= 256
+        assert mc & (mc - 1) == 0
+        # guardrail: canary recall never dipped below the floor (FLAT
+        # stays exact at any MaxCheck, so the floor was never at risk —
+        # which is exactly why MaxCheck is the safe relief valve here)
+        recalls = timeline.window_values("canary.recall", 120.0)
+        assert recalls and min(recalls) >= 0.5
+        # the decision trail: /debug/controller carries the ring
+        status, body = _http_get(mport, "/debug/controller")
+        assert status == 200
+        dbg = json.loads(body)
+        assert dbg["enabled"] is True and dbg["tier"] == "server"
+        assert dbg["epoch"] >= 1
+        acts = dbg["actuators"]["main.MaxCheck"]
+        assert acts["current"] == float(mc)
+        assert acts["baseline"] == 8192.0
+        down = [e for e in dbg["audit"]["entries"]
+                if e["rule"] == "burn_step_down"]
+        assert down, dbg["audit"]
+        assert down[0]["outcome"] in ("applied", "kept", "reverted")
+        assert down[0]["inputs"]["slo"] in ("warn", "page")
+        # ... flightrec carries the actuation on the rid timeline
+        status, body = _http_get(mport, "/debug/flight")
+        assert status == 200
+        events = [e for e in json.loads(body)["flightEvents"]
+                  if e["kind"] == "controller_actuation"]
+        assert any(e["payload"]["knob"] == "main.MaxCheck"
+                   for e in events)
+        # ... the timeline series shows the knob walk
+        assert any(k.startswith("controller.knob")
+                   for k in timeline.series_names())
+        # ... and slow queries were stamped with the controller epoch
+        assert any("cepoch=" in r.getMessage() for r in caplog.records
+                   if "SLOW" in r.getMessage() or "slow" in
+                   r.getMessage() or "cepoch=" in r.getMessage())
+    finally:
+        t.stop()
+    assert not any(th.name == "canary-prober"
+                   for th in threading.enumerate())

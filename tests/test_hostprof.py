@@ -328,10 +328,11 @@ def test_debug_devicetrace_bounded(standalone_http, tmp_path):
 # the /debug route contract on both tiers
 # ---------------------------------------------------------------------------
 
-EXPECTED_ROUTES = ["/debug/admission", "/debug/devicetrace",
-                   "/debug/flight", "/debug/memory", "/debug/mutation",
-                   "/debug/prof", "/debug/quality", "/debug/slo",
-                   "/debug/timeline", "/healthz", "/metrics"]
+EXPECTED_ROUTES = ["/debug/admission", "/debug/controller",
+                   "/debug/devicetrace", "/debug/flight",
+                   "/debug/memory", "/debug/mutation", "/debug/prof",
+                   "/debug/quality", "/debug/slo", "/debug/timeline",
+                   "/healthz", "/metrics"]
 
 
 @pytest.fixture(scope="module")

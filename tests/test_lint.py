@@ -753,6 +753,69 @@ def test_issue15_timeline_slo_canary_names_are_literals():
 
 
 # ---------------------------------------------------------------------------
+# GL609 controller audit rule names (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_gl609_dynamic_audit_rule_flagged():
+    """The ctlaudit ring is keyed and counted by decision rule; a
+    dynamic rule name would make the audit trail unsearchable.  Both
+    the module-attribute and from-import call forms are in scope."""
+    src = (
+        "from sptag_tpu.serve import ctlaudit\n"
+        "def decide(rule, knob):\n"
+        "    ctlaudit.record(rule, knob=knob)\n"
+        "def decide2(outcome):\n"
+        "    ctlaudit.record('veto_' + outcome)\n"
+    )
+    found = lint_one(src, select=["GL609"])
+    assert rules_of(found) == ["GL609"]
+    assert len(found) == 2
+    assert "string literal" in found[0].message
+    dirty = (
+        "from sptag_tpu.serve.ctlaudit import record\n"
+        "def decide(rule):\n"
+        "    record(rule)\n"
+    )
+    assert rules_of(lint_one(dirty, select=["GL609"])) == ["GL609"]
+
+
+def test_gl609_literal_constant_and_knob_arg_clean():
+    """Literal / module-constant rule names pass — positionally or by
+    keyword; the `knob` argument is out of scope (knob names come from
+    the live-actuation registry, bounded by deployment — the flightrec
+    tier rationale)."""
+    src = (
+        "from sptag_tpu.serve import ctlaudit\n"
+        "RULE = 'burn_step_down'\n"
+        "def decide(knob_name, old, new):\n"
+        "    ctlaudit.record('canary_floor_veto', knob=knob_name)\n"
+        "    ctlaudit.record(RULE, knob=knob_name, old=old, new=new)\n"
+        "    ctlaudit.record(rule='at_floor_hold')\n"
+        "    ctlaudit.set_outcome(1, 'kept')\n"
+    )
+    assert lint_one(src, select=["GL609"]) == []
+
+
+def test_issue17_controller_rule_names_are_literals():
+    """ISSUE 17 CI satellite: the controller/audit/serving files lint
+    GL609-clean with NO baseline applied at all (zero baseline
+    entries)."""
+    paths = [
+        "sptag_tpu/serve/controller.py",
+        "sptag_tpu/serve/ctlaudit.py",
+        "sptag_tpu/serve/server.py",
+        "sptag_tpu/serve/aggregator.py",
+        "sptag_tpu/serve/service.py",
+    ]
+    srcs = {}
+    for p in paths:
+        with open(os.path.join(REPO, p), encoding="utf-8") as fh:
+            srcs[p] = fh.read()
+    found = lint_sources(srcs, select=["GL609"])
+    assert found == [], "\n".join(f.format() for f in found)
+
+
+# ---------------------------------------------------------------------------
 # GL605 cost-ledger coverage (ISSUE 6)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,348 @@
+"""Offline autotuner — search the recall-vs-QPS Pareto frontier, emit a
+per-workload config artifact (ISSUE 17 tentpole a).
+
+KBest (arXiv:2508.03016) tunes exactly these knobs per deployment; the
+ROADMAP's "millions of users" north star means nobody hand-tunes per
+tenant.  This tool closes the OFFLINE half of the loop: sweep the
+candidate-budget grid against a ground-truth query set (the bench
+pareto-stage measurement, Wilson CIs and all), keep the Pareto frontier,
+pick the highest-QPS point whose recall CI LOWER bound clears the
+declared target (the CI floor, not the point estimate — a thin query
+set cannot fake health), and emit two files:
+
+* ``autotune.ini`` — an INI fragment of ``[Index]`` Name=Value pairs a
+  server applies at start ([Service] AutotuneConfig=, flowing through
+  the same `set_parameter` path an operator or the online controller
+  uses);
+* ``autotune.json`` — full provenance: schema version, git rev, corpus
+  fingerprint, the chosen point, every frontier point, and every point
+  REJECTED with the reason (dominated / below the recall gate), so a
+  later run can explain why the knob is what it is.
+
+The regression gate is tools/benchdiff.py: ``--gate BASELINE.json``
+diffs this run's operating point against a prior artifact's
+``autotune.qps_at_slo`` / ``autotune.recall_at_10`` lines and exits
+non-zero on regression — the same judgement bench CI applies.
+
+Every knob the artifact may set is validated against the core/params
+LIVE-ACTUATION REGISTRY before emission: the offline tuner honors the
+same bounds contract as the online controller.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SCHEMA_VERSION = 1
+ARTIFACT_INI = "autotune.ini"
+ARTIFACT_JSON = "autotune.json"
+
+
+def _git_rev() -> str:
+    """Short git rev of the tuned tree; 'unknown' when git is
+    unavailable — never fatal (the bench.py provenance pattern)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10)
+        rev = out.stdout.strip()
+        if out.returncode == 0 and rev:
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain"], cwd=REPO,
+                capture_output=True, text=True, timeout=10)
+            if dirty.returncode == 0 and dirty.stdout.strip():
+                rev += "-dirty"
+            return rev
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def fingerprint_array(arr: np.ndarray) -> str:
+    """Corpus fingerprint: sha256 over dtype/shape/bytes — the artifact
+    binds to the data it was tuned against."""
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
+# --------------------------------------------------------------- measure
+
+
+def measure_point(index, queries, truth, k: int,
+                  max_check: Optional[int] = None,
+                  max_queries: int = 512) -> dict:
+    """One operating point: warm, time a batch, score recall with a
+    Wilson CI (the bench pareto-stage measurement).  `max_check=None`
+    measures the index AS CONFIGURED (the replay path)."""
+    from sptag_tpu.utils import qualmon
+
+    qn = min(len(queries), max_queries)
+    kw = {} if max_check is None else {"max_check": int(max_check)}
+    index.search_batch(queries[:qn], k, **kw)               # warm
+    t0 = time.perf_counter()
+    _, ids = index.search_batch(queries[:qn], k, **kw)
+    dt = time.perf_counter() - t0
+    rec = qualmon.recall_at_k(ids, truth[:qn], k)
+    lo, hi = qualmon.wilson(rec * qn * k, qn * k)
+    point = {
+        "qps": round(qn / dt, 1),
+        "recall_at_10": round(rec, 4),
+        "ci": [round(lo, 4), round(hi, 4)],
+        "queries": qn,
+        "non_default_params": dict(index.params.non_default_items()),
+    }
+    if max_check is not None:
+        point["max_check"] = int(max_check)
+    return point
+
+
+def sweep(index, queries, truth, k: int, grid: List[int],
+          deadline: Optional[float] = None,
+          max_queries: int = 512) -> Tuple[List[dict], List[int]]:
+    """Measure every MaxCheck on `grid` (bounds-checked against the
+    live-actuation registry); returns (points, dropped) where dropped
+    holds grid values skipped for the wall-clock deadline — caps are
+    recorded, never silent (the bench stage-budget discipline)."""
+    from sptag_tpu.core import params as core_params
+
+    points, dropped = [], []
+    for mc in grid:
+        if deadline is not None and time.monotonic() >= deadline:
+            dropped.append(int(mc))
+            continue
+        bounded = int(core_params.clamp_actuation("MaxCheck", mc))
+        points.append(measure_point(index, queries, truth, k,
+                                    max_check=bounded,
+                                    max_queries=max_queries))
+    return points, dropped
+
+
+def pareto_frontier(points: List[dict]
+                    ) -> Tuple[List[dict], List[dict]]:
+    """Split measured points into the Pareto frontier and the dominated
+    rest; dominated points carry the reason (which point beat them)."""
+    frontier, rejected = [], []
+    for p in points:
+        dom = next(
+            (q for q in points if q is not p
+             and q["qps"] >= p["qps"]
+             and q["recall_at_10"] >= p["recall_at_10"]
+             and (q["qps"] > p["qps"]
+                  or q["recall_at_10"] > p["recall_at_10"])), None)
+        if dom is None:
+            frontier.append(p)
+        else:
+            rejected.append(dict(
+                p, reason="dominated by max_check=%s"
+                % dom.get("max_check", "?")))
+    return frontier, rejected
+
+
+def choose(frontier: List[dict], recall_target: float
+           ) -> Tuple[Optional[dict], List[dict]]:
+    """Highest-QPS frontier point whose Wilson LOWER bound clears the
+    recall target; frontier points failing the gate join the rejected
+    list with the reason.  No point clears the gate -> the highest-
+    recall point wins (the artifact says so via `gate_met`: a tuner
+    that silently under-delivers recall is worse than no tuner)."""
+    ok = [p for p in frontier if p["ci"][0] >= recall_target]
+    rejected = [dict(p, reason="ci_lo %.4f < recall target %.4f"
+                     % (p["ci"][0], recall_target))
+                for p in frontier if p["ci"][0] < recall_target]
+    if ok:
+        chosen = dict(max(ok, key=lambda p: p["qps"]), gate_met=True)
+    elif frontier:
+        chosen = dict(max(frontier, key=lambda p: p["recall_at_10"]),
+                      gate_met=False)
+        rejected = [p for p in rejected
+                    if p.get("max_check") != chosen.get("max_check")]
+    else:
+        chosen = None
+    return chosen, rejected
+
+
+# ------------------------------------------------------------------ emit
+
+
+def emit(out_dir: str, chosen: dict, frontier: List[dict],
+         rejected: List[dict], recall_target: float,
+         corpus_fingerprint: str, extra: Optional[dict] = None
+         ) -> Dict[str, str]:
+    """Write autotune.ini + autotune.json into `out_dir`; returns their
+    paths.  Artifact knobs are validated against the live-actuation
+    registry (UnknownActuationError surfaces a tuner bug at emission,
+    not at some later server start)."""
+    from sptag_tpu.core import params as core_params
+
+    knobs: Dict[str, object] = {}
+    if "max_check" in chosen:
+        knobs["MaxCheck"] = int(core_params.clamp_actuation(
+            "MaxCheck", chosen["max_check"]))
+    for name, value in (chosen.get("knobs") or {}).items():
+        knobs[core_params.actuation_spec(name).name] = value
+    os.makedirs(out_dir, exist_ok=True)
+    ini_path = os.path.join(out_dir, ARTIFACT_INI)
+    json_path = os.path.join(out_dir, ARTIFACT_JSON)
+    with open(ini_path, "w", encoding="utf-8") as f:
+        f.write("; emitted by tools/autotune.py — apply via [Service] "
+                "AutotuneConfig=\n[Index]\n")
+        for name, value in knobs.items():
+            f.write("%s=%s\n" % (name, value))
+    provenance = {
+        "schema_version": SCHEMA_VERSION,
+        "tool": "tools/autotune.py",
+        "created_unix": round(time.time(), 1),
+        "git_rev": _git_rev(),
+        "corpus_fingerprint": corpus_fingerprint,
+        "recall_target": recall_target,
+        "knobs": knobs,
+        "chosen": chosen,
+        "frontier": frontier,
+        "rejected": rejected,
+    }
+    provenance.update(extra or {})
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(provenance, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return {"ini": ini_path, "json": json_path}
+
+
+def replay(index, queries, truth, k: int, ini_path: str,
+           max_queries: int = 512) -> dict:
+    """Apply an emitted artifact to `index` through the SERVE-path
+    helper (service.apply_autotune_artifact — the exact code a real
+    server start runs) and measure at the applied operating point."""
+    from sptag_tpu.serve import service as service_mod
+
+    ctx = service_mod.ServiceContext()
+    ctx.add_index("main", index)
+    applied = service_mod.apply_autotune_artifact(ctx, ini_path)
+    out = measure_point(index, queries, truth, k,
+                        max_queries=max_queries)
+    out["applied_params"] = applied
+    return out
+
+
+def gate(current_point: dict, baseline_json: str) -> Tuple[bool, List[str]]:
+    """Benchdiff the replayed operating point against a prior
+    autotune.json (or bench artifact); returns (ok, report lines)."""
+    from tools import benchdiff
+
+    with open(baseline_json, "r", encoding="utf-8") as f:
+        baseline = json.load(f)
+    if "autotune" not in baseline and "chosen" in baseline:
+        # a bare autotune.json: lift its chosen point into the bench
+        # artifact shape benchdiff's dotted paths expect
+        baseline = {"schema_version": baseline.get("schema_version", 0),
+                    "autotune": {
+                        "qps_at_slo": baseline["chosen"].get("qps"),
+                        "recall_at_10":
+                            baseline["chosen"].get("recall_at_10")}}
+    current = {"schema_version": baseline.get("schema_version", 0),
+               "autotune": {
+                   "qps_at_slo": current_point.get("qps"),
+                   "recall_at_10": current_point.get("recall_at_10")}}
+    verdicts, notes = benchdiff.diff(baseline, current)
+    lines = list(notes)
+    ok = True
+    for v in verdicts:
+        lines.append("%-28s %12s -> %12s  %s" % (
+            v.metric.path, v.base, v.cur, v.status))
+        ok = ok and v.status != "REGRESSED"
+    return ok, lines
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _build_corpus(algo: str, n: int, dim: int, n_queries: int, k: int,
+                  seed: int):
+    """Synthetic workload: corpus + queries + exact truth (the bench
+    clustered-blobs shape keeps the sweep's recall curve non-trivial)."""
+    import sptag_tpu as sp
+
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((max(8, n // 128), dim)) * 4.0
+    assign = rng.integers(0, len(centers), size=n)
+    data = (centers[assign]
+            + rng.standard_normal((n, dim))).astype(np.float32)
+    queries = (centers[rng.integers(0, len(centers), size=n_queries)]
+               + rng.standard_normal((n_queries, dim))).astype(np.float32)
+    index = sp.create_instance(algo, "Float")
+    index.set_parameter("DistCalcMethod", "L2")
+    index.build(data)
+    _, truth = index.exact_search_batch(queries, k)
+    return index, data, queries, np.asarray(truth)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline recall-vs-QPS autotuner (ISSUE 17)")
+    ap.add_argument("--out", required=True,
+                    help="artifact output directory")
+    ap.add_argument("--algo", default="BKT")
+    ap.add_argument("--corpus", type=int, default=20000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--queries", type=int, default=256)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--recall-target", type=float, default=0.9)
+    ap.add_argument("--grid", default="256,512,1024,2048,4096,8192",
+                    help="comma-separated MaxCheck sweep")
+    ap.add_argument("--budget-s", type=float, default=300.0,
+                    help="wall-clock budget for the sweep")
+    ap.add_argument("--gate", default="",
+                    help="baseline autotune.json/bench.json to "
+                    "benchdiff the replayed point against")
+    args = ap.parse_args(argv)
+
+    grid = [int(t) for t in args.grid.split(",") if t.strip()]
+    index, data, queries, truth = _build_corpus(
+        args.algo, args.corpus, args.dim, args.queries, args.k,
+        args.seed)
+    deadline = time.monotonic() + args.budget_s
+    points, dropped = sweep(index, queries, truth, args.k, grid,
+                            deadline=deadline)
+    frontier, dominated = pareto_frontier(points)
+    chosen, gated_out = choose(frontier, args.recall_target)
+    if chosen is None:
+        print("autotune: no measurable points", file=sys.stderr)
+        return 2
+    paths = emit(args.out, chosen, frontier, dominated + gated_out,
+                 args.recall_target, fingerprint_array(data),
+                 extra={"algo": args.algo, "k": args.k,
+                        "grid": grid, "grid_dropped": dropped})
+    rep = replay(index, queries, truth, args.k, paths["ini"])
+    print("autotune: chose MaxCheck=%s qps=%.1f recall@%d=%.4f "
+          "(gate_met=%s) -> %s"
+          % (chosen.get("max_check"), rep["qps"], args.k,
+             rep["recall_at_10"], chosen.get("gate_met"),
+             paths["ini"]))
+    if args.gate:
+        ok, lines = gate(rep, args.gate)
+        print("\n".join(lines))
+        if not ok:
+            print("autotune: REGRESSED vs %s" % args.gate,
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

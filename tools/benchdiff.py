@@ -110,6 +110,14 @@ METRICS: List[Metric] = [
     Metric("loadgen.canary_recall_at_10", HIGHER, 0.01, 0.005,
            platform_bound=False),
     Metric("loadgen.canary_p99_ms", LOWER, 0.25, 10.0),
+    # offline-autotuner replay (ISSUE 17): the emitted config
+    # artifact's operating point — QPS at the recall-SLO target and the
+    # recall actually delivered there.  A worse chosen point means the
+    # tuner (or the engine underneath it) regressed; recall is
+    # platform-independent like every quality line.
+    Metric("autotune.qps_at_slo", HIGHER, 0.20, 16.0),
+    Metric("autotune.recall_at_10", HIGHER, 0.01, 0.005,
+           platform_bound=False),
     # mutation-under-load stage (ISSUE 9)
     Metric("mutate.read_qps", HIGHER, 0.20, 25.0),
     Metric("mutate.p99_steady_ms", LOWER, 0.25, 10.0),

@@ -221,6 +221,22 @@ env JAX_PLATFORMS=cpu SPTAG_TRACESAN=1 python -m pytest \
     tests/test_beam_segmented.py tests/test_mesh_serve.py \
     tests/test_tracesan.py -q -p no:cacheprovider -m 'not slow'
 
+# the ISSUE 17 serving gate, standalone: with Controller=0 and no
+# AutotuneConfig (the defaults) the serve tier's wire bytes stay
+# byte-identical, no controller object or audit entry exists and the
+# decision counter reads zero — the closed loop is provably open when
+# not asked for
+echo "== controller off: serve byte parity (standalone) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/test_controller.py -q \
+    -p no:cacheprovider -k "off_parity"
+
+# the ISSUE 17 lint gate, standalone: controller decision-rule names
+# passed to ctlaudit.record are string literals (GL609, the GL6xx
+# cardinality family) with ZERO baseline entries — a dynamic rule name
+# would make the bounded audit ring unsearchable
+echo "== GL609 controller audit-rule lint (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL609
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

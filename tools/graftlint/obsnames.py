@@ -46,6 +46,16 @@ Rules:
   prober both publish through this call) must be bounded.  The
   `label` argument is out of scope — labels are deployment-bounded
   (index names, objective names), the qualmon shard-label rationale.
+* GL609 — the rule argument of a controller decision-audit record
+  (`ctlaudit.record(rule, ...)`) is not a string literal or
+  module-level string constant: the audit ring is the control plane's
+  accountability surface — dashboards and the acceptance drill key off
+  rule names, the ring counts decisions per rule, and a dynamic rule
+  name would make the decision taxonomy (burn_step_down /
+  revert_on_worse / canary_floor_veto / ...) unsearchable.  The `knob`
+  argument is out of scope — knob names come from the core/params
+  live-actuation registry, bounded by deployment like flightrec's
+  tier.
 
 Calls are resolved through import aliases (`from sptag_tpu.utils import
 trace` / `import sptag_tpu.utils.metrics as metrics` / from-imports of the
@@ -74,6 +84,8 @@ RULES = {
              "dynamic stages make the folded-stack taxonomy unbounded",
     "GL608": "timeline series name is not a string literal — dynamic "
              "names make the time-series store unbounded",
+    "GL609": "controller audit rule name is not a string literal — "
+             "dynamic rule names make the decision taxonomy unbounded",
 }
 
 _TRACE_MODULE = "sptag_tpu.utils.trace"
@@ -82,6 +94,7 @@ _FLIGHT_MODULE = "sptag_tpu.utils.flightrec"
 _QUALMON_MODULE = "sptag_tpu.utils.qualmon"
 _HOSTPROF_MODULE = "sptag_tpu.utils.hostprof"
 _TIMELINE_MODULE = "sptag_tpu.utils.timeline"
+_CTLAUDIT_MODULE = "sptag_tpu.serve.ctlaudit"
 
 _TRACE_FNS = {"span", "record"}
 _METRICS_FNS = {"counter", "gauge", "histogram", "inc", "set_gauge",
@@ -90,12 +103,14 @@ _FLIGHT_FNS = {"record", "span"}
 _QUALMON_FNS = {"gauge", "inc"}
 _HOSTPROF_FNS = {"set_stage", "stage"}
 _TIMELINE_FNS = {"record"}
+_CTLAUDIT_FNS = {"record"}
 
 #: per-rule (positional index, keyword name) of the argument that must
 #: be a bounded string — GL60x's lint surface
 _NAME_ARG = {"GL601": (0, "name"), "GL602": (0, "name"),
              "GL603": (1, "kind"), "GL606": (0, "name"),
-             "GL607": (0, "stage"), "GL608": (0, "name")}
+             "GL607": (0, "stage"), "GL608": (0, "name"),
+             "GL609": (0, "rule")}
 
 
 def _module_str_constants(mod: ModuleInfo) -> Set[str]:
@@ -130,6 +145,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL607"
         if full == _TIMELINE_MODULE and func.attr in _TIMELINE_FNS:
             return "GL608"
+        if full == _CTLAUDIT_MODULE and func.attr in _CTLAUDIT_FNS:
+            return "GL609"
         return None
     if isinstance(func, ast.Name):
         target = mod.from_imports.get(func.id, "")
@@ -146,6 +163,8 @@ def _rule_for_call(call: ast.Call, mod: ModuleInfo) -> Optional[str]:
             return "GL607"
         if modpath == _TIMELINE_MODULE and sym in _TIMELINE_FNS:
             return "GL608"
+        if modpath == _CTLAUDIT_MODULE and sym in _CTLAUDIT_FNS:
+            return "GL609"
     return None
 
 
@@ -201,7 +220,8 @@ def _check_module(mod: ModuleInfo) -> List[Finding]:
             continue
         fn_name = _dotted(node.func) or "<call>"
         what = ("kind" if rule == "GL603"
-                else "stage" if rule == "GL607" else "name")
+                else "stage" if rule == "GL607"
+                else "rule" if rule == "GL609" else "name")
         out.append(Finding(
             rule, mod.relpath, node.lineno,
             f"`{fn_name}` {what} is {_describe(arg)} — use a string "
