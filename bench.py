@@ -2199,8 +2199,12 @@ def _mutate_measure_armed(index, queries, k, budget_s, write_frac,
         return round(float(np.percentile(vals, q)) * 1e3, 3) \
             if vals else None
 
+    duration_s = time.monotonic() - t_stage0
     return {
-        "duration_s": round(time.monotonic() - t_stage0, 1),
+        "duration_s": round(duration_s, 1),
+        # GL1001: benchdiff watches mutate.read_qps — the stage counted
+        # reads but never published the rate the catalog diffs
+        "read_qps": round(ops["reads"] / max(duration_s, 1e-9), 1),
         "reads": ops["reads"],
         "writes": ops["writes"],
         "deletes": ops["deletes"],

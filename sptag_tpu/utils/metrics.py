@@ -182,10 +182,29 @@ _gauges: Dict[str, Gauge] = {}
 _histograms: Dict[str, Histogram] = {}
 
 
+class MetricKindError(TypeError):
+    """One name, two instrument kinds.  Before this check, registering
+    `x` as both a counter and a gauge silently minted two instruments
+    sharing one name — the timeline then derived `x` AND `x.rate` from
+    different series and /metrics exposed the name twice (GL10xx
+    contract, DESIGN.md §24)."""
+
+
+def _check_kind(name: str, kind: str) -> None:
+    # caller holds _reg_lock
+    for other_kind, reg in (("counter", _counters), ("gauge", _gauges),
+                            ("histogram", _histograms)):
+        if other_kind != kind and name in reg:
+            raise MetricKindError(
+                f"metric {name!r} is already registered as a "
+                f"{other_kind}; cannot re-register it as a {kind}")
+
+
 def counter(name: str) -> Counter:
     with _reg_lock:
         c = _counters.get(name)
         if c is None:
+            _check_kind(name, "counter")
             c = _counters[name] = Counter(name)
         return c
 
@@ -194,6 +213,7 @@ def gauge(name: str) -> Gauge:
     with _reg_lock:
         g = _gauges.get(name)
         if g is None:
+            _check_kind(name, "gauge")
             g = _gauges[name] = Gauge(name)
         return g
 
@@ -202,6 +222,7 @@ def histogram(name: str) -> Histogram:
     with _reg_lock:
         h = _histograms.get(name)
         if h is None:
+            _check_kind(name, "histogram")
             h = _histograms[name] = Histogram(name)
         return h
 

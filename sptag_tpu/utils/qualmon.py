@@ -478,6 +478,18 @@ def record_sample(mode: str, shard: str, recall: float, k: int,
         flightrec.dump_to_file("low_recall", rid)
 
 
+#: the triage-verdict contract surface — every code a classifier can
+#: return (or a triage site can stamp, e.g. the aggregator's
+#: `merge_drop`).  Dashboards, tests and the GL10xx observability graph
+#: key on this tuple: a classifier returning a code missing here is
+#: GL1001, a registry entry no classifier produces is GL1002.
+TRIAGE_VERDICTS: Tuple[str, ...] = (
+    "sketch_budget", "int8_budget", "host_fetch_drop", "shard_skew",
+    "beam_budget", "sketch_prefilter", "dense_prefilter",
+    "beam_converged_early", "merge_drop", "unknown",
+)
+
+
 def classify_low_recall(rid: str, mode: str,
                         sketch: bool = False,
                         cascade: Optional[Dict[str, int]] = None

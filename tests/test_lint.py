@@ -2219,3 +2219,294 @@ def test_gl90x_registered_and_repo_clean_with_zero_gl905_waivers():
     loose = [s for s in entries if s.rule == "GL901"
              and "is float-typed" not in s.contains]
     assert loose == []
+
+
+# ---------------------------------------------------------------------------
+# GL100x observability/config contract graph
+# ---------------------------------------------------------------------------
+
+def test_gl1001_timeline_read_of_unpublished_series_flagged():
+    src = (
+        "from sptag_tpu.utils import timeline\n"
+        "def poll():\n"
+        "    return timeline.latest(\"ghost.series\")\n"
+    )
+    found = lint_one(src, select=["GL1001"])
+    assert rules_of(found) == ["GL1001"]
+    assert found[0].symbol == "poll"
+    assert "ghost.series" in found[0].message
+
+
+def test_gl1001_counter_derivation_satisfies_timeline_read():
+    """A counter producer covers the `.rate` timeline derivation the
+    consumer reads — the exact dataflow slo.py depends on."""
+    src = (
+        "from sptag_tpu.utils import metrics, timeline\n"
+        "def serve(n):\n"
+        "    metrics.inc(\"serve.requests\", n)\n"
+        "def poll():\n"
+        "    return timeline.latest(\"serve.requests.rate\")\n"
+    )
+    assert lint_one(src, select=["GL1001"]) == []
+
+
+def test_gl1001_metric_read_with_wrong_instrument_kind_flagged():
+    src = (
+        "from sptag_tpu.utils import metrics\n"
+        "def serve(n):\n"
+        "    metrics.inc(\"serve.requests\", n)\n"
+        "def report():\n"
+        "    return metrics.gauge_value(\"serve.requests\")\n"
+    )
+    found = lint_one(src, select=["GL1001"])
+    assert rules_of(found) == ["GL1001"]
+    assert "counter" in found[0].message
+
+
+def test_gl1002_published_never_consumed_flagged():
+    """In-memory fixtures carry no docs/tests corpus, so an orphan
+    producer has no mention anywhere and must be reported."""
+    src = (
+        "from sptag_tpu.utils import metrics\n"
+        "def publish(n):\n"
+        "    metrics.inc(\"orphan.counter\", n)\n"
+    )
+    found = lint_one(src, select=["GL1002"])
+    assert rules_of(found) == ["GL1002"]
+    assert "orphan.counter" in found[0].message
+
+
+def test_gl1002_doc_mention_clears_published_name():
+    sources = {
+        "sptag_tpu/algo/snippet.py": (
+            "from sptag_tpu.utils import metrics\n"
+            "def publish(n):\n"
+            "    metrics.inc(\"orphan.counter\", n)\n"
+        ),
+        # planted corpus file: a docs mention is a sanctioned consumer
+        "docs/NOTES.md": "`orphan.counter` is scraped by the ops board\n",
+    }
+    assert [f for f in lint_sources(sources, select=["GL1002"])] == []
+
+
+def test_gl1002_prom_rendered_mention_clears_published_name():
+    """Tests grep /metrics in Prometheus form (`sptag_tpu_x_y`) — that
+    counts as consumption of the dotted registry name `x.y`."""
+    sources = {
+        "sptag_tpu/algo/snippet.py": (
+            "from sptag_tpu.utils import metrics\n"
+            "def publish(n):\n"
+            "    metrics.inc(\"orphan.counter\", n)\n"
+        ),
+        "docs/NOTES.md": "scrape asserts sptag_tpu_orphan_counter > 0\n",
+    }
+    assert lint_sources(sources, select=["GL1002"]) == []
+
+
+def test_gl1003_bare_read_of_labeled_only_family_flagged():
+    """Every producer publishes `shard.lag` under a label; the bare
+    timeline key never receives a point, so the read is dead."""
+    src = (
+        "from sptag_tpu.utils import metrics, timeline\n"
+        "def publish(v, shard):\n"
+        "    fam = metrics.Family(\"shard.lag\")\n"
+        "    fam.add(v, {\"shard\": shard})\n"
+        "def poll():\n"
+        "    return timeline.latest(\"shard.lag\")\n"
+    )
+    found = lint_one(src, select=["GL1003"])
+    assert rules_of(found) == ["GL1003"]
+    assert "labeled" in found[0].message
+
+
+def test_gl1003_conflicting_family_label_sets_flagged():
+    src = (
+        "from sptag_tpu.utils import metrics\n"
+        "def publish(v, shard, tier):\n"
+        "    fam = metrics.Family(\"shard.lag\")\n"
+        "    fam.add(v, {\"shard\": shard})\n"
+        "    fam.add(v, {\"tier\": tier})\n"
+    )
+    found = lint_one(src, select=["GL1003"])
+    assert rules_of(found) == ["GL1003"]
+    assert "conflicting" in found[0].message
+
+
+def test_gl1003_consistent_labels_and_unlabeled_aggregate_clean():
+    src = (
+        "from sptag_tpu.utils import metrics, timeline\n"
+        "def publish(v, shard):\n"
+        "    fam = metrics.Family(\"shard.lag\")\n"
+        "    fam.add(v, {\"shard\": shard})\n"
+        "    fam.add(v, {\"shard\": \"all\"})\n"
+        "    agg = metrics.Family(\"shard.skew\")\n"
+        "    agg.add(v, None)\n"
+        "def poll():\n"
+        "    return timeline.latest(\"shard.skew\")\n"
+    )
+    assert lint_one(src, select=["GL1003"]) == []
+
+
+def test_gl1004_param_spec_without_doc_row_flagged():
+    sources = {
+        "sptag_tpu/core/params.py": (
+            "def _spec(lo, hi, default, name):\n"
+            "    return (lo, hi, default, name)\n"
+            "SPECS = [_spec(0, 8, 2, \"DocumentedKnob\"),\n"
+            "         _spec(0, 8, 2, \"UndocumentedKnob\")]\n"
+        ),
+        "docs/PARAMETERS.md": (
+            "| Parameter | Default | Notes |\n"
+            "| --- | --- | --- |\n"
+            "| `DocumentedKnob` | 2 | tuned per round |\n"
+        ),
+    }
+    found = lint_sources(sources, select=["GL1004"])
+    assert rules_of(found) == ["GL1004"]
+    assert len(found) == 1
+    assert "UndocumentedKnob" in found[0].message
+
+
+def test_gl1004_stale_doc_row_flagged():
+    sources = {
+        "sptag_tpu/core/params.py": (
+            "def _spec(lo, hi, default, name):\n"
+            "    return (lo, hi, default, name)\n"
+            "SPECS = [_spec(0, 8, 2, \"RealKnob\")]\n"
+        ),
+        "docs/PARAMETERS.md": (
+            "| `RealKnob` | 2 | fine |\n"
+            "| `GhostKnob` | 7 | removed two rounds ago |\n"
+        ),
+    }
+    found = lint_sources(sources, select=["GL1004"])
+    assert rules_of(found) == ["GL1004"]
+    assert found[0].path == "docs/PARAMETERS.md"
+    assert "GhostKnob" in found[0].message
+
+
+def test_gl1004_without_planted_doc_silent():
+    """No docs/PARAMETERS.md surface (fixture project) -> the doc
+    contract simply does not apply; no noise on unit fixtures."""
+    src = (
+        "def _spec(lo, hi, default, name):\n"
+        "    return name\n"
+        "SPECS = [_spec(0, 8, 2, \"WhateverKnob\")]\n"
+    )
+    assert lint_one(src, select=["GL1004"]) == []
+
+
+def test_gl1005_param_use_without_spec_flagged():
+    src = (
+        "def _spec(lo, hi, default, name):\n"
+        "    return name\n"
+        "KNOBS = [_spec(1, 8, 2, \"RealKnob\")]\n"
+        "def tune(idx):\n"
+        "    idx.set_parameter(\"NoSuchKnob\", 3)\n"
+    )
+    found = lint_one(src, select=["GL1005"])
+    assert rules_of(found) == ["GL1005"]
+    assert "NoSuchKnob" in found[0].message
+
+
+def test_gl1005_case_insensitive_spec_match_clean():
+    """set_parameter lowercases on lookup — `realknob` resolves."""
+    src = (
+        "def _spec(lo, hi, default, name):\n"
+        "    return name\n"
+        "KNOBS = [_spec(1, 8, 2, \"RealKnob\")]\n"
+        "def tune(idx):\n"
+        "    idx.set_parameter(\"realknob\", 3)\n"
+    )
+    assert lint_one(src, select=["GL1005"]) == []
+
+
+def test_gl1006_route_contract_mismatch_flagged_both_directions():
+    server_src = (
+        "def handler(q):\n"
+        "    return 200\n"
+        "class Server:\n"
+        "    def __init__(self):\n"
+        "        self._routes = {\"/metrics\": handler,\n"
+        "                        \"/debug/extra\": handler}\n"
+    )
+    contract_src = "EXPECTED_ROUTES = [\"/metrics\", \"/debug/ghost\"]\n"
+    found = lint_sources({"sptag_tpu/serve/http.py": server_src,
+                          "sptag_tpu/serve/contract.py": contract_src},
+                         select=["GL1006"])
+    assert rules_of(found) == ["GL1006"]
+    msgs = "\n".join(f.message for f in found)
+    assert "/debug/extra" in msgs        # registered, not expected
+    assert "/debug/ghost" in msgs        # expected, not registered
+    assert len(found) == 2
+
+
+def test_gl1006_matching_route_contract_clean():
+    server_src = (
+        "def handler(q):\n"
+        "    return 200\n"
+        "class Server:\n"
+        "    def __init__(self):\n"
+        "        self._routes = {\"/metrics\": handler}\n"
+    )
+    contract_src = "EXPECTED_ROUTES = [\"/metrics\"]\n"
+    assert lint_sources({"sptag_tpu/serve/http.py": server_src,
+                         "sptag_tpu/serve/contract.py": contract_src},
+                        select=["GL1006"]) == []
+
+
+def test_gl1001_verdict_produced_but_unregistered_flagged():
+    src = (
+        "TRIAGE_VERDICTS = (\"beam_budget\", \"unknown\")\n"
+        "def classify_low_recall(sample):\n"
+        "    return (\"rogue_verdict\", 0.5)\n"
+    )
+    found = lint_one(src, path="sptag_tpu/utils/qualmon.py",
+                     select=["GL1001"])
+    assert rules_of(found) == ["GL1001"]
+    assert "rogue_verdict" in found[0].message
+
+
+def test_gl1002_verdict_registered_but_never_returned_flagged():
+    src = (
+        "TRIAGE_VERDICTS = (\"beam_budget\", \"never_classified\")\n"
+        "def classify_low_recall(sample):\n"
+        "    return (\"beam_budget\", 0.5)\n"
+    )
+    found = lint_one(src, path="sptag_tpu/utils/qualmon.py",
+                     select=["GL1002"])
+    assert rules_of(found) == ["GL1002"]
+    assert any("never_classified" in f.message for f in found)
+
+
+def test_gl100x_silent_on_subpackage_scoped_lint():
+    """The contract graph is a whole-package analysis — a scoped lint
+    of one subpackage must not report phantom cross-subpackage edges
+    (serve/ reads series utils/ publishes, docs rows name core/params
+    specs, the bench vocabulary spans the tree)."""
+    for sub in ("core", "serve", "utils"):
+        root = os.path.join(REPO, "sptag_tpu", sub)
+        if not os.path.isdir(root):
+            continue
+        unsup, _sup, _stale = lint_project(
+            root, DEFAULT_BASELINE, select=["GL10"])
+        assert unsup == [], "\n".join(f.format() for f in unsup)
+
+
+def test_gl100x_registered_and_repo_clean_with_zero_waivers():
+    """GL1001-1006 are registered; the repo's observability graph is
+    fully closed (every consumer has a producer, every producer a
+    consumer or doc, params match docs) with ZERO baseline entries —
+    the ISSUE 18 acceptance bar."""
+    for rule in ("GL1001", "GL1002", "GL1003", "GL1004", "GL1005",
+                 "GL1006"):
+        assert rule in ALL_RULES
+    unsup, sup, _stale = lint_project(
+        os.path.join(REPO, "sptag_tpu"), DEFAULT_BASELINE,
+        select=["GL10"])
+    assert unsup == [], "\n".join(f.format() for f in unsup)
+    assert sup == []                     # nothing waived
+    from tools.graftlint.baseline import load_baseline
+    gl10_waivers = [s for s in load_baseline(DEFAULT_BASELINE)
+                    if s.rule.startswith("GL10")]
+    assert gl10_waivers == []            # zero GL10 baseline entries

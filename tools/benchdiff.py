@@ -118,9 +118,13 @@ METRICS: List[Metric] = [
     Metric("autotune.qps_at_slo", HIGHER, 0.20, 16.0),
     Metric("autotune.recall_at_10", HIGHER, 0.01, 0.005,
            platform_bound=False),
-    # mutation-under-load stage (ISSUE 9)
+    # mutation-under-load stage (ISSUE 9).  GL1001: this pair was
+    # silently dead from the day it landed — the stage emits
+    # `steady_p99_ms` (this entry watched the transposed
+    # `p99_steady_ms`) and emitted no read-throughput key at all
+    # (bench.py now produces `read_qps`)
     Metric("mutate.read_qps", HIGHER, 0.20, 25.0),
-    Metric("mutate.p99_steady_ms", LOWER, 0.25, 10.0),
+    Metric("mutate.steady_p99_ms", LOWER, 0.25, 10.0),
     # in-mesh sharded serving stage (ISSUE 11): the one-dispatch mesh
     # path's throughput/tail, its margin over the socket fan-out
     # baseline, and the merged-path recall (platform-independent).  The
@@ -149,6 +153,41 @@ METRICS: List[Metric] = [
     Metric("roofline.rows.beam.pct_peak", HIGHER, 0.20, 2.0),
     Metric("roofline.rows.int8.pct_peak", HIGHER, 0.20, 2.0),
 ]
+
+
+def validate_catalog(metrics: Optional[List[Metric]] = None,
+                     repo_root: str = ".") -> List[str]:
+    """GL10xx startup contract: every catalog path's dotted segments
+    must appear in the bench-artifact vocabulary (string constants in
+    bench.py + the package) harvested by the observability graph —
+    otherwise the entry can never match an artifact key and the diff
+    silently skips it (how `mutate.p99_steady_ms` stayed dead).
+    Returns human-readable problems; empty = valid.  Harvest failures
+    (no bench.py next to the caller, no package tree) return [] — the
+    static GL1001 pass owns that environment, not the CLI."""
+    import os
+
+    try:
+        from tools.graftlint import obsgraph
+        from tools.graftlint.core import Project
+    except ImportError:
+        return []
+    pkg = os.path.join(repo_root, "sptag_tpu")
+    if not os.path.isdir(pkg):
+        return []
+    model = obsgraph.build_model(Project.from_tree(pkg))
+    if not model.has_bench_vocab:
+        return []
+    problems = []
+    for metric in (METRICS if metrics is None else metrics):
+        bad = obsgraph.unknown_catalog_segments(metric.path,
+                                                model.bench_vocab)
+        if bad:
+            problems.append(
+                f"catalog metric `{metric.path}`: segment(s) "
+                f"{', '.join(repr(b) for b in bad)} unknown to any "
+                "bench.py artifact key")
+    return problems
 
 
 def load_artifact(path: str) -> Dict[str, Any]:
@@ -347,6 +386,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print every checked metric, not only "
                         "regressions/improvements")
     args = parser.parse_args(argv)
+    problems = validate_catalog()
+    if problems:
+        for p in problems:
+            print(f"benchdiff: {p}", file=sys.stderr)
+        print("benchdiff: metric catalog does not match the bench "
+              "artifact schema (config error)", file=sys.stderr)
+        return 2
     try:
         baseline = load_artifact(args.baseline)
         current = load_artifact(args.current)

@@ -237,6 +237,23 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_controller.py -q \
 echo "== GL609 controller audit-rule lint (standalone) =="
 python -m tools.graftlint sptag_tpu/ --select GL609
 
+# the ISSUE 18 contract-graph gate, standalone: the GL10xx
+# observability/config dataflow pass — every consumed metric/series/
+# route/param has a producer (GL1001), every producer a consumer or a
+# doc mention (GL1002), label sets agree (GL1003), params match
+# docs/PARAMETERS.md (GL1004/1005), routes match EXPECTED_ROUTES
+# (GL1006) — with ZERO baseline entries
+echo "== GL10 observability contract graph (standalone) =="
+python -m tools.graftlint sptag_tpu/ --select GL10
+
+# the ISSUE 18 runtime gate, standalone: boot the armed server+
+# aggregator scenario in-process, scrape /metrics + every debug route +
+# the timeline, and diff the live exposition against the static
+# ObsModel in BOTH directions — a name published but unmodeled, or
+# modeled/consumed but never emitted, fails here
+echo "== schema dump: live exposition vs static ObsModel =="
+env JAX_PLATFORMS=cpu python -m tools.graftlint --schema-dump
+
 # the ISSUE 6 observability gate, standalone: the cost ledger's
 # registered FLOPs/bytes formulas for the flat, dense and beam-segment
 # kernels must agree with XLA's own Compiled.cost_analysis() within

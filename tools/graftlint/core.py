@@ -317,7 +317,20 @@ class Project:
         #: here so every registered pass shares ONE parse + call graph
         #: per lint invocation instead of rebuilding its own
         self.cache: Dict[str, object] = {}
+        #: non-Python sources handed to the project (e.g. a planted
+        #: "docs/PARAMETERS.md" in an obsgraph fixture) — checkers that
+        #: cross-reference doc surfaces read them from here first, then
+        #: fall back to `source_root` on disk
+        self.extra_sources: Dict[str, str] = {}
+        #: repo root when this project was parsed from a real tree
+        #: (from_tree sets it); None for in-memory fixture projects —
+        #: cross-tree surfaces (docs/, tests/, tools/) are only
+        #: consulted when this is set
+        self.source_root: Optional[str] = None
         for relpath, src in sorted(sources.items()):
+            if not relpath.endswith(".py"):
+                self.extra_sources[relpath.replace(os.sep, "/")] = src
+                continue
             try:
                 self.modules[relpath] = ModuleInfo(relpath, src)
             except SyntaxError as e:
@@ -360,7 +373,9 @@ class Project:
                 rel = os.path.relpath(full, base)
                 with open(full, encoding="utf-8") as f:
                     sources[rel] = f.read()
-        return cls(sources, package_root=package_root)
+        project = cls(sources, package_root=package_root)
+        project.source_root = base
+        return project
 
     # -------------------------------------------------------- reachability
 

@@ -16,15 +16,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from tools.graftlint import (asyncrules, attrmodel, concurrency, costrules,
                              dtype_parity, errorpath, guardedby, hostsync,
-                             lockgraph, obsnames, persistrules, retrace,
-                             tracecontract)
+                             lockgraph, obsgraph, obsnames, persistrules,
+                             retrace, tracecontract)
 from tools.graftlint.baseline import (BaselineError, Suppression,
                                       apply_baseline, load_baseline)
 from tools.graftlint.core import Finding, Project
 
 CHECKERS = (hostsync, retrace, concurrency, errorpath, dtype_parity,
             obsnames, lockgraph, asyncrules, costrules, persistrules,
-            guardedby, tracecontract, attrmodel)
+            guardedby, tracecontract, attrmodel, obsgraph)
 
 #: rule id -> one-line description, collected from every checker module
 ALL_RULES: Dict[str, str] = {}
@@ -91,12 +91,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="only run rules with this id prefix "
                              "(repeatable, e.g. --select GL1)")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--schema-dump", action="store_true",
+                        help="boot a server+aggregator in-process with "
+                             "all telemetry armed, scrape every surface, "
+                             "and diff the live exposition against the "
+                             "static ObsModel (both directions)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in sorted(ALL_RULES):
             print(f"{rule}  {ALL_RULES[rule]}")
         return 0
+
+    if args.schema_dump:
+        from tools.graftlint import schemadump
+        return schemadump.main(args.paths or ["sptag_tpu"])
 
     baseline_path = None if args.no_baseline else args.baseline
     if baseline_path is not None and not os.path.exists(baseline_path):
